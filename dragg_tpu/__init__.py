@@ -1,0 +1,28 @@
+"""dragg_tpu — a TPU-native community energy-simulation framework.
+
+Re-implements the capabilities of the reference DRAGG simulator
+(corymosiman12/dragg: per-home MPC over HVAC/water-heater RC thermal dynamics,
+optional battery + PV, community aggregator, RL price-signal agent) as a
+batched tensor program: every home's MPC is a fixed-shape QP solved by a JAX
+ADMM kernel ``vmap``'d over the whole community and sharded over a TPU mesh,
+instead of one CVXPY MILP per home fanned out over a Redis-coordinated process
+pool (reference: dragg/aggregator.py:711-726, dragg/mpc_calc.py:434-454).
+
+Public API mirrors the reference's entry points:
+
+    from dragg_tpu import Aggregator
+    Aggregator().run()
+"""
+
+__version__ = "0.1.0"
+
+from dragg_tpu.config import load_config, default_config  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy import: keeps `import dragg_tpu` light and avoids import cycles.
+    if name == "Aggregator":
+        from dragg_tpu.aggregator import Aggregator
+
+        return Aggregator
+    raise AttributeError(f"module 'dragg_tpu' has no attribute {name!r}")

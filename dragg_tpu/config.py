@@ -1,0 +1,181 @@
+"""Config loading + schema validation.
+
+Mirrors the reference's TOML schema and required-key validation
+(dragg/aggregator.py:38-50,88-109 and dragg/data/config.toml:1-71).  The same
+TOML files the reference ships are loadable unchanged.  Differences:
+
+* reading uses the stdlib ``tomllib`` (the reference used the ``toml``
+  package);
+* validation raises ``ConfigError`` instead of calling ``sys.exit(1)``;
+* ``default_config()`` provides the full default configuration as a dict so
+  the framework runs standalone without a data directory.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import tomllib
+from typing import Any
+
+# Required-key schema — parity with dragg/aggregator.py:38-50.  The reference
+# requires home.wh.c_dist but never uses it (WH capacitance is derived from
+# tank size, dragg/mpc_calc.py:183-184); we therefore do NOT require it.
+REQUIRED_KEYS: dict[str, Any] = {
+    "community": {"total_number_homes"},
+    "home": {
+        "hvac": {"r_dist", "c_dist", "p_cool_dist", "p_heat_dist", "temp_sp_dist", "temp_deadband_dist"},
+        "wh": {"r_dist", "p_dist", "sp_dist", "deadband_dist", "size_dist", "waterdraw_file"},
+        "battery": {"max_rate", "capacity", "lower_bound", "upper_bound", "charge_eff", "discharge_eff"},
+        "pv": {"area", "efficiency"},
+        "hems": {"prediction_horizon", "sub_subhourly_steps", "discount_factor"},
+    },
+    "simulation": {"start_datetime", "end_datetime", "random_seed", "check_type", "run_rbo_mpc"},
+    "agg": {"base_price", "subhourly_steps"},
+}
+
+
+class ConfigError(ValueError):
+    """Raised when a config file fails schema validation."""
+
+
+def _validate(data: dict, required: dict, path: str = "") -> None:
+    for key, sub in required.items():
+        if key not in data:
+            raise ConfigError(f"Missing required config key: {path}{key}")
+        if isinstance(sub, dict):
+            _validate(data[key], sub, path=f"{path}{key}.")
+        elif isinstance(sub, set):
+            missing = sub - set(data[key].keys())
+            if missing:
+                raise ConfigError(f"Parameters for {path}{key}: {sorted(missing)} must be specified")
+
+
+def validate_config(data: dict) -> dict:
+    _validate(data, REQUIRED_KEYS)
+    return data
+
+
+def load_config(path: str | None = None) -> dict:
+    """Load and validate a TOML config.
+
+    Resolution mirrors the reference (dragg/aggregator.py:31-35): if ``path``
+    is None, use ``$DATA_DIR/$CONFIG_FILE`` (defaults ``data/config.toml``).
+    Falls back to :func:`default_config` if no file exists at the default
+    location and none was explicitly requested.
+    """
+    explicit = path is not None
+    if path is None:
+        data_dir = os.path.expanduser(os.environ.get("DATA_DIR", "data"))
+        path = os.path.join(data_dir, os.environ.get("CONFIG_FILE", "config.toml"))
+    if not os.path.exists(path):
+        if explicit:
+            raise ConfigError(f"Configuration file does not exist: {path}")
+        return default_config()
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    return validate_config(data)
+
+
+# Default configuration — same parameter distributions and simulation window
+# as the reference's shipped config (dragg/data/config.toml:1-71).
+_DEFAULT: dict[str, Any] = {
+    "community": {
+        "total_number_homes": 10,
+        "homes_battery": 0,
+        "homes_pv": 4,
+        "homes_pv_battery": 0,
+        "overwrite_existing": True,
+        "house_p_avg": 1.2,
+    },
+    "simulation": {
+        "start_datetime": "2015-01-01 00",
+        "end_datetime": "2015-01-04 00",
+        "random_seed": 12,
+        "n_nodes": 4,
+        "load_zone": "LZ_HOUSTON",
+        "check_type": "all",
+        "run_rbo_mpc": True,
+        "run_rl_agg": False,
+        "run_rl_simplified": False,
+        "checkpoint_interval": "daily",
+        "named_version": "test",
+    },
+    "agg": {
+        "base_price": 0.07,
+        "subhourly_steps": 1,
+        "tou_enabled": True,
+        "spp_enabled": False,
+        "rl": {
+            "action_horizon": 1,
+            "forecast_horizon": 1,
+            "prev_timesteps": 12,
+            "max_rp": 0.02,
+        },
+        "tou": {
+            "shoulder_times": [9, 21],
+            "shoulder_price": 0.09,
+            "peak_times": [14, 18],
+            "peak_price": 0.13,
+        },
+        "simplified": {"response_rate": 0.3, "offset": 0.0},
+    },
+    "home": {
+        "hvac": {
+            "r_dist": [6.8, 9.2],
+            "c_dist": [4.25, 5.75],
+            "p_cool_dist": [3.5, 3.5],
+            "p_heat_dist": [3.5, 3.5],
+            "temp_sp_dist": [18, 22],
+            "temp_deadband_dist": [2, 3],
+        },
+        "wh": {
+            "r_dist": [18.7, 25.3],
+            "p_dist": [2.5, 2.5],
+            "sp_dist": [45.5, 48.5],
+            "deadband_dist": [9, 12],
+            "size_dist": [200, 300],
+            "waterdraw_file": "waterdraw_profiles.csv",
+        },
+        "battery": {
+            "max_rate": [3, 5],
+            "capacity": [9.0, 13.5],
+            "lower_bound": [0.01, 0.15],
+            "upper_bound": [0.85, 0.99],
+            "charge_eff": [0.85, 0.95],
+            "discharge_eff": [0.97, 0.99],
+        },
+        "pv": {"area": [20, 32], "efficiency": [0.15, 0.2]},
+        "hems": {
+            "prediction_horizon": 6,
+            "sub_subhourly_steps": 6,
+            "discount_factor": 0.92,
+            "solver": "admm",
+        },
+    },
+    "rl": {
+        "utility": {"action_space": [-0.02, 0.02]},
+        "parameters": {
+            "alpha": 0.0625,
+            "beta": 1.0,
+            "epsilon": 0.05,
+            "batch_size": 32,
+            "twin_q": True,
+        },
+    },
+    # dragg_tpu-specific knobs (no reference analog).
+    "tpu": {
+        "admm_iters": 250,
+        "admm_rho": 0.1,
+        "admm_sigma": 1e-6,
+        "admm_alpha": 1.6,
+        "admm_eps": 1e-4,
+        "fix_tou_peak": False,  # reference bug parity: peak price is overwritten by shoulder (dragg/aggregator.py:214-215)
+        "mesh_axis": "homes",
+    },
+}
+
+
+def default_config() -> dict:
+    """Return a deep copy of the default configuration."""
+    return copy.deepcopy(_DEFAULT)

@@ -1,0 +1,229 @@
+"""Weather / price timeseries ingestion.
+
+Capability parity with the reference's data layer:
+
+* NSRDB weather csv ingestion with subhourly resampling
+  (dragg/aggregator.py:129-165) — same file format, same int cast of GHI/OAT,
+  same repeat-rows-to-dt-grid scheme.
+* TOU price construction (dragg/aggregator.py:206-216).  The reference
+  assigns the peak price and then *overwrites* it with the shoulder
+  assignment, so the peak price never takes effect; we reproduce that
+  effective behavior by default and fix it behind ``fix_tou_peak=True``.
+* Synthetic data generators so the framework runs standalone without the
+  NREL/NEEA data files (the reference ships them; we do not copy data).
+
+All series are produced at the aggregator's ``dt`` steps-per-hour resolution
+covering the full weather span, ready to be placed on device once and sliced
+per-timestep with ``lax.dynamic_slice`` inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from datetime import datetime
+
+import numpy as np
+import pandas as pd
+
+
+def parse_dt(s: str) -> datetime:
+    """Parse the reference's '%Y-%m-%d %H' datetime format (dragg/aggregator.py:118)."""
+    return datetime.strptime(s, "%Y-%m-%d %H")
+
+
+@dataclass
+class EnvironmentData:
+    """Full-span environmental series at dt steps/hour resolution.
+
+    Attributes
+    ----------
+    oat, ghi, tou : np.ndarray  (n_steps,)
+        Outdoor air temp (degC), global horizontal irradiance (W/m2), and
+        time-of-use price ($/kWh) over the whole data span.
+    data_start : datetime
+        Timestamp of index 0.
+    dt : int
+        Steps per hour.
+    """
+
+    oat: np.ndarray
+    ghi: np.ndarray
+    tou: np.ndarray
+    data_start: datetime
+    dt: int
+
+    @property
+    def n_steps(self) -> int:
+        return len(self.oat)
+
+    def start_index(self, start_dt: datetime) -> int:
+        """Step index of ``start_dt`` in the series.
+
+        The reference computed this in *hours* (dragg/aggregator.py:630-638)
+        and used it as a list index at dt resolution — correct only for
+        ``dt == 1``.  We index in steps, which coincides at dt=1.
+        """
+        hours = (start_dt - self.data_start).total_seconds() / 3600
+        return int(round(hours * self.dt))
+
+    def check_coverage(self, start_dt: datetime, end_dt: datetime, horizon_hours: int) -> None:
+        """Simulation window + prediction horizon must lie inside the data
+        (parity with dragg/aggregator.py:617-628)."""
+        s = self.start_index(start_dt)
+        if s < 0:
+            raise ValueError("The start datetime must exist in the data provided.")
+        e = self.start_index(end_dt) + horizon_hours * self.dt
+        if e + 1 > self.n_steps:
+            raise ValueError("The end datetime + the prediction horizon must exist in the data provided.")
+
+
+def load_nsrdb(path: str, dt: int) -> tuple[np.ndarray, np.ndarray, datetime]:
+    """Ingest an NSRDB csv (two metadata rows, then Year/Month/Day/Hour/Minute/
+    GHI/Temperature columns) and resample to ``dt`` steps/hour.
+
+    Mirrors dragg/aggregator.py:129-157: each source row (at 60/k-minute
+    cadence, typically half-hourly) is repeated ceil(dt/2) times if Minute==0
+    else floor(dt/2), yielding exactly dt rows per hour, and GHI/OAT are cast
+    to int.
+    """
+    df = pd.read_csv(path, skiprows=2)
+    reps = [int(np.ceil(dt / 2)) if v == 0 else int(np.floor(dt / 2)) for v in df.Minute]
+    df = df.loc[np.repeat(df.index.values, reps)]
+    df = df.rename(columns={"Temperature": "OAT"})
+    oat = df["OAT"].to_numpy().astype(int).astype(np.float64)
+    ghi = df["GHI"].to_numpy().astype(int).astype(np.float64)
+    first = df.iloc[0]
+    data_start = datetime(int(first.Year), int(first.Month), int(first.Day), int(first.Hour), 0)
+    return oat, ghi, data_start
+
+
+def build_tou(
+    n_steps: int,
+    data_start: datetime,
+    dt: int,
+    base_price: float,
+    tou_enabled: bool = True,
+    shoulder_times: tuple[int, int] = (9, 21),
+    shoulder_price: float = 0.09,
+    peak_times: tuple[int, int] = (14, 18),
+    peak_price: float = 0.13,
+    fix_tou_peak: bool = False,
+) -> np.ndarray:
+    """Construct the TOU price series over the full span.
+
+    Reference behavior (dragg/aggregator.py:206-216): price = shoulder_price
+    for hours in [shoulder_times), else base_price — the peak assignment is
+    dead code because the subsequent shoulder assignment overwrites the whole
+    column.  Set ``fix_tou_peak=True`` for the presumably-intended tiering
+    (peak within shoulder window).
+    """
+    hours = (np.arange(n_steps) // dt + data_start.hour) % 24
+    tou = np.full(n_steps, float(base_price))
+    if tou_enabled:
+        if fix_tou_peak:
+            sh = (hours >= shoulder_times[0]) & (hours < shoulder_times[1])
+            pk = (hours >= peak_times[0]) & (hours < peak_times[1])
+            tou[sh] = float(shoulder_price)
+            tou[pk] = float(peak_price)
+        else:
+            sh = (hours >= shoulder_times[0]) & (hours < shoulder_times[1])
+            tou[sh] = float(shoulder_price)
+    return tou
+
+
+def load_environment(config: dict, data_dir: str | None = None) -> EnvironmentData:
+    """Build the EnvironmentData from config: NSRDB file if present, else
+    synthetic weather covering the simulation year."""
+    dt = int(config["agg"]["subhourly_steps"])
+    ts_file = None
+    if data_dir is not None:
+        ts_file = os.path.join(data_dir, os.environ.get("SOLAR_TEMPERATURE_DATA_FILE", "nsrdb.csv"))
+    if ts_file is not None and os.path.exists(ts_file):
+        oat, ghi, data_start = load_nsrdb(ts_file, dt)
+    else:
+        start = parse_dt(config["simulation"]["start_datetime"])
+        year_start = datetime(start.year, 1, 1)
+        oat, ghi, data_start = synth_weather(year_start, days=366, dt=dt, seed=int(config["simulation"]["random_seed"]))
+    tou_cfg = config["agg"].get("tou", {})
+    tou = build_tou(
+        len(oat),
+        data_start,
+        dt,
+        base_price=config["agg"]["base_price"],
+        tou_enabled=bool(config["agg"].get("tou_enabled", False)),
+        shoulder_times=tuple(tou_cfg.get("shoulder_times", (9, 21))),
+        shoulder_price=float(tou_cfg.get("shoulder_price", 0.09)),
+        peak_times=tuple(tou_cfg.get("peak_times", (14, 18))),
+        peak_price=float(tou_cfg.get("peak_price", 0.13)),
+        fix_tou_peak=bool(config.get("tpu", {}).get("fix_tou_peak", False)),
+    )
+    return EnvironmentData(oat=oat, ghi=ghi, tou=tou, data_start=data_start, dt=dt)
+
+
+def synth_weather(
+    start: datetime, days: int, dt: int, seed: int = 0
+) -> tuple[np.ndarray, np.ndarray, datetime]:
+    """Generate synthetic weather at dt steps/hour: seasonal + diurnal OAT and
+    a clear-sky-like GHI, with the same int quantization the NSRDB ingest
+    applies.  Deterministic given ``seed``."""
+    rng = np.random.RandomState(seed ^ 0x5EED)
+    n = days * 24 * dt
+    t_hours = np.arange(n) / dt
+    doy = (t_hours / 24.0 + (start.timetuple().tm_yday - 1)) % 365.25
+    hod = (t_hours + start.hour) % 24.0
+    seasonal = 15.0 - 12.0 * np.cos(2 * np.pi * (doy - 15) / 365.25)
+    diurnal = 6.0 * np.sin(2 * np.pi * (hod - 9) / 24.0)
+    noise = rng.randn(n) * 1.5
+    # Smooth the noise so consecutive steps are correlated like real weather.
+    kernel = np.exp(-0.5 * (np.arange(-12, 13) / 4.0) ** 2)
+    kernel /= kernel.sum()
+    noise = np.convolve(noise, kernel, mode="same")
+    oat = np.round(seasonal + diurnal + noise).astype(int).astype(np.float64)
+    solar_elev = np.sin(np.pi * np.clip((hod - 6.0) / 12.0, 0.0, 1.0))
+    season_scale = 0.65 + 0.35 * np.sin(2 * np.pi * (doy - 80) / 365.25)
+    cloud = 1.0 - 0.3 * np.abs(np.sin(0.37 * t_hours + rng.rand() * 6.28))
+    ghi = np.round(950.0 * solar_elev * season_scale * cloud).astype(int)
+    ghi = np.clip(ghi, 0, None).astype(np.float64)
+    return oat, ghi, start
+
+
+def synth_waterdraw_profiles(
+    n_profiles: int = 10, days: int = 7, seed: int = 0
+) -> pd.DataFrame:
+    """Generate minutely water-draw flow profiles in the reference file's
+    layout (datetime index, one column per profile; see
+    waterdraw_profiles.csv ingestion at dragg/aggregator.py:365-377).
+
+    Draw events cluster at morning and evening hours, ~150-250 L/day total.
+    """
+    rng = np.random.RandomState(seed ^ 0xD3A3)
+    n_min = days * 24 * 60
+    idx = pd.date_range("2020-01-01", periods=n_min, freq="min")
+    cols = {}
+    minute_of_day = np.arange(n_min) % (24 * 60)
+    density = (
+        0.2
+        + 1.2 * np.exp(-0.5 * ((minute_of_day - 7 * 60) / 60.0) ** 2)
+        + 1.0 * np.exp(-0.5 * ((minute_of_day - 19 * 60) / 90.0) ** 2)
+    )
+    density /= density.sum() / (24 * 60)
+    for p in range(n_profiles):
+        flows = np.zeros(n_min)
+        n_events = rng.poisson(8 * days)
+        starts = rng.choice(n_min, size=n_events, p=density / density.sum())
+        for s in starts:
+            dur = rng.randint(1, 12)
+            rate = rng.uniform(2.0, 8.0)
+            flows[s : s + dur] += rate
+        cols[f"Flow_{p:05d}"] = flows
+    return pd.DataFrame(cols, index=idx)
+
+
+def load_waterdraw_profiles(path: str | None, seed: int = 0) -> pd.DataFrame:
+    """Load the minutely water-draw profile csv, or synthesize one."""
+    if path is not None and os.path.exists(path):
+        df = pd.read_csv(path, index_col=0)
+        df.index = pd.to_datetime(df.index, format="%Y-%m-%d %H:%M:%S")
+        return df
+    return synth_waterdraw_profiles(seed=seed)

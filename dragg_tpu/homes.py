@@ -1,0 +1,302 @@
+"""Seeded home-population synthesis.
+
+Capability parity with the reference's ``create_homes``
+(dragg/aggregator.py:273-587): given parameter distributions and per-type
+counts, produce the community as (a) a list of JSON-serializable home dicts
+with the reference's exact schema (so cached ``all_homes-<N>-config.json``
+files interoperate) and (b) a :class:`HomeBatch` struct-of-arrays padded to a
+single superset shape so the whole community solves as one batched tensor
+program (base homes get zero-width battery/PV blocks; SURVEY.md §7 step 2).
+
+Seeding: the numpy parameter streams are drawn in the reference's exact order
+(dragg/aggregator.py:281-359 then the per-type loops :393-578), so home
+parameters are reproducible home-by-home for a given seed.  Home *names* use
+an embedded name pool instead of the third-party ``names`` package, and the
+water-draw profile sampling uses the same global-numpy-RNG calls in a
+documented order (pandas' internal ``DataFrame.sample`` RNG consumption is
+version-dependent and not reproducible bit-for-bit).
+"""
+
+from __future__ import annotations
+
+import random
+import string
+from typing import Any, NamedTuple
+
+import numpy as np
+import pandas as pd
+
+from dragg_tpu.names_data import FIRST_NAMES
+
+HOME_TYPES = ("pv_battery", "pv_only", "battery_only", "base")
+TYPE_CODES = {t: i for i, t in enumerate(HOME_TYPES)}
+
+
+def _uniform(rng_cfg, n):
+    return np.random.uniform(rng_cfg[0], rng_cfg[1], n)
+
+
+def _make_name() -> str:
+    first = random.choice(FIRST_NAMES)
+    suffix = "".join(random.choices(string.ascii_uppercase + string.digits, k=5))
+    return f"{first}-{suffix}"
+
+
+def _battery_params(cfg: dict) -> dict:
+    b = cfg["home"]["battery"]
+    return {
+        "max_rate": np.random.uniform(b["max_rate"][0], b["max_rate"][1]),
+        "capacity": np.random.uniform(b["capacity"][0], b["capacity"][1]),
+        "capacity_lower": np.random.uniform(b["lower_bound"][0], b["lower_bound"][1]),
+        "capacity_upper": np.random.uniform(b["upper_bound"][0], b["upper_bound"][1]),
+        "ch_eff": np.random.uniform(b["charge_eff"][0], b["charge_eff"][1]),
+        "disch_eff": np.random.uniform(b["discharge_eff"][0], b["discharge_eff"][1]),
+        "e_batt_init": np.random.uniform(b["lower_bound"][1], b["upper_bound"][0]),
+    }
+
+
+def _pv_params(cfg: dict) -> dict:
+    p = cfg["home"]["pv"]
+    return {
+        "area": np.random.uniform(p["area"][0], p["area"][1]),
+        "eff": np.random.uniform(p["efficiency"][0], p["efficiency"][1]),
+    }
+
+
+def create_homes(
+    config: dict,
+    num_timesteps: int,
+    dt: int,
+    waterdraw_df: pd.DataFrame,
+) -> list[dict[str, Any]]:
+    """Synthesize the home population.  Returns the reference-schema list of
+    home dicts (order: pv_battery, pv_only, battery_only, base — parity with
+    dragg/aggregator.py:393-578)."""
+    seed = int(config["simulation"]["random_seed"])
+    np.random.seed(seed)
+    random.seed(seed)
+    n = int(config["community"]["total_number_homes"])
+    hvac = config["home"]["hvac"]
+    wh = config["home"]["wh"]
+
+    # HVAC parameter streams (order parity: dragg/aggregator.py:285-322).
+    home_r = _uniform(hvac["r_dist"], n)
+    home_c = _uniform(hvac["c_dist"], n)
+    p_cool = _uniform(hvac["p_cool_dist"], n)
+    p_heat = _uniform(hvac["p_heat_dist"], n)
+    t_sp = _uniform(hvac["temp_sp_dist"], n)
+    t_db = _uniform(hvac["temp_deadband_dist"], n)
+    t_init_pos = np.random.uniform(0.25, 0.75, n)
+    t_min = t_sp - 0.5 * t_db
+    t_max = t_sp + 0.5 * t_db
+    t_init = t_min + t_init_pos * t_db
+
+    # Water-heater parameter streams (order parity: dragg/aggregator.py:325-359).
+    wh_r = _uniform(wh["r_dist"], n)
+    wh_p = _uniform(wh["p_dist"], n)
+    wh_sp = _uniform(wh["sp_dist"], n)
+    wh_db = _uniform(wh["deadband_dist"], n)
+    wh_init_pos = np.random.uniform(0.25, 0.75, n)
+    wh_min = wh_sp - 0.5 * wh_db
+    wh_max = wh_sp + 0.5 * wh_db
+    wh_init = wh_min + wh_init_pos * wh_db
+    wh_size = _uniform(wh["size_dist"], n)
+
+    # Water-draw events (dragg/aggregator.py:361-377): per-cell lognormal-ish
+    # noise, hourly resample, then per home pick a random profile column and
+    # ndays random days, clipped to tank size.
+    ndays = num_timesteps // (24 * dt) + 1
+    noisy = waterdraw_df.to_numpy() * (1 + 0.2 * np.random.randn(waterdraw_df.shape[1], waterdraw_df.shape[0]).T)
+    hourly = (
+        pd.DataFrame(noisy, index=waterdraw_df.index, columns=waterdraw_df.columns)
+        .resample("h")
+        .sum()
+        .to_numpy()
+    )
+    n_hours_data, n_cols = hourly.shape
+    n_days_data = n_hours_data // 24
+    draw_sizes_all = []
+    for j in range(n):
+        col = int(np.random.choice(n_cols))
+        this_house = hourly[: n_days_data * 24, col].reshape(-1, 24)
+        days = np.random.choice(this_house.shape[0], ndays)
+        this_house = this_house[days].flatten()
+        draw_sizes_all.append(np.clip(this_house, 0, wh_size[j]).tolist())
+
+    hems = {
+        "horizon": config["home"]["hems"]["prediction_horizon"],
+        "hourly_agg_steps": dt,
+        "sub_subhourly_steps": config["home"]["hems"]["sub_subhourly_steps"],
+        "solver": config["home"]["hems"].get("solver", "admm"),
+        "discount_factor": config["home"]["hems"]["discount_factor"],
+    }
+
+    def _common(i):
+        return {
+            "hvac": {
+                "r": home_r[i], "c": home_c[i], "p_c": p_cool[i], "p_h": p_heat[i],
+                "temp_in_min": t_min[i], "temp_in_max": t_max[i],
+                "temp_in_sp": t_sp[i], "temp_in_init": t_init[i],
+            },
+            "wh": {
+                "r": wh_r[i], "p": wh_p[i],
+                "temp_wh_min": wh_min[i], "temp_wh_max": wh_max[i],
+                "temp_wh_sp": wh_sp[i], "temp_wh_init": wh_init[i],
+                "tank_size": wh_size[i], "draw_sizes": draw_sizes_all[i],
+            },
+            "hems": hems,
+        }
+
+    comm = config["community"]
+    n_pvb = int(comm.get("homes_pv_battery", 0))
+    n_pv = int(comm.get("homes_pv", 0))
+    n_b = int(comm.get("homes_battery", 0))
+    n_base = n - n_pvb - n_pv - n_b
+    if n_base < 0:
+        raise ValueError("Per-type home counts exceed total_number_homes")
+
+    all_homes: list[dict[str, Any]] = []
+    i = 0
+    for _ in range(n_pvb):
+        name = _make_name()
+        battery = _battery_params(config)
+        pv = _pv_params(config)
+        all_homes.append({"name": name, "type": "pv_battery", **_common(i), "battery": battery, "pv": pv})
+        i += 1
+    for _ in range(n_pv):
+        name = _make_name()
+        pv = _pv_params(config)
+        all_homes.append({"name": name, "type": "pv_only", **_common(i), "pv": pv})
+        i += 1
+    for _ in range(n_b):
+        name = _make_name()
+        battery = _battery_params(config)
+        all_homes.append({"name": name, "type": "battery_only", **_common(i), "battery": battery})
+        i += 1
+    for _ in range(n_base):
+        name = _make_name()
+        all_homes.append({"name": name, "type": "base", **_common(i)})
+        i += 1
+    return all_homes
+
+
+def check_home_configs(all_homes: list[dict], config: dict) -> None:
+    """Population check — counts of each home type must match config
+    (parity with dragg/aggregator.py:232-253)."""
+    counts = {t: sum(1 for h in all_homes if h["type"] == t) for t in HOME_TYPES}
+    comm = config["community"]
+    expect = {
+        "pv_battery": int(comm.get("homes_pv_battery", 0)),
+        "pv_only": int(comm.get("homes_pv", 0)),
+        "battery_only": int(comm.get("homes_battery", 0)),
+    }
+    expect["base"] = int(comm["total_number_homes"]) - sum(expect.values())
+    for t, c in expect.items():
+        if counts[t] != c:
+            raise ValueError(f"Incorrect number of {t} homes: {counts[t]} != {c}")
+
+
+class HomeBatch(NamedTuple):
+    """Struct-of-arrays community, padded to the superset (pv_battery) shape.
+
+    All arrays have leading dim n_homes.  Physical parameters keep the
+    reference's units and meanings (dragg/mpc_calc.py:157-191,233-262):
+    ``hvac_c`` already includes the ×1000 scale, ``hvac_p_c``/``p_h``/``wh_p``
+    are per-sub-subhourly-step powers (total / s), ``wh_r`` includes ×1000,
+    ``wh_c = tank_size * 4.2`` kJ/degC.
+    """
+
+    type_code: np.ndarray      # int, index into HOME_TYPES
+    has_pv: np.ndarray         # float 0/1
+    has_batt: np.ndarray       # float 0/1
+    hvac_r: np.ndarray
+    hvac_c: np.ndarray         # c * 1000
+    hvac_p_c: np.ndarray       # p_c / s
+    hvac_p_h: np.ndarray       # p_h / s
+    temp_in_min: np.ndarray
+    temp_in_max: np.ndarray
+    temp_in_init: np.ndarray
+    wh_r: np.ndarray           # r * 1000
+    wh_c: np.ndarray           # tank_size * 4.2
+    wh_p: np.ndarray           # p / s
+    temp_wh_min: np.ndarray
+    temp_wh_max: np.ndarray
+    temp_wh_init: np.ndarray
+    tank_size: np.ndarray
+    draws_hourly: np.ndarray   # (n_homes, pad + n_hours) with (H//dt + 1) leading zeros
+    batt_max_rate: np.ndarray
+    batt_cap_min: np.ndarray   # capacity_lower * capacity
+    batt_cap_max: np.ndarray   # capacity_upper * capacity
+    batt_ch_eff: np.ndarray
+    batt_disch_eff: np.ndarray
+    e_batt_init_frac: np.ndarray  # fraction of capacity (t=0 init; dragg/mpc_calc.py:274)
+    batt_capacity: np.ndarray
+    pv_area: np.ndarray
+    pv_eff: np.ndarray
+
+    @property
+    def n_homes(self) -> int:
+        return int(self.type_code.shape[0])
+
+
+def build_home_batch(all_homes: list[dict], horizon: int, dt: int, sub_steps: int) -> HomeBatch:
+    """Pack home dicts into the padded superset batch.
+
+    ``draws_hourly`` is prepended with ``horizon//dt + 1`` zero hours exactly
+    as the reference's ``water_draws`` does (dragg/mpc_calc.py:194), so a
+    window slice at hour ``t//dt`` of length ``horizon//dt + 1`` reproduces
+    the reference draw schedule.
+    """
+    n = len(all_homes)
+    s = float(max(1, sub_steps))
+    pad = horizon // dt + 1
+
+    def g(fn, default=0.0):
+        return np.array([fn(h) if fn(h) is not None else default for h in all_homes], dtype=np.float64)
+
+    type_code = np.array([TYPE_CODES[h["type"]] for h in all_homes], dtype=np.int32)
+    has_pv = np.array(["pv" in h["type"] for h in all_homes], dtype=np.float64)
+    has_batt = np.array(["battery" in h["type"] for h in all_homes], dtype=np.float64)
+
+    draw_len = max(len(h["wh"]["draw_sizes"]) for h in all_homes)
+    draws = np.zeros((n, pad + draw_len), dtype=np.float64)
+    for i, h in enumerate(all_homes):
+        d = np.asarray(h["wh"]["draw_sizes"], dtype=np.float64)
+        draws[i, pad : pad + len(d)] = d
+
+    def batt(key, default=0.0):
+        return np.array(
+            [float(h["battery"][key]) if "battery" in h else default for h in all_homes],
+            dtype=np.float64,
+        )
+
+    capacity = batt("capacity")
+    return HomeBatch(
+        type_code=type_code,
+        has_pv=has_pv,
+        has_batt=has_batt,
+        hvac_r=g(lambda h: float(h["hvac"]["r"])),
+        hvac_c=g(lambda h: float(h["hvac"]["c"]) * 1000.0),
+        hvac_p_c=g(lambda h: float(h["hvac"]["p_c"]) / s),
+        hvac_p_h=g(lambda h: float(h["hvac"]["p_h"]) / s),
+        temp_in_min=g(lambda h: float(h["hvac"]["temp_in_min"])),
+        temp_in_max=g(lambda h: float(h["hvac"]["temp_in_max"])),
+        temp_in_init=g(lambda h: float(h["hvac"]["temp_in_init"])),
+        wh_r=g(lambda h: float(h["wh"]["r"]) * 1000.0),
+        wh_c=g(lambda h: float(h["wh"]["tank_size"]) * 4.2),
+        wh_p=g(lambda h: float(h["wh"]["p"]) / s),
+        temp_wh_min=g(lambda h: float(h["wh"]["temp_wh_min"])),
+        temp_wh_max=g(lambda h: float(h["wh"]["temp_wh_max"])),
+        temp_wh_init=g(lambda h: float(h["wh"]["temp_wh_init"])),
+        tank_size=g(lambda h: float(h["wh"]["tank_size"])),
+        draws_hourly=draws,
+        batt_max_rate=batt("max_rate"),
+        batt_cap_min=batt("capacity_lower") * capacity,
+        batt_cap_max=batt("capacity_upper") * capacity,
+        batt_ch_eff=batt("ch_eff", 1.0),
+        batt_disch_eff=batt("disch_eff", 1.0),
+        e_batt_init_frac=batt("e_batt_init"),
+        batt_capacity=capacity,
+        pv_area=np.array([float(h["pv"]["area"]) if "pv" in h else 0.0 for h in all_homes]),
+        pv_eff=np.array([float(h["pv"]["eff"]) if "pv" in h else 0.0 for h in all_homes]),
+    )

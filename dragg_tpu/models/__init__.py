@@ -1,0 +1,4 @@
+from dragg_tpu.models.thermal import hvac_step, wh_mix, wh_step, expand_draws  # noqa: F401
+from dragg_tpu.models.battery import battery_step  # noqa: F401
+from dragg_tpu.models.pv import pv_power  # noqa: F401
+from dragg_tpu.models.fallback import fallback_control, FallbackResult  # noqa: F401

@@ -1,0 +1,95 @@
+"""Vectorized rule-based fallback controller.
+
+Capability parity with the reference's infeasibility recovery
+(dragg/mpc_calc.py:527-596): when a home's MPC solve fails, (i) replay the
+last feasible plan shifted by ``solve_counter`` and patch it bang-bang where
+the simulated temperatures would violate bounds, else (ii) pure bang-bang
+keyed on the current thermal state.  This controller doubles as the
+horizon-0 "no-MPC" mode.
+
+Implemented as a branch-free batched function (every home evaluates both
+paths; ``jnp.where`` selects), so it composes with ``vmap``/``pjit`` and
+runs inside the jitted engine step — the reference handles this per-home
+imperatively (SURVEY.md §5.3).
+
+Unit note: duties here are raw counts in [0, s].  The reference's replay
+path reads back the *stored* (duty/s) value and multiplies by the per-step
+power P/s, under-heating replayed steps by a factor of s
+(dragg/mpc_calc.py:537-547 vs :342); we use consistent raw-duty units
+throughout instead of replicating that inconsistency.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from dragg_tpu.models.thermal import hvac_step, wh_step
+
+
+class FallbackResult(NamedTuple):
+    cool_on: jnp.ndarray   # raw duty [0, s]
+    heat_on: jnp.ndarray
+    wh_on: jnp.ndarray
+    temp_in: jnp.ndarray   # simulated next indoor temp
+    temp_wh: jnp.ndarray   # simulated next WH temp
+    counter: jnp.ndarray   # updated solve_counter
+
+
+def fallback_control(
+    counter,            # (n,) previous solve_counter (int) — already for this failure: counter_prev + 1
+    timestep,           # scalar int
+    horizon: int,
+    replay_cool,        # (n,) raw-duty plan value at index `counter` of the last feasible plan
+    replay_heat,
+    replay_wh,
+    temp_in_init,       # (n,)
+    temp_wh_init,       # (n,) (after draw mixing)
+    oat1,               # scalar or (n,) OAT at step t+1
+    hvac_r, hvac_c, hvac_p_c, hvac_p_h,
+    wh_r, wh_c, wh_p,
+    temp_in_min, temp_in_max, temp_wh_min, temp_wh_max,
+    cool_max, heat_max, wh_max,  # (n,) seasonal duty caps (0 or s)
+    dt: int,
+) -> FallbackResult:
+    """Compute fallback duties + simulated temps for every home.
+
+    The caller increments ``counter`` before the call (reference increments
+    at dragg/mpc_calc.py:529) and applies the result only where the solve
+    failed.
+    """
+    zero = jnp.zeros_like(temp_in_init)
+
+    # --- Path A: replay last feasible plan, shifted (dragg/mpc_calc.py:533-557).
+    replay_ok = (counter < horizon) & (timestep > 0)
+    a_cool, a_heat, a_wh = replay_cool, replay_heat, replay_wh
+    t_in_a = hvac_step(temp_in_init, oat1, hvac_r, hvac_c, dt, a_cool, a_heat, hvac_p_c, hvac_p_h)
+    t_wh_a = wh_step(temp_wh_init, t_in_a, wh_r, wh_c, dt, a_wh, wh_p)
+    too_hot = t_in_a > temp_in_max
+    too_cold = t_in_a < temp_in_min
+    a_heat = jnp.where(too_hot, zero, jnp.where(too_cold, heat_max, a_heat))
+    a_cool = jnp.where(too_hot, cool_max, jnp.where(too_cold, zero, a_cool))
+    a_wh = jnp.where(t_wh_a < temp_wh_min, wh_max, a_wh)
+
+    # --- Path B: pure bang-bang on current state (dragg/mpc_calc.py:559-574).
+    hot0 = temp_in_init > temp_in_max
+    cold0 = temp_in_init < temp_in_min
+    b_heat = jnp.where(cold0, heat_max, zero)
+    b_cool = jnp.where(hot0, cool_max, zero)
+    b_wh = jnp.where(temp_wh_init < temp_wh_min, wh_max, zero)
+    counter_b = jnp.maximum(counter, horizon)
+
+    cool = jnp.where(replay_ok, a_cool, b_cool)
+    heat = jnp.where(replay_ok, a_heat, b_heat)
+    wh = jnp.where(replay_ok, a_wh, b_wh)
+    new_counter = jnp.where(replay_ok, counter, counter_b)
+
+    # Final forward simulation with the chosen duties (dragg/mpc_calc.py:576-582).
+    new_temp_in = hvac_step(temp_in_init, oat1, hvac_r, hvac_c, dt, cool, heat, hvac_p_c, hvac_p_h)
+    new_temp_wh = wh_step(temp_wh_init, new_temp_in, wh_r, wh_c, dt, wh, wh_p)
+
+    return FallbackResult(
+        cool_on=cool, heat_on=heat, wh_on=wh,
+        temp_in=new_temp_in, temp_wh=new_temp_wh, counter=new_counter,
+    )

@@ -1,0 +1,75 @@
+"""RC thermal dynamics for HVAC and water heater — pure JAX, batchable.
+
+These are the update equations of the reference MPC constraints
+(dragg/mpc_calc.py:313-342) and of its fallback simulator
+(dragg/mpc_calc.py:541-582), written once as vectorized functions so the QP
+builder, the fallback controller, and the unit tests all share them.
+
+Units follow the reference: R in degC/kW, C in kJ/degC (the home dict's
+``c`` × 1000), powers in kW per sub-subhourly step (total power / s), dt in
+steps-per-hour, duties are raw counts in [0, s].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def hvac_step(temp_in, oat_next, hvac_r, hvac_c, dt, cool_on, heat_on, p_c, p_h):
+    """One indoor-temperature RC step (dragg/mpc_calc.py:313-317).
+
+    T' = T + 3600 * ((OAT - T)/R - cool*Pc + heat*Ph) / (C * dt)
+    """
+    return temp_in + 3600.0 * (
+        (oat_next - temp_in) / hvac_r - cool_on * p_c + heat_on * p_h
+    ) / (hvac_c * dt)
+
+
+def wh_mix(temp_wh, draw, tank_size, tap_temp=15.0):
+    """Water-draw mixing (dragg/mpc_calc.py:271,281):
+    T' = (T*(size - draw) + tap*draw) / size.  tap_temp=15 degC as in the
+    reference (dragg/mpc_calc.py:181)."""
+    return (temp_wh * (tank_size - draw) + tap_temp * draw) / tank_size
+
+
+def wh_step(temp_wh, temp_in_next, wh_r, wh_c, dt, wh_on, wh_p):
+    """One water-heater RC step (dragg/mpc_calc.py:336-338):
+    T' = T + 3600 * ((Tin - T)/Rwh + wh*Pwh) / (Cwh * dt)
+    """
+    return temp_wh + 3600.0 * (
+        (temp_in_next - temp_wh) / wh_r + wh_on * wh_p
+    ) / (wh_c * dt)
+
+
+def wh_traj_step(temp_wh, temp_in_next, frac, wh_r, wh_c, dt, wh_on, wh_p, tap_temp=15.0):
+    """One step of the *trajectory* WH constraint with in-step draw mixing
+    (dragg/mpc_calc.py:330-332): the mixed temperature
+    M = (1-frac)*T + frac*tap replaces T in the RC update."""
+    mixed = (1.0 - frac) * temp_wh + frac * tap_temp
+    return mixed + 3600.0 * ((temp_in_next - mixed) / wh_r + wh_on * wh_p) / (wh_c * dt)
+
+
+def expand_draws(window_hourly, dt: int, horizon: int):
+    """Expand an hourly draw window to the subhourly horizon grid.
+
+    Reproduces the reference's ``water_draws`` (dragg/mpc_calc.py:193-201):
+    the hourly window (length horizon//dt + 1) is repeated dt times and
+    divided by dt; the first dt entries are used as-is and entries at index
+    i >= dt are the mean of raw[i-1 : i+2] (a shorter window at the array
+    end).  Returns draw sizes of length horizon + 1.
+
+    ``window_hourly`` may be batched with leading dims; expansion applies to
+    the last axis.
+    """
+    raw = jnp.repeat(window_hourly, dt, axis=-1) / dt  # (..., horizon + dt)
+    n_raw = raw.shape[-1]
+    h_plus = horizon + 1
+    idx = jnp.arange(h_plus)
+    # Rolling mean of raw[i-1:i+2] with edge truncation, matching
+    # np.average over a python slice.
+    prev_ok = (idx - 1 >= 0).astype(raw.dtype)
+    next_ok = (idx + 1 < n_raw).astype(raw.dtype)
+    take = lambda off: jnp.take(raw, jnp.clip(idx + off, 0, n_raw - 1), axis=-1)
+    rolled = (take(-1) * prev_ok + take(0) + take(1) * next_ok) / (prev_ok + 1.0 + next_ok)
+    direct = jnp.take(raw, jnp.minimum(idx, n_raw - 1), axis=-1)
+    return jnp.where(idx < dt, direct, rolled)

@@ -1,0 +1,344 @@
+"""Fixed-shape QP formulation of the per-home MPC.
+
+The reference builds a CVXPY mixed-integer program per home per timestep and
+canonicalizes it at runtime (dragg/mpc_calc.py:291-454).  Here the
+(home-type, horizon) template is compiled once into index arrays, and each
+timestep only fills a per-home coefficient vector — no runtime
+canonicalization, fixed shapes, so the whole community batches on the MXU
+(SURVEY.md §2.2, §7 step 2).
+
+Relaxation: the reference's integer duty-cycle variables
+(dragg/mpc_calc.py:171-173, bounded [0, sub_subhourly_steps]) are relaxed to
+box-constrained continuous duty fractions.  The reference itself divides the
+integer counts by ``sub_subhourly_steps`` to report duty fractions
+(dragg/mpc_calc.py:497-499), so the LP/QP relaxation is the parity target
+(SURVEY.md §2.2); its optimal cost lower-bounds the MILP's.
+
+Problem form (OSQP convention):  minimize (1/2) x'(eps I)x + q'x subject to
+l <= A x <= u, with A = [A_eq; I] — equality rows (dynamics + initial
+conditions) followed by an identity box block.  Only the box block and RHS
+change shape-free per timestep; A_eq has a fixed sparsity whose values are
+per-home (static) except the water-draw mixing coefficients, which vary per
+timestep (dragg/mpc_calc.py:330-332).
+
+Variable vector per home (superset pv_battery shape; base homes get
+zero-width battery/PV via [0,0] bounds), horizon H:
+
+    cool[H] heat[H] wh[H] p_ch[H] p_disch[H] u_curt[H]
+    T_in_ev[H+1] T_wh_ev[H+1] e_batt[H+1] T_in1 T_wh1        (n = 9H + 5)
+
+p_load / p_grid / cost of the reference are affine in these and eliminated;
+the objective sum_k discount^k * price[k] * p_grid[k]
+(dragg/mpc_calc.py:441-446) becomes a linear q over the controls.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+TAP_TEMP = 15.0  # assumed cold tap water temp, degC (dragg/mpc_calc.py:181)
+BIG = jnp.inf
+
+
+class QPLayout:
+    """Index bookkeeping for the per-home variable vector and equality rows."""
+
+    def __init__(self, horizon: int):
+        H = int(horizon)
+        self.H = H
+        self.i_cool = 0
+        self.i_heat = H
+        self.i_wh = 2 * H
+        self.i_pch = 3 * H
+        self.i_pd = 4 * H
+        self.i_curt = 5 * H
+        self.i_tin = 6 * H
+        self.i_twh = 7 * H + 1
+        self.i_eb = 8 * H + 2
+        self.i_tin1 = 9 * H + 3
+        self.i_twh1 = 9 * H + 4
+        self.n = 9 * H + 5
+        # Equality rows.
+        self.r_tin0 = 0
+        self.r_tind = 1                  # H rows
+        self.r_twh0 = H + 1
+        self.r_twhd = H + 2              # H rows
+        self.r_tin1 = 2 * H + 2
+        self.r_twh1 = 2 * H + 3
+        self.r_eb0 = 2 * H + 4
+        self.r_ebd = 2 * H + 5           # H rows
+        self.m_eq = 3 * H + 5
+        self.m = self.m_eq + self.n
+
+
+class HomeQPStatic(NamedTuple):
+    """Per-home static pieces: the (row, col) sparsity (shared) plus the
+    per-home coefficient values split into static entries and the indices of
+    the timestep-varying water-mix band."""
+
+    rows: np.ndarray          # (nnz,) shared across homes
+    cols: np.ndarray          # (nnz,)
+    vals: jnp.ndarray         # (n_homes, nnz) — static values; wh-mix band filled per step
+    whmix_pos: np.ndarray     # (H,) positions in the nnz axis of the wh-mix coefficients
+    a_in: jnp.ndarray         # (n_homes,) 3600 / (C * dt)
+    a_wh: jnp.ndarray         # (n_homes,) 3600 / (wh_c * dt)
+    kin: jnp.ndarray          # (n_homes,) 1 - a_in / R
+    kwh: jnp.ndarray          # (n_homes,) 1 - a_wh / wh_r
+    awr: jnp.ndarray          # (n_homes,) a_wh / wh_r
+
+
+def build_qp_static(batch, horizon: int, dt: int) -> HomeQPStatic:
+    """Precompute the equality-constraint sparsity + per-home coefficients.
+
+    ``batch`` is a HomeBatch (arrays may be numpy or jax).  Row/col index
+    arrays are identical for every home; values are per-home.
+    """
+    lay = QPLayout(horizon)
+    H = lay.H
+    n_homes = batch.hvac_r.shape[0]
+
+    a_in = 3600.0 / (np.asarray(batch.hvac_c) * dt)
+    a_wh = 3600.0 / (np.asarray(batch.wh_c) * dt)
+    R = np.asarray(batch.hvac_r)
+    wh_r = np.asarray(batch.wh_r)
+    kin = 1.0 - a_in / R
+    kwh = 1.0 - a_wh / wh_r
+    awr = a_wh / wh_r
+    pc = np.asarray(batch.hvac_p_c)
+    ph = np.asarray(batch.hvac_p_h)
+    pwh = np.asarray(batch.wh_p)
+    che = np.asarray(batch.batt_ch_eff)
+    dse = np.asarray(batch.batt_disch_eff)
+
+    rows, cols, vals = [], [], []
+    whmix_pos = np.zeros(H, dtype=np.int64)
+
+    def add(r, c, v):
+        rows.append(r)
+        cols.append(c)
+        vals.append(np.broadcast_to(v, (n_homes,)).astype(np.float64))
+        return len(rows) - 1
+
+    ks = np.arange(H)
+    # Indoor temp: T[0] pin + dynamics (dragg/mpc_calc.py:313-317).
+    add(lay.r_tin0, lay.i_tin, 1.0)
+    for k in range(H):
+        add(lay.r_tind + k, lay.i_tin + k + 1, 1.0)
+        add(lay.r_tind + k, lay.i_tin + k, -kin)
+        add(lay.r_tind + k, lay.i_cool + k, a_in * pc)
+        add(lay.r_tind + k, lay.i_heat + k, -a_in * ph)
+    # WH temp: T[0] pin + dynamics with draw mixing (dragg/mpc_calc.py:329-332).
+    add(lay.r_twh0, lay.i_twh, 1.0)
+    for k in range(H):
+        add(lay.r_twhd + k, lay.i_twh + k + 1, 1.0)
+        whmix_pos[k] = add(lay.r_twhd + k, lay.i_twh + k, 0.0)  # -rem[k+1]*kwh, per step
+        add(lay.r_twhd + k, lay.i_tin + k + 1, -awr)
+        add(lay.r_twhd + k, lay.i_wh + k, -a_wh * pwh)
+    # One-step deterministic temps (dragg/mpc_calc.py:321-324,336-338).
+    add(lay.r_tin1, lay.i_tin1, 1.0)
+    add(lay.r_tin1, lay.i_cool, a_in * pc)
+    add(lay.r_tin1, lay.i_heat, -a_in * ph)
+    add(lay.r_twh1, lay.i_twh1, 1.0)
+    add(lay.r_twh1, lay.i_tin + 1, -awr)
+    add(lay.r_twh1, lay.i_wh, -a_wh * pwh)
+    # Battery SoC: pin + dynamics (dragg/mpc_calc.py:363-372).
+    add(lay.r_eb0, lay.i_eb, 1.0)
+    for k in range(H):
+        add(lay.r_ebd + k, lay.i_eb + k + 1, 1.0)
+        add(lay.r_ebd + k, lay.i_eb + k, -1.0)
+        add(lay.r_ebd + k, lay.i_pch + k, -che / dt)
+        add(lay.r_ebd + k, lay.i_pd + k, -1.0 / (dse * dt))
+    del ks
+
+    return HomeQPStatic(
+        rows=np.array(rows, dtype=np.int64),
+        cols=np.array(cols, dtype=np.int64),
+        vals=jnp.asarray(np.stack(vals, axis=1)),
+        whmix_pos=whmix_pos,
+        a_in=jnp.asarray(a_in),
+        a_wh=jnp.asarray(a_wh),
+        kin=jnp.asarray(kin),
+        kwh=jnp.asarray(kwh),
+        awr=jnp.asarray(awr),
+    )
+
+
+class QPStep(NamedTuple):
+    """Everything the ADMM solver needs for one timestep, batched over homes."""
+
+    A_eq: jnp.ndarray     # (n_homes, m_eq, n)
+    b_eq: jnp.ndarray     # (n_homes, m_eq)
+    l_box: jnp.ndarray    # (n_homes, n)
+    u_box: jnp.ndarray    # (n_homes, n)
+    q: jnp.ndarray        # (n_homes, n)
+    q_scale: jnp.ndarray  # (n_homes,) applied scaling of q (divide out for true cost)
+
+
+def assemble_qp_step(
+    static: HomeQPStatic,
+    lay: QPLayout,
+    batch,
+    *,
+    oat_window,        # (H+1,) environment slice — oat_window[k] = OAT at t+k
+    ghi_window,        # (H+1,) GHI slice — ghi_window[k] = GHI at t+k
+    price_total,       # (n_homes, H) discounting NOT applied; rp + tou
+    draw_frac,         # (n_homes, H+1) draw fractions for this step (index 0 = current)
+    temp_in_init,      # (n_homes,)
+    temp_wh_init,      # (n_homes,) AFTER draw mixing
+    e_batt_init,       # (n_homes,)
+    cool_cap,          # (n_homes,) seasonal duty cap (0 or s)
+    heat_cap,          # (n_homes,)
+    wh_cap: float,     # s
+    discount,          # scalar
+) -> QPStep:
+    """Fill the per-timestep QP: A_eq values (water-mix band), RHS, box
+    bounds (seasonal HVAC gating, dragg/mpc_calc.py:298-309), and the linear
+    objective q (discounted price on grid power, dragg/mpc_calc.py:441-446).
+    """
+    H = lay.H
+    n_homes = static.vals.shape[0]
+    dtype = jnp.float32
+
+    rem = 1.0 - draw_frac  # remainder_frac (dragg/mpc_calc.py:202-204)
+    whmix_vals = -(rem[:, 1:] * static.kwh[:, None])  # (n_homes, H)
+    vals = static.vals.at[:, static.whmix_pos].set(whmix_vals)
+
+    A_eq = jnp.zeros((n_homes, lay.m_eq, lay.n), dtype=dtype)
+    A_eq = A_eq.at[:, static.rows, static.cols].add(vals.astype(dtype))
+
+    oat = jnp.asarray(oat_window)
+    b = jnp.zeros((n_homes, lay.m_eq), dtype=dtype)
+    b = b.at[:, lay.r_tin0].set(temp_in_init)
+    b = b.at[:, lay.r_tind : lay.r_tind + H].set(
+        (static.a_in[:, None] / jnp.asarray(batch.hvac_r)[:, None]) * oat[None, 1 : H + 1]
+    )
+    b = b.at[:, lay.r_twh0].set(temp_wh_init)
+    b = b.at[:, lay.r_twhd : lay.r_twhd + H].set(draw_frac[:, 1:] * TAP_TEMP * static.kwh[:, None])
+    b = b.at[:, lay.r_tin1].set(
+        temp_in_init * static.kin + static.a_in / jnp.asarray(batch.hvac_r) * oat[1]
+    )
+    b = b.at[:, lay.r_twh1].set(temp_wh_init * static.kwh)
+    b = b.at[:, lay.r_eb0].set(e_batt_init)
+    # battery dynamics rows rhs = 0 already
+
+    inf = jnp.full((n_homes,), BIG, dtype=dtype)
+    zeros = jnp.zeros((n_homes,), dtype=dtype)
+    l = jnp.zeros((n_homes, lay.n), dtype=dtype)
+    u = jnp.zeros((n_homes, lay.n), dtype=dtype)
+    rate = jnp.asarray(batch.batt_max_rate) * jnp.asarray(batch.has_batt)
+
+    def seg(lo, hi, i0, length):
+        nonlocal l, u
+        l = l.at[:, i0 : i0 + length].set(jnp.broadcast_to(lo[:, None], (n_homes, length)))
+        u = u.at[:, i0 : i0 + length].set(jnp.broadcast_to(hi[:, None], (n_homes, length)))
+
+    seg(zeros, cool_cap, lay.i_cool, H)
+    seg(zeros, heat_cap, lay.i_heat, H)
+    seg(zeros, jnp.full((n_homes,), wh_cap, dtype=dtype), lay.i_wh, H)
+    seg(zeros, rate, lay.i_pch, H)
+    seg(-rate, zeros, lay.i_pd, H)
+    seg(zeros, jnp.ones((n_homes,), dtype=dtype), lay.i_curt, H)
+    # T_in_ev[0] is pinned by equality; bounds apply to [1:] only
+    # (dragg/mpc_calc.py:318-319).
+    seg(-inf, inf, lay.i_tin, 1)
+    seg(jnp.asarray(batch.temp_in_min).astype(dtype), jnp.asarray(batch.temp_in_max).astype(dtype), lay.i_tin + 1, H)
+    # T_wh_ev bounds apply to ALL H+1 entries including the pinned index 0
+    # (dragg/mpc_calc.py:333-334) — an out-of-band initial WH temp makes the
+    # problem infeasible, which routes the home to the fallback controller
+    # exactly as in the reference.
+    seg(jnp.asarray(batch.temp_wh_min).astype(dtype), jnp.asarray(batch.temp_wh_max).astype(dtype), lay.i_twh, H + 1)
+    seg(-inf, inf, lay.i_eb, 1)
+    cap_min = jnp.asarray(batch.batt_cap_min).astype(dtype)
+    cap_max = jnp.asarray(batch.batt_cap_max).astype(dtype)
+    seg(cap_min, cap_max, lay.i_eb + 1, H)
+    seg(jnp.asarray(batch.temp_in_min).astype(dtype), jnp.asarray(batch.temp_in_max).astype(dtype), lay.i_tin1, 1)
+    seg(jnp.asarray(batch.temp_wh_min).astype(dtype), jnp.asarray(batch.temp_wh_max).astype(dtype), lay.i_twh1, 1)
+
+    # Objective: sum_k w[k] * price[k] * p_grid[k], p_grid affine in controls
+    # (dragg/mpc_calc.py:342,387-432,441-446).  s cancels: p_load contributes
+    # s * (P/s) * duty per control unit.
+    s = float(wh_cap)
+    w = jnp.power(jnp.asarray(discount, dtype=dtype), jnp.arange(H, dtype=dtype))
+    wp = (w[None, :] * price_total.astype(dtype))  # (n_homes, H)
+    q = jnp.zeros((n_homes, lay.n), dtype=dtype)
+    q = q.at[:, lay.i_cool : lay.i_cool + H].set(wp * (s * jnp.asarray(batch.hvac_p_c)[:, None]).astype(dtype))
+    q = q.at[:, lay.i_heat : lay.i_heat + H].set(wp * (s * jnp.asarray(batch.hvac_p_h)[:, None]).astype(dtype))
+    q = q.at[:, lay.i_wh : lay.i_wh + H].set(wp * (s * jnp.asarray(batch.wh_p)[:, None]).astype(dtype))
+    q = q.at[:, lay.i_pch : lay.i_pch + H].set(wp * s)
+    q = q.at[:, lay.i_pd : lay.i_pd + H].set(wp * s)
+    # PV: p_grid -= s * pvc[k] * (1 - u_curt[k]); the constant term is
+    # dropped from q (it shifts the objective, not the argmin) and the
+    # u_curt coefficient is +w*price*s*pvc (dragg/mpc_calc.py:380-385,410-432).
+    ghi = jnp.asarray(ghi_window).astype(dtype)
+    pvc = (
+        jnp.asarray(batch.pv_area)[:, None]
+        * jnp.asarray(batch.pv_eff)[:, None]
+        * jnp.asarray(batch.has_pv)[:, None]
+        * ghi[None, :H]
+        / 1000.0
+    ).astype(dtype)
+    q = q.at[:, lay.i_curt : lay.i_curt + H].set(wp * s * pvc)
+    q_scale = jnp.maximum(jnp.max(jnp.abs(q), axis=1), 1e-8)
+    return QPStep(A_eq=A_eq, b_eq=b, l_box=l, u_box=u, q=q, q_scale=q_scale)
+
+
+class MPCSolution(NamedTuple):
+    """Recovered per-home horizon series (raw duty units, kW, degC, kWh)."""
+
+    cool: jnp.ndarray      # (n_homes, H) raw duty [0, s]
+    heat: jnp.ndarray
+    wh: jnp.ndarray
+    p_ch: jnp.ndarray
+    p_disch: jnp.ndarray
+    u_curt: jnp.ndarray
+    p_pv: jnp.ndarray      # (n_homes, H)
+    p_load: jnp.ndarray    # (n_homes, H) total community-units load (pre /s)
+    p_grid: jnp.ndarray    # (n_homes, H)
+    cost: jnp.ndarray      # (n_homes, H) price * p_grid (undiscounted, parity
+                           # with dragg/mpc_calc.py:444)
+    temp_in_ev: jnp.ndarray  # (n_homes, H+1)
+    temp_wh_ev: jnp.ndarray
+    e_batt: jnp.ndarray      # (n_homes, H+1)
+    temp_in1: jnp.ndarray    # (n_homes,) one-step deterministic indoor temp
+    temp_wh1: jnp.ndarray
+
+
+def recover_solution(x, lay: QPLayout, batch, ghi_window, price_total, s: float) -> MPCSolution:
+    """Extract physical series from the stacked variable vector and rebuild
+    the eliminated p_load / p_pv / p_grid / cost
+    (dragg/mpc_calc.py:342,380-432,444)."""
+    H = lay.H
+    cool = x[:, lay.i_cool : lay.i_cool + H]
+    heat = x[:, lay.i_heat : lay.i_heat + H]
+    wh = x[:, lay.i_wh : lay.i_wh + H]
+    p_ch = x[:, lay.i_pch : lay.i_pch + H]
+    p_disch = x[:, lay.i_pd : lay.i_pd + H]
+    u_curt = x[:, lay.i_curt : lay.i_curt + H]
+    ghi = jnp.asarray(ghi_window)[None, :H]
+    pvc = (
+        jnp.asarray(batch.pv_area)[:, None]
+        * jnp.asarray(batch.pv_eff)[:, None]
+        * jnp.asarray(batch.has_pv)[:, None]
+        * ghi
+        / 1000.0
+    )
+    p_pv = pvc * (1.0 - u_curt)
+    p_load = s * (
+        jnp.asarray(batch.hvac_p_c)[:, None] * cool
+        + jnp.asarray(batch.hvac_p_h)[:, None] * heat
+        + jnp.asarray(batch.wh_p)[:, None] * wh
+    )
+    p_grid = p_load + s * (p_ch + p_disch) - s * p_pv
+    cost = price_total * p_grid
+    return MPCSolution(
+        cool=cool, heat=heat, wh=wh, p_ch=p_ch, p_disch=p_disch, u_curt=u_curt,
+        p_pv=p_pv, p_load=p_load, p_grid=p_grid, cost=cost,
+        temp_in_ev=x[:, lay.i_tin : lay.i_tin + H + 1],
+        temp_wh_ev=x[:, lay.i_twh : lay.i_twh + H + 1],
+        e_batt=x[:, lay.i_eb : lay.i_eb + H + 1],
+        temp_in1=x[:, lay.i_tin1],
+        temp_wh1=x[:, lay.i_twh1],
+    )

@@ -1,0 +1,39 @@
+"""Test configuration.
+
+Multi-chip behavior is tested on a virtual 8-device CPU mesh
+(``xla_force_host_platform_device_count``), the pattern SURVEY.md §4(f)
+prescribes; single-chip numerics run on the same CPU backend so CI needs no
+TPU.  Must set env vars before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from dragg_tpu.config import default_config  # noqa: E402
+
+
+@pytest.fixture
+def tiny_config():
+    """A small, fast community config: 6 homes (1 of each special type),
+    4h horizon, 24h sim."""
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = 6
+    cfg["community"]["homes_pv"] = 1
+    cfg["community"]["homes_battery"] = 1
+    cfg["community"]["homes_pv_battery"] = 1
+    cfg["simulation"]["start_datetime"] = "2015-01-01 00"
+    cfg["simulation"]["end_datetime"] = "2015-01-02 00"
+    cfg["home"]["hems"]["prediction_horizon"] = 4
+    return cfg
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

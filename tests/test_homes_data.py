@@ -1,0 +1,101 @@
+"""Home-synthesis determinism + data-ingestion tests (SURVEY.md §4(c))."""
+
+import numpy as np
+import pytest
+
+from dragg_tpu.config import ConfigError, default_config, validate_config
+from dragg_tpu.data import build_tou, load_environment, parse_dt, synth_waterdraw_profiles, synth_weather
+from dragg_tpu.homes import HOME_TYPES, build_home_batch, check_home_configs, create_homes
+
+
+def _make_homes(cfg, num_timesteps=24, dt=1, seed=None):
+    if seed is not None:
+        cfg["simulation"]["random_seed"] = seed
+    wd = synth_waterdraw_profiles(seed=7)
+    return create_homes(cfg, num_timesteps, dt, wd)
+
+
+class TestConfig:
+    def test_default_validates(self):
+        validate_config(default_config())
+
+    def test_missing_key_raises(self):
+        cfg = default_config()
+        del cfg["home"]["hvac"]["r_dist"]
+        with pytest.raises(ConfigError):
+            validate_config(cfg)
+
+
+class TestHomes:
+    def test_counts_and_order(self, tiny_config):
+        homes = _make_homes(tiny_config)
+        assert len(homes) == 6
+        check_home_configs(homes, tiny_config)
+        # Creation order parity: pv_battery, pv_only, battery_only, base
+        # (dragg/aggregator.py:393-578).
+        assert [h["type"] for h in homes[:3]] == ["pv_battery", "pv_only", "battery_only"]
+        assert all(h["type"] == "base" for h in homes[3:])
+
+    def test_seed_determinism(self, tiny_config):
+        a = _make_homes(dict(tiny_config), seed=42)
+        b = _make_homes(dict(tiny_config), seed=42)
+        c = _make_homes(dict(tiny_config), seed=43)
+        assert a[0]["name"] == b[0]["name"]
+        for ha, hb in zip(a, b):
+            assert ha["hvac"]["r"] == hb["hvac"]["r"]
+            assert ha["wh"]["draw_sizes"] == hb["wh"]["draw_sizes"]
+        assert any(x["hvac"]["r"] != y["hvac"]["r"] for x, y in zip(a, c))
+
+    def test_parameter_ranges(self, tiny_config):
+        homes = _make_homes(tiny_config)
+        hv = tiny_config["home"]["hvac"]
+        for h in homes:
+            assert hv["r_dist"][0] <= h["hvac"]["r"] <= hv["r_dist"][1]
+            db = h["hvac"]["temp_in_max"] - h["hvac"]["temp_in_min"]
+            assert hv["temp_deadband_dist"][0] - 1e-9 <= db <= hv["temp_deadband_dist"][1] + 1e-9
+            assert h["hvac"]["temp_in_min"] <= h["hvac"]["temp_in_init"] <= h["hvac"]["temp_in_max"]
+            assert h["wh"]["temp_wh_min"] <= h["wh"]["temp_wh_init"] <= h["wh"]["temp_wh_max"]
+            # draws clipped to tank size (dragg/aggregator.py:376)
+            assert max(h["wh"]["draw_sizes"]) <= h["wh"]["tank_size"] + 1e-9
+
+    def test_batch_padding(self, tiny_config):
+        homes = _make_homes(tiny_config)
+        batch = build_home_batch(homes, horizon=4, dt=1, sub_steps=6)
+        assert batch.n_homes == 6
+        # base homes have zero-width battery/pv blocks
+        base = np.asarray(batch.type_code) == HOME_TYPES.index("base")
+        assert np.all(np.asarray(batch.batt_max_rate)[base] == 0)
+        assert np.all(np.asarray(batch.pv_area)[base] == 0)
+        # powers divided by sub_steps (dragg/mpc_calc.py:159-162)
+        assert np.allclose(np.asarray(batch.hvac_p_c), np.array([h["hvac"]["p_c"] for h in homes]) / 6)
+        # leading zero pad on draws: horizon//dt + 1 hours (dragg/mpc_calc.py:194)
+        assert np.all(np.asarray(batch.draws_hourly)[:, :5] == 0)
+
+
+class TestData:
+    def test_tou_reference_parity(self):
+        """Reference bug parity: peak price is overwritten by shoulder
+        (dragg/aggregator.py:214-215) — peak never appears unless fixed."""
+        start = parse_dt("2015-01-01 00")
+        tou = build_tou(48, start, 1, 0.07, True, (9, 21), 0.09, (14, 18), 0.13)
+        assert set(np.unique(tou)) == {0.07, 0.09}
+        assert tou[10] == 0.09 and tou[2] == 0.07 and tou[15] == 0.09
+        fixed = build_tou(48, start, 1, 0.07, True, (9, 21), 0.09, (14, 18), 0.13, fix_tou_peak=True)
+        assert fixed[15] == 0.13 and fixed[10] == 0.09
+
+    def test_synth_weather_shapes_and_determinism(self):
+        oat1, ghi1, _ = synth_weather(parse_dt("2015-01-01 00"), days=3, dt=1, seed=5)
+        oat2, ghi2, _ = synth_weather(parse_dt("2015-01-01 00"), days=3, dt=1, seed=5)
+        assert oat1.shape == (72,)
+        np.testing.assert_array_equal(oat1, oat2)
+        assert ghi1.min() >= 0
+        assert np.all(ghi1[:5] == 0)  # midnight: no sun
+
+    def test_load_environment_coverage(self, tiny_config):
+        env = load_environment(tiny_config)
+        start = parse_dt(tiny_config["simulation"]["start_datetime"])
+        end = parse_dt(tiny_config["simulation"]["end_datetime"])
+        env.check_coverage(start, end, tiny_config["home"]["hems"]["prediction_horizon"])
+        assert env.start_index(start) == 0
+        with pytest.raises(ValueError):
+            env.check_coverage(start, parse_dt("2099-01-01 00"), 4)

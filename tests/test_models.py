@@ -1,0 +1,139 @@
+"""Physics-core unit tests vs closed-form RC responses (SURVEY.md §4(a))."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from dragg_tpu.models import battery_step, expand_draws, fallback_control, hvac_step, pv_power, wh_mix, wh_step
+
+
+class TestThermal:
+    def test_hvac_free_response_decays_to_oat(self):
+        """With no HVAC, T relaxes exponentially toward OAT with time
+        constant R*C; one step must match the explicit-Euler closed form."""
+        R, C, dt = 8.0, 5000.0, 1
+        T0, oat = 20.0, 0.0
+        T1 = float(hvac_step(T0, oat, R, C, dt, 0.0, 0.0, 0.0, 0.0))
+        expected = T0 + 3600.0 * (oat - T0) / (R * C * dt)
+        assert abs(T1 - expected) < 1e-9
+        assert T1 < T0  # cooling toward oat
+
+    def test_hvac_heat_and_cool_signs(self):
+        R, C, dt, P = 8.0, 5000.0, 1, 3.5
+        base = float(hvac_step(20.0, 20.0, R, C, dt, 0.0, 0.0, P, P))
+        heat = float(hvac_step(20.0, 20.0, R, C, dt, 0.0, 1.0, P, P))
+        cool = float(hvac_step(20.0, 20.0, R, C, dt, 1.0, 0.0, P, P))
+        assert heat > base > cool
+        assert abs((heat - base) - 3600.0 * P / (C * dt)) < 1e-9
+
+    def test_wh_mix_conserves_energy(self):
+        """Mixing: (T*(size-draw) + tap*draw)/size — a full-tank draw gives
+        tap temp, zero draw leaves T unchanged."""
+        assert abs(float(wh_mix(50.0, 0.0, 200.0)) - 50.0) < 1e-12
+        assert abs(float(wh_mix(50.0, 200.0, 200.0)) - 15.0) < 1e-12
+        half = float(wh_mix(50.0, 100.0, 200.0))
+        assert abs(half - 32.5) < 1e-12
+
+    def test_wh_step_equilibrium(self):
+        """At T == Tin with heater off, temperature is unchanged."""
+        assert abs(float(wh_step(20.0, 20.0, 20000.0, 840.0, 1, 0.0, 0.0)) - 20.0) < 1e-12
+
+    def test_batched_shapes(self):
+        n = 7
+        T = jnp.linspace(18, 22, n)
+        out = hvac_step(T, 10.0, jnp.full(n, 8.0), jnp.full(n, 5000.0), 1, jnp.zeros(n), jnp.ones(n), 0.5, 0.5)
+        assert out.shape == (n,)
+
+
+class TestBattery:
+    def test_charge_discharge_efficiency(self):
+        e = float(battery_step(5.0, 1.0, 0.0, 0.9, 0.98, 1))
+        assert abs(e - 5.9) < 1e-12
+        e = float(battery_step(5.0, 0.0, -1.0, 0.9, 0.98, 1))
+        assert abs(e - (5.0 - 1.0 / 0.98)) < 1e-9
+
+
+class TestPV:
+    def test_pv_power(self):
+        p = float(pv_power(1000.0, 25.0, 0.18, 0.0))
+        assert abs(p - 4.5) < 1e-12
+        assert float(pv_power(1000.0, 25.0, 0.18, 1.0)) == 0.0
+
+
+class TestExpandDraws:
+    def test_matches_reference_listcode_dt1(self):
+        """Cross-check against a direct transcription of the reference's
+        water_draws list arithmetic (dragg/mpc_calc.py:193-201)."""
+        H, dt = 6, 1
+        window = np.array([3.0, 0.0, 10.0, 2.0, 5.0, 1.0, 4.0])  # H//dt + 1 = 7
+        raw = (np.repeat(window, dt) / dt).tolist()
+        expect = raw[:dt]
+        for i in range(dt, H + 1):
+            expect.append(np.average(raw[i - 1 : i + 2]))
+        got = np.asarray(expand_draws(jnp.asarray(window), dt, H))
+        np.testing.assert_allclose(got, np.array(expect), rtol=1e-6)
+
+    def test_matches_reference_listcode_dt2(self):
+        H, dt = 8, 2
+        window = np.array([3.0, 0.0, 10.0, 2.0, 5.0])  # H//dt + 1 = 5
+        raw = (np.repeat(window, dt) / dt).tolist()
+        expect = raw[:dt]
+        for i in range(dt, H + 1):
+            expect.append(np.average(raw[i - 1 : i + 2]))
+        got = np.asarray(expand_draws(jnp.asarray(window), dt, H))
+        np.testing.assert_allclose(got, np.array(expect), rtol=1e-6)
+
+    def test_batched(self):
+        w = jnp.asarray(np.random.RandomState(0).rand(4, 7))
+        out = expand_draws(w, 1, 6)
+        assert out.shape == (4, 7)
+
+
+class TestFallback:
+    def _params(self, n):
+        return dict(
+            hvac_r=jnp.full(n, 8.0), hvac_c=jnp.full(n, 5000.0),
+            hvac_p_c=jnp.full(n, 0.58), hvac_p_h=jnp.full(n, 0.58),
+            wh_r=jnp.full(n, 20000.0), wh_c=jnp.full(n, 840.0), wh_p=jnp.full(n, 0.42),
+            temp_in_min=jnp.full(n, 19.0), temp_in_max=jnp.full(n, 21.0),
+            temp_wh_min=jnp.full(n, 43.0), temp_wh_max=jnp.full(n, 50.0),
+            cool_max=jnp.full(n, 0.0), heat_max=jnp.full(n, 6.0), wh_max=jnp.full(n, 6.0),
+            dt=1,
+        )
+
+    def test_bang_bang_heats_when_cold(self):
+        n = 1
+        res = fallback_control(
+            jnp.array([10]), 5, 8,
+            jnp.zeros(n), jnp.zeros(n), jnp.zeros(n),
+            jnp.array([18.0]),           # below temp_in_min -> heat on
+            jnp.array([40.0]),           # below temp_wh_min -> wh on
+            0.0, **self._params(n),
+        )
+        assert float(res.heat_on[0]) == 6.0
+        assert float(res.cool_on[0]) == 0.0
+        assert float(res.wh_on[0]) == 6.0
+        assert float(res.temp_in[0]) > 18.0
+        assert int(res.counter[0]) >= 8
+
+    def test_in_band_idles(self):
+        n = 1
+        res = fallback_control(
+            jnp.array([10]), 5, 8,
+            jnp.zeros(n), jnp.zeros(n), jnp.zeros(n),
+            jnp.array([20.0]), jnp.array([45.0]), 15.0, **self._params(n),
+        )
+        assert float(res.heat_on[0]) == 0.0
+        assert float(res.wh_on[0]) == 0.0
+
+    def test_replay_path_uses_previous_plan(self):
+        """counter < horizon and t > 0 -> replay the shifted plan value."""
+        n = 1
+        res = fallback_control(
+            jnp.array([2]), 5, 8,
+            jnp.array([0.0]), jnp.array([3.0]), jnp.array([2.0]),  # replayed duties
+            jnp.array([20.0]), jnp.array([45.0]), 15.0, **self._params(n),
+        )
+        # In-band temps: the replayed duties survive unmodified.
+        assert float(res.heat_on[0]) == 3.0
+        assert float(res.wh_on[0]) == 2.0
+        assert int(res.counter[0]) == 2
