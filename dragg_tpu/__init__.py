@@ -22,7 +22,9 @@ from dragg_tpu.config import load_config, default_config  # noqa: F401
 def __getattr__(name):
     # Lazy import: keeps `import dragg_tpu` light and avoids import cycles.
     if name == "Aggregator":
-        from dragg_tpu.aggregator import Aggregator
-
+        try:
+            from dragg_tpu.aggregator import Aggregator
+        except ImportError as e:  # PEP 562: unresolvable names must raise AttributeError
+            raise AttributeError(f"module 'dragg_tpu' has no attribute {name!r}") from e
         return Aggregator
     raise AttributeError(f"module 'dragg_tpu' has no attribute {name!r}")

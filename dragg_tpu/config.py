@@ -43,6 +43,8 @@ def _validate(data: dict, required: dict, path: str = "") -> None:
     for key, sub in required.items():
         if key not in data:
             raise ConfigError(f"Missing required config key: {path}{key}")
+        if not isinstance(data[key], dict):
+            raise ConfigError(f"Config section {path}{key} must be a table, got {type(data[key]).__name__}")
         if isinstance(sub, dict):
             _validate(data[key], sub, path=f"{path}{key}.")
         elif isinstance(sub, set):

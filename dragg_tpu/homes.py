@@ -251,8 +251,8 @@ def build_home_batch(all_homes: list[dict], horizon: int, dt: int, sub_steps: in
     s = float(max(1, sub_steps))
     pad = horizon // dt + 1
 
-    def g(fn, default=0.0):
-        return np.array([fn(h) if fn(h) is not None else default for h in all_homes], dtype=np.float64)
+    def g(fn):
+        return np.array([fn(h) for h in all_homes], dtype=np.float64)
 
     type_code = np.array([TYPE_CODES[h["type"]] for h in all_homes], dtype=np.int32)
     has_pv = np.array(["pv" in h["type"] for h in all_homes], dtype=np.float64)

@@ -208,9 +208,7 @@ def admm_solve(
             rho_new = jnp.clip(rho_b * ratio, RHO_MIN, RHO_MAX)
             update = (ratio > 5.0) | (ratio < 0.2)
             rho_next = jnp.where(update & ~ok, rho_new, rho_b)
-            L = jnp.where(
-                jnp.any(rho_next != rho_b), factor(rho_next), L
-            )
+            L = lax.cond(jnp.any(rho_next != rho_b), factor, lambda _: L, rho_next)
             rho_b = rho_next
         return state, rho_b, L, it + check_every, jnp.all(ok)
 

@@ -173,8 +173,7 @@ class QPStep(NamedTuple):
     b_eq: jnp.ndarray     # (n_homes, m_eq)
     l_box: jnp.ndarray    # (n_homes, n)
     u_box: jnp.ndarray    # (n_homes, n)
-    q: jnp.ndarray        # (n_homes, n)
-    q_scale: jnp.ndarray  # (n_homes,) applied scaling of q (divide out for true cost)
+    q: jnp.ndarray        # (n_homes, n) unscaled (admm_solve does its own cost scaling)
 
 
 def assemble_qp_step(
@@ -281,8 +280,7 @@ def assemble_qp_step(
         / 1000.0
     ).astype(dtype)
     q = q.at[:, lay.i_curt : lay.i_curt + H].set(wp * s * pvc)
-    q_scale = jnp.maximum(jnp.max(jnp.abs(q), axis=1), 1e-8)
-    return QPStep(A_eq=A_eq, b_eq=b, l_box=l, u_box=u, q=q, q_scale=q_scale)
+    return QPStep(A_eq=A_eq, b_eq=b, l_box=l, u_box=u, q=q)
 
 
 class MPCSolution(NamedTuple):
