@@ -1,0 +1,375 @@
+"""Community aggregator — host-side orchestration around the device engine.
+
+Capability parity with the reference ``Aggregator`` (dragg/aggregator.py:29-970):
+config + weather + price ingestion, seeded home synthesis (with the
+``all_homes-<N>-config.json`` cache), the simulation loop, per-home data
+collection, the RL utility setpoint, and results.json checkpoints in the
+reference's directory layout — so the reference's ``Reformat`` post-processing
+consumes our outputs unchanged.
+
+Architectural inversion (SURVEY.md §7): the reference's hot loop fans one
+process per home out over a pathos pool and moves every datum through Redis
+(dragg/aggregator.py:711-755); here the community is a batched tensor program
+(:mod:`dragg_tpu.engine`) and the host loop only touches the device at
+checkpoint boundaries — one ``lax.scan`` chunk per checkpoint interval.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from datetime import timedelta
+
+import numpy as np
+
+from dragg_tpu.config import load_config
+from dragg_tpu.data import EnvironmentData, load_environment, load_waterdraw_profiles, parse_dt
+from dragg_tpu.engine import Engine, StepOutputs, make_engine
+from dragg_tpu.homes import build_home_batch, check_home_configs, create_homes
+from dragg_tpu.logger import Logger
+
+# Per-home series appended each timestep, in the reference's result-hash
+# vocabulary (dragg/aggregator.py:741-745) → StepOutputs field name.
+_BASE_KEYS = {
+    "p_grid_opt": "p_grid",
+    "forecast_p_grid_opt": "forecast_p_grid",
+    "p_load_opt": "p_load",
+    "temp_in_opt": "temp_in",
+    "temp_wh_opt": "temp_wh",
+    "hvac_cool_on_opt": "hvac_cool_on",
+    "hvac_heat_on_opt": "hvac_heat_on",
+    "wh_heat_on_opt": "wh_heat_on",
+    "cost_opt": "cost",
+    "waterdraws": "waterdraws",
+    "correct_solve": "correct_solve",
+}
+_PV_KEYS = {"p_pv_opt": "p_pv", "u_pv_curt_opt": "u_pv_curt"}
+_BATT_KEYS = {"e_batt_opt": "e_batt", "p_batt_ch": "p_batt_ch", "p_batt_disch": "p_batt_disch"}
+
+
+class Aggregator:
+    """Drop-in analog of the reference Aggregator (dragg/aggregator.py:29).
+
+    Parameters
+    ----------
+    config : dict | str | None
+        A validated config dict, a path to a TOML file, or None to resolve
+        via ``$DATA_DIR/$CONFIG_FILE`` with synthetic-data fallback.
+    data_dir : str | None
+        Where to look for nsrdb.csv / waterdraw profiles; defaults to
+        ``$DATA_DIR`` (reference: dragg/aggregator.py:31-37).
+    outputs_dir : str
+        Root of the run-directory tree (reference: dragg/aggregator.py:32).
+    """
+
+    def __init__(self, config=None, data_dir=None, outputs_dir="outputs"):
+        self.log = Logger("aggregator")
+        self.data_dir = data_dir if data_dir is not None else os.path.expanduser(
+            os.environ.get("DATA_DIR", "data")
+        )
+        self.outputs_dir = outputs_dir
+        os.makedirs(self.outputs_dir, exist_ok=True)
+
+        if isinstance(config, dict):
+            self.config = config
+        else:
+            self.config = load_config(config)
+        self.check_type = self.config["simulation"]["check_type"]
+        self.case = "baseline"
+
+        # Simulation window (dragg/aggregator.py:111-127).
+        self.start_dt = parse_dt(self.config["simulation"]["start_datetime"])
+        self.end_dt = parse_dt(self.config["simulation"]["end_datetime"])
+        self.hours = int((self.end_dt - self.start_dt).total_seconds() / 3600)
+        self.dt = int(self.config["agg"]["subhourly_steps"])
+        self.dt_interval = 60 // self.dt
+        self.num_timesteps = int(np.ceil(self.hours * self.dt))
+
+        # Environment series (weather + TOU price).
+        self.env: EnvironmentData = load_environment(self.config, data_dir=self.data_dir)
+        horizon_hours = int(self.config["home"]["hems"]["prediction_horizon"])
+        self.env.check_coverage(self.start_dt, self.end_dt, horizon_hours)
+        self.start_index = self.env.start_index(self.start_dt)
+
+        self.all_homes: list[dict] | None = None
+        self.engine: Engine | None = None
+        self._state = None
+        self.timestep = 0
+        self.collected_data: dict = {}
+        self.baseline_agg_load_list: list[float] = []
+        self.all_rps = np.zeros(self.num_timesteps)
+        self.all_sps = np.zeros(self.num_timesteps)
+        self.agg_load = 0.0
+        self.agg_cost = 0.0
+        self.forecast_load = 0.0
+        self.reward_price = np.zeros(
+            int(self.config["agg"].get("rl", {}).get("action_horizon", 1)) * self.dt
+        )
+        self.start_time = None
+        self.end_time = None
+        self.version = self.config["simulation"].get("named_version", "test")
+        self.run_dir = None
+        self._solve_iters: list[int] = []
+
+    # ----------------------------------------------------------- population
+    def get_homes(self) -> None:
+        """Create or reload the home population (dragg/aggregator.py:263-271):
+        reuse ``all_homes-<N>-config.json`` unless overwrite_existing."""
+        n = self.config["community"]["total_number_homes"]
+        homes_file = os.path.join(self.outputs_dir, f"all_homes-{n}-config.json")
+        if not self.config["community"].get("overwrite_existing", True) and os.path.isfile(homes_file):
+            with open(homes_file) as f:
+                self.all_homes = json.load(f)
+        else:
+            waterdraw = load_waterdraw_profiles(
+                self._waterdraw_path(), seed=int(self.config["simulation"]["random_seed"])
+            )
+            self.all_homes = create_homes(self.config, self.num_timesteps, self.dt, waterdraw)
+        check_home_configs(self.all_homes, self.config)
+        self.write_home_configs()
+
+    def _waterdraw_path(self) -> str | None:
+        if self.data_dir is None:
+            return None
+        fname = self.config["home"]["wh"].get("waterdraw_file", "waterdraw_profiles.csv")
+        return os.path.join(self.data_dir, fname)
+
+    def write_home_configs(self) -> None:
+        """Persist the population (dragg/aggregator.py:846-854)."""
+        n = self.config["community"]["total_number_homes"]
+        path = os.path.join(self.outputs_dir, f"all_homes-{n}-config.json")
+        with open(path, "w") as f:
+            json.dump(self.all_homes, f, indent=4)
+
+    def _build_engine(self) -> None:
+        hems = self.config["home"]["hems"]
+        horizon = max(1, int(hems["prediction_horizon"]) * self.dt)
+        batch = build_home_batch(
+            self.all_homes, horizon, self.dt, int(hems["sub_subhourly_steps"])
+        )
+        self.batch = batch
+        self.engine = make_engine(batch, self.env, self.config, self.start_index)
+
+    # ------------------------------------------------------------- data mgmt
+    def reset_collected_data(self) -> None:
+        """Initialize the per-home series dict (dragg/aggregator.py:589-615)."""
+        self.timestep = 0
+        self.baseline_agg_load_list = []
+        self.collected_data = {}
+        self._solve_iters = []
+        for home in self.all_homes:
+            d = {
+                "type": home["type"],
+                "temp_in_sp": home["hvac"]["temp_in_sp"],
+                "temp_wh_sp": home["wh"]["temp_wh_sp"],
+                "temp_in_opt": [home["hvac"]["temp_in_init"]],
+                "temp_wh_opt": [home["wh"]["temp_wh_init"]],
+                "p_grid_opt": [],
+                "forecast_p_grid_opt": [],
+                "p_load_opt": [],
+                "hvac_cool_on_opt": [],
+                "hvac_heat_on_opt": [],
+                "wh_heat_on_opt": [],
+                "cost_opt": [],
+                "waterdraws": [],
+                "correct_solve": [],
+            }
+            if "pv" in home["type"]:
+                d["p_pv_opt"] = []
+                d["u_pv_curt_opt"] = []
+            if "battery" in home["type"]:
+                d["e_batt_opt"] = [home["battery"]["e_batt_init"]]
+                d["p_batt_ch"] = []
+                d["p_batt_disch"] = []
+            self.collected_data[home["name"]] = d
+
+    def _collect_chunk(self, outs: StepOutputs) -> None:
+        """Append a chunk of stacked step outputs to collected_data — the
+        analog of per-step ``collect_data`` Redis reads
+        (dragg/aggregator.py:728-755), amortized over the whole chunk."""
+        host = {f: np.asarray(getattr(outs, f)) for f in StepOutputs._fields}
+        n_steps = host["p_grid"].shape[0]
+        for i, home in enumerate(self.all_homes):
+            if not (self.check_type == "all" or home["type"] == self.check_type):
+                continue
+            d = self.collected_data[home["name"]]
+            for out_key, field in _BASE_KEYS.items():
+                d[out_key].extend(float(v) for v in host[field][:, i])
+            if "pv" in home["type"]:
+                for out_key, field in _PV_KEYS.items():
+                    d[out_key].extend(float(v) for v in host[field][:, i])
+            if "battery" in home["type"]:
+                for out_key, field in _BATT_KEYS.items():
+                    d[out_key].extend(float(v) for v in host[field][:, i])
+        agg_loads = host["agg_load"]
+        self.baseline_agg_load_list.extend(float(v) for v in agg_loads)
+        self._solve_iters.extend(int(v) for v in host["admm_iters"])
+        # Per-step setpoint tracking.  Ordering parity: the reference
+        # increments the timestep in run_iteration BEFORE collect_data calls
+        # gen_setpoint (dragg/aggregator.py:726,755), and the setpoint
+        # computed after collecting step t is recorded at step t+1 by the
+        # next redis_set_current_values (dragg/aggregator.py:671-673).
+        for k in range(n_steps):
+            self.agg_load = float(agg_loads[k])
+            self.forecast_load = float(host["forecast_load"][k])
+            self.agg_cost = float(host["agg_cost"][k])
+            self.timestep += 1
+            self.agg_setpoint = self.gen_setpoint()
+            if self.timestep < self.num_timesteps:
+                self.all_sps[self.timestep] = self.agg_setpoint
+
+    # ----------------------------------------------------------- RL setpoint
+    def gen_setpoint(self) -> float:
+        """RL utility setpoint: trailing average of community load
+        (dragg/aggregator.py:677-696)."""
+        prev_n = int(self.config["agg"].get("rl", {}).get("prev_timesteps", 12))
+        if self.timestep < 2:
+            max_poss = self._max_possible_load()
+            self.tracked_loads = [0.5 * max_poss] * prev_n
+            self.max_load = -float("inf")
+            self.min_load = float("inf")
+        else:
+            self.tracked_loads[:-1] = self.tracked_loads[1:]
+            self.tracked_loads[-1] = self.agg_load
+        self.avg_load = float(np.average(self.tracked_loads))
+        if self.agg_load > self.max_load or self.timestep % 24 == 0:
+            self.max_load = self.agg_load
+        if self.agg_load < self.min_load or self.timestep % 24 == 0:
+            self.min_load = self.agg_load
+        return self.avg_load
+
+    def _max_possible_load(self) -> float:
+        """Sum of each home's max simultaneous load (dragg/mpc_calc.py:191)."""
+        total = 0.0
+        for h in self.all_homes:
+            total += max(float(h["hvac"]["p_c"]), float(h["hvac"]["p_h"])) + float(h["wh"]["p"])
+        return total
+
+    # ------------------------------------------------------------------ runs
+    def run_baseline(self) -> None:
+        """The baseline community simulation (dragg/aggregator.py:757-778):
+        chunked device scans with checkpoint writes between chunks."""
+        horizon_h = self.config["home"]["hems"]["prediction_horizon"]
+        self.log.logger.info(f"Performing baseline run for horizon: {horizon_h}")
+        self.start_time = time.time()
+        state = self.engine.init_state()
+        H = self.engine.params.horizon
+        t = 0
+        while t < self.num_timesteps:
+            n_steps = min(self.checkpoint_interval, self.num_timesteps - t)
+            rps = np.zeros((n_steps, H), dtype=np.float32)
+            state, outs = self.engine.run_chunk(state, t, rps)
+            self._collect_chunk(outs)
+            t += n_steps
+            if t < self.num_timesteps:
+                self.log.logger.info("Creating a checkpoint file.")
+                self.write_outputs()
+        self._state = state
+
+    def check_baseline_vals(self) -> None:
+        """Result-shape check over the check_type-selected homes
+        (dragg/aggregator.py:698-709)."""
+        selected = {
+            h["name"] for h in self.all_homes
+            if self.check_type == "all" or h["type"] == self.check_type
+        }
+        for home, vals in self.collected_data.items():
+            if home == "Summary" or home not in selected:
+                continue
+            for k, v2 in vals.items():
+                if not isinstance(v2, list):
+                    continue
+                want = self.num_timesteps + 1 if k in ("temp_in_opt", "temp_wh_opt", "e_batt_opt") else self.num_timesteps
+                if len(v2) != want:
+                    self.log.logger.error(f"Incorrect number of hours. {home}: {k} {len(v2)}")
+
+    # --------------------------------------------------------------- outputs
+    def set_run_dir(self) -> None:
+        """Reference directory layout (dragg/aggregator.py:818-829):
+        outputs/<start>_<end>/<type>-homes_<N>-horizon_<H>-interval_<X>-<Y>-solver_<S>/version-<V>."""
+        cfg = self.config
+        date_output = os.path.join(
+            self.outputs_dir,
+            f"{self.start_dt.strftime('%Y-%m-%dT%H')}_{self.end_dt.strftime('%Y-%m-%dT%H')}",
+        )
+        sub = int(cfg["home"]["hems"]["sub_subhourly_steps"])
+        solver = cfg["home"]["hems"].get("solver", "admm")
+        mpc_output = os.path.join(
+            date_output,
+            f"{self.check_type}-homes_{cfg['community']['total_number_homes']}"
+            f"-horizon_{cfg['home']['hems']['prediction_horizon']}"
+            f"-interval_{self.dt_interval}-{self.dt_interval // sub}-solver_{solver}",
+        )
+        self.run_dir = os.path.join(mpc_output, f"version-{self.version}")
+        os.makedirs(self.run_dir, exist_ok=True)
+
+    def summarize_baseline(self) -> None:
+        """Build the Summary block (dragg/aggregator.py:783-816)."""
+        self.end_time = time.time()
+        t_diff = self.end_time - self.start_time
+        cfg = self.config
+        sim_slice = slice(self.start_index, self.start_index + self.num_timesteps)
+        self.max_agg_load = max(self.baseline_agg_load_list) if self.baseline_agg_load_list else 0.0
+        self.collected_data["Summary"] = {
+            "case": self.case,
+            "start_datetime": self.start_dt.strftime("%Y-%m-%d %H"),
+            "end_datetime": self.end_dt.strftime("%Y-%m-%d %H"),
+            "solve_time": t_diff,
+            "horizon": cfg["home"]["hems"]["prediction_horizon"],
+            "num_homes": cfg["community"]["total_number_homes"],
+            "p_max_aggregate": self.max_agg_load,
+            "p_grid_aggregate": list(self.baseline_agg_load_list),
+            "OAT": self.env.oat[sim_slice].tolist(),
+            "GHI": self.env.ghi[sim_slice].tolist(),
+            "RP": self.all_rps.tolist(),
+            "p_grid_setpoint": self.all_sps.tolist(),
+            # dragg_tpu extras (additive; Reformat ignores unknown keys).
+            "solver_iterations": list(self._solve_iters),
+        }
+        # The reference wraps the price series in a 1-tuple — a trailing-comma
+        # bug (dragg/aggregator.py:814-816) we do NOT reproduce.
+        self.collected_data["Summary"]["TOU"] = self.env.tou[sim_slice].tolist()
+
+    def write_outputs(self) -> None:
+        """Serialize collected_data → <run_dir>/<case>/results.json
+        (dragg/aggregator.py:831-844)."""
+        self.summarize_baseline()
+        case_dir = os.path.join(self.run_dir, self.case)
+        os.makedirs(case_dir, exist_ok=True)
+        with open(os.path.join(case_dir, "results.json"), "w") as f:
+            json.dump(self.collected_data, f, indent=4)
+
+    # ------------------------------------------------------------------- run
+    def _checkpoint_steps(self) -> int:
+        """hourly/daily/weekly → timesteps (dragg/aggregator.py:949-955)."""
+        interval = self.config["simulation"].get("checkpoint_interval", "daily")
+        return {
+            "hourly": self.dt,
+            "daily": self.dt * 24,
+            "weekly": self.dt * 24 * 7,
+        }.get(interval, 500)
+
+    def run(self) -> None:
+        """Entry point (dragg/aggregator.py:941-970)."""
+        self.log.logger.info("Made it to Aggregator Run")
+        self.checkpoint_interval = self._checkpoint_steps()
+        self.version = self.config["simulation"].get("named_version", "test")
+        self.set_run_dir()
+
+        if self.config["simulation"].get("run_rbo_mpc", True):
+            self.case = "baseline"
+            self.get_homes()
+            self._build_engine()
+            self.reset_collected_data()
+            self.run_baseline()
+            self.check_baseline_vals()
+            self.write_outputs()
+        if self.config["simulation"].get("run_rl_agg", False):
+            from dragg_tpu.rl.runner import run_rl_agg
+
+            run_rl_agg(self)
+        if self.config["simulation"].get("run_rl_simplified", False):
+            from dragg_tpu.rl.runner import run_rl_simplified
+
+            run_rl_simplified(self)

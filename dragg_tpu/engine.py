@@ -1,0 +1,387 @@
+"""The community engine — one jitted, scan-able step for the whole community.
+
+This is the TPU-native replacement for the reference's per-timestep fan-out
+(``Aggregator.run_iteration`` → pathos pool → ``MPCCalc.run_home`` → CVXPY →
+GLPK_MI → Redis, dragg/aggregator.py:711-755, dragg/mpc_calc.py:649-672):
+the community is a batched tensor program.  Each step
+
+1. slices the environment windows (OAT/GHI/TOU) on device with
+   ``lax.dynamic_slice`` — the series are placed on device once, the analog
+   of the reference pushing them into Redis up front
+   (dragg/aggregator.py:653-662);
+2. computes water-draw windows and the draw-mixed initial WH temperature
+   (dragg/mpc_calc.py:193-204,281);
+3. gates each home's HVAC season (heat-only vs cool-only) on the *noisy*
+   OAT forecast — in the reference the "expected-value" forecast noise is
+   used only for this seasonal switch; the MPC constraints themselves use
+   the true OAT/GHI windows (dragg/mpc_calc.py:206-231 builds
+   ``oat_current_ev`` but :229 passes the un-noised ``oat_current`` into the
+   constraints; the EV array is read only by the season check :303);
+4. assembles the fixed-shape batched QP and solves it with the ADMM kernel;
+5. routes homes whose solve failed tolerance through the vectorized
+   fallback controller (dragg/mpc_calc.py:527-596);
+6. emits the per-home observables of the reference's Redis result hash
+   (dragg/mpc_calc.py:482-524) as stacked arrays.
+
+``make_engine`` builds the step and a ``lax.scan`` chunk runner over
+timesteps; the host loop only crosses the device boundary at checkpoint
+intervals.  Everything batches over the home axis, which is the axis the
+parallel layer shards over the TPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dragg_tpu.models.fallback import fallback_control
+from dragg_tpu.ops.admm import admm_solve
+from dragg_tpu.ops.qp import (
+    QPLayout,
+    TAP_TEMP,
+    assemble_qp_step,
+    build_qp_static,
+    recover_solution,
+)
+
+WINTER_MAX_OAT = 30.0  # season switch threshold, degC (dragg/mpc_calc.py:303)
+
+
+class CommunityState(NamedTuple):
+    """Per-home simulation state carried between timesteps.
+
+    The reference persists these in each home's Redis hash
+    (``temp_in_opt``/``temp_wh_opt``/``e_batt_opt``/``solve_counter`` and the
+    ``{key}_{j}`` horizon plans, dragg/mpc_calc.py:100-115,482-524); here
+    they are device arrays threaded through ``lax.scan``.
+    """
+
+    temp_in: jnp.ndarray     # (n,) one-step deterministic indoor temp
+    temp_wh: jnp.ndarray     # (n,) WH temp BEFORE next step's draw mixing
+    e_batt: jnp.ndarray      # (n,) battery SoC (kWh)
+    counter: jnp.ndarray     # (n,) int32 solve_counter
+    plan_cool: jnp.ndarray   # (n, H) last feasible raw-duty plans (replay source)
+    plan_heat: jnp.ndarray   # (n, H)
+    plan_wh: jnp.ndarray     # (n, H)
+    warm_x: jnp.ndarray      # (n, nvar) ADMM warm-start primal
+    warm_y_eq: jnp.ndarray   # (n, m_eq) ADMM warm-start equality duals
+    warm_y_box: jnp.ndarray  # (n, nvar) ADMM warm-start box duals
+    warm_rho: jnp.ndarray    # (n,) ADMM warm-start rho
+    key: jnp.ndarray         # PRNG key for the seasonal forecast noise
+
+
+class StepOutputs(NamedTuple):
+    """Per-home observables for one timestep — the reference's Redis result
+    hash fields (dragg/mpc_calc.py:482-524), same units:
+
+    * ``p_grid`` / ``p_load`` / ``forecast_p_grid`` are physical kW
+      (reference stores ``value / sub_subhourly_steps``);
+    * duty cycles are fractions in [0, 1] (reference stores count / s);
+    * ``cost`` follows the reference's per-path convention: s * price *
+      p_grid on optimal steps (dragg/mpc_calc.py:500 — the raw QP variable),
+      price * p_grid on fallback steps (dragg/mpc_calc.py:594).
+    """
+
+    p_grid: jnp.ndarray           # (n,)
+    forecast_p_grid: jnp.ndarray  # (n,)
+    p_load: jnp.ndarray           # (n,)
+    temp_in: jnp.ndarray          # (n,)
+    temp_wh: jnp.ndarray          # (n,)
+    hvac_cool_on: jnp.ndarray     # (n,) duty fraction
+    hvac_heat_on: jnp.ndarray     # (n,)
+    wh_heat_on: jnp.ndarray       # (n,)
+    cost: jnp.ndarray             # (n,)
+    waterdraws: jnp.ndarray       # (n,) liters
+    correct_solve: jnp.ndarray    # (n,) 1.0 / 0.0
+    p_pv: jnp.ndarray             # (n,) kW
+    u_pv_curt: jnp.ndarray        # (n,)
+    e_batt: jnp.ndarray           # (n,) kWh
+    p_batt_ch: jnp.ndarray        # (n,) kW
+    p_batt_disch: jnp.ndarray     # (n,) kW (non-positive)
+    agg_load: jnp.ndarray         # () sum of p_grid over homes (the one
+                                  # reduction in the system; psum-able)
+    forecast_load: jnp.ndarray    # ()
+    agg_cost: jnp.ndarray         # ()
+    admm_iters: jnp.ndarray       # () iterations the solver ran this step
+
+
+class EngineParams(NamedTuple):
+    """Static (Python-side) engine configuration."""
+
+    horizon: int        # H — decision steps (hems horizon * dt)
+    dt: int             # steps per hour
+    s: float            # sub_subhourly_steps (duty-cycle denominator)
+    discount: float
+    start_index: int    # index of sim t=0 in the environment series
+    admm_iters: int
+    admm_rho: float
+    admm_eps: float
+    admm_sigma: float
+    admm_alpha: float
+    seed: int
+
+
+class Engine:
+    """Holds the compiled step/scan functions for one (community, config).
+
+    Build via :func:`make_engine`.  The home batch and environment series
+    are closed over as device constants; state flows through explicitly.
+    """
+
+    def __init__(self, params: EngineParams, batch, env_oat, env_ghi, env_tou,
+                 check_mask=None):
+        self.params = params
+        self.batch = batch
+        lay = QPLayout(params.horizon)
+        self.layout = lay
+        self.static = build_qp_static(batch, params.horizon, params.dt)
+        self.n_homes = batch.n_homes
+        # Device-resident environment series (float32).
+        self._oat = jnp.asarray(np.asarray(env_oat), dtype=jnp.float32)
+        self._ghi = jnp.asarray(np.asarray(env_ghi), dtype=jnp.float32)
+        self._tou = jnp.asarray(np.asarray(env_tou), dtype=jnp.float32)
+        self._draws = jnp.asarray(np.asarray(batch.draws_hourly), dtype=jnp.float32)
+        self._tank = jnp.asarray(np.asarray(batch.tank_size), dtype=jnp.float32)
+        # check_type mask: aggregate reductions include only selected homes
+        # (the reference only simulates matching homes, dragg/aggregator.py:
+        # 767-770; homes are independent, so simulating all and masking the
+        # sums is behaviorally identical for the selected homes).
+        if check_mask is None:
+            check_mask = np.ones(batch.n_homes)
+        self._check_mask = jnp.asarray(np.asarray(check_mask), dtype=jnp.float32)
+        self._step_fn = jax.jit(self._step)
+        self._chunk_fn = jax.jit(self._chunk)
+
+    # ---------------------------------------------------------------- state
+    def init_state(self) -> CommunityState:
+        """t=0 initial conditions (dragg/mpc_calc.py:267-277)."""
+        b = self.batch
+        n = self.n_homes
+        H = self.params.horizon
+        f32 = jnp.float32
+        return CommunityState(
+            temp_in=jnp.asarray(b.temp_in_init, dtype=f32),
+            temp_wh=jnp.asarray(b.temp_wh_init, dtype=f32),
+            e_batt=jnp.asarray(b.e_batt_init_frac * b.batt_capacity, dtype=f32),
+            counter=jnp.zeros((n,), dtype=jnp.int32),
+            plan_cool=jnp.zeros((n, H), dtype=f32),
+            plan_heat=jnp.zeros((n, H), dtype=f32),
+            plan_wh=jnp.zeros((n, H), dtype=f32),
+            warm_x=jnp.zeros((n, self.layout.n), dtype=f32),
+            warm_y_eq=jnp.zeros((n, self.layout.m_eq), dtype=f32),
+            warm_y_box=jnp.zeros((n, self.layout.n), dtype=f32),
+            warm_rho=jnp.full((n,), self.params.admm_rho, dtype=f32),
+            key=jax.random.PRNGKey(self.params.seed),
+        )
+
+    # ----------------------------------------------------------------- step
+    def _step(self, state: CommunityState, t, rp):
+        """One community timestep.  ``t`` is the sim timestep (traced),
+        ``rp`` the reward-price vector (H,) for this step."""
+        p = self.params
+        lay = self.layout
+        b = self.batch
+        H, dt, s = p.horizon, p.dt, p.s
+        n = self.n_homes
+        f32 = jnp.float32
+
+        # --- Water draws (dragg/mpc_calc.py:193-204).
+        hour = t // dt
+        win_hourly = lax.dynamic_slice(self._draws, (0, hour), (n, H // dt + 1))
+        raw = jnp.repeat(win_hourly, dt, axis=-1) / dt
+        n_raw = raw.shape[-1]
+        idx = jnp.arange(H + 1)
+        prev_ok = (idx - 1 >= 0).astype(f32)
+        next_ok = (idx + 1 < n_raw).astype(f32)
+        take = lambda off: jnp.take(raw, jnp.clip(idx + off, 0, n_raw - 1), axis=-1)
+        rolled = (take(-1) * prev_ok + take(0) + take(1) * next_ok) / (prev_ok + 1.0 + next_ok)
+        direct = jnp.take(raw, jnp.minimum(idx, n_raw - 1), axis=-1)
+        draw_size = jnp.where(idx < dt, direct, rolled)        # (n, H+1) liters
+        draw_frac = draw_size / self._tank[:, None]
+
+        # Draw-mixed initial WH temperature (dragg/mpc_calc.py:271,281).
+        temp_wh_init = (
+            state.temp_wh * (self._tank - draw_size[:, 0]) + TAP_TEMP * draw_size[:, 0]
+        ) / self._tank
+
+        # --- Environment windows (true values; dragg/mpc_calc.py:211-230).
+        start = p.start_index + t
+        oat_w = lax.dynamic_slice(self._oat, (start,), (H + 1,))
+        ghi_w = lax.dynamic_slice(self._ghi, (start,), (H + 1,))
+        tou_w = lax.dynamic_slice(self._tou, (start,), (H,))
+        price_total = rp[None, :].astype(f32) + tou_w[None, :]   # (1, H) → broadcast
+        price_total = jnp.broadcast_to(price_total, (n, H))
+
+        # --- Seasonal gate on the noisy forecast (dragg/mpc_calc.py:217-223,302-309).
+        key = jax.random.fold_in(state.key, t)
+        noise = jax.random.normal(key, (n, H), dtype=f32) * jnp.power(
+            jnp.asarray(1.1, f32), jnp.arange(H, dtype=f32)
+        )
+        oat_ev_max = jnp.maximum(oat_w[0], jnp.max(oat_w[None, 1:] + noise, axis=1))
+        winter = (oat_ev_max <= WINTER_MAX_OAT).astype(f32)
+        heat_cap = winter * s
+        cool_cap = (1.0 - winter) * s
+
+        # --- Assemble + solve the batched QP.
+        qp = assemble_qp_step(
+            self.static, lay, b,
+            oat_window=oat_w, ghi_window=ghi_w, price_total=price_total,
+            draw_frac=draw_frac,
+            temp_in_init=state.temp_in, temp_wh_init=temp_wh_init,
+            e_batt_init=state.e_batt,
+            cool_cap=cool_cap, heat_cap=heat_cap, wh_cap=s,
+            discount=p.discount,
+        )
+        sol = admm_solve(
+            qp.A_eq, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+            rho=p.admm_rho, sigma=p.admm_sigma, alpha=p.admm_alpha,
+            eps_abs=p.admm_eps, eps_rel=p.admm_eps,
+            iters=p.admm_iters,
+            x0=state.warm_x, y_eq0=state.warm_y_eq, y_box0=state.warm_y_box,
+            rho0=state.warm_rho,
+        )
+        mpc = recover_solution(sol.x, lay, b, ghi_w, price_total, s)
+        solved = sol.solved
+
+        # --- Fallback for unsolved homes (dragg/mpc_calc.py:527-596).
+        counter_inc = jnp.where(solved, 0, state.counter + 1)
+        ridx = jnp.clip(counter_inc, 0, H - 1)[:, None]
+        fb = fallback_control(
+            counter_inc, t, H,
+            jnp.take_along_axis(state.plan_cool, ridx, axis=1)[:, 0],
+            jnp.take_along_axis(state.plan_heat, ridx, axis=1)[:, 0],
+            jnp.take_along_axis(state.plan_wh, ridx, axis=1)[:, 0],
+            state.temp_in, temp_wh_init, oat_w[1],
+            jnp.asarray(b.hvac_r, f32), jnp.asarray(b.hvac_c, f32),
+            jnp.asarray(b.hvac_p_c, f32), jnp.asarray(b.hvac_p_h, f32),
+            jnp.asarray(b.wh_r, f32), jnp.asarray(b.wh_c, f32), jnp.asarray(b.wh_p, f32),
+            jnp.asarray(b.temp_in_min, f32), jnp.asarray(b.temp_in_max, f32),
+            jnp.asarray(b.temp_wh_min, f32), jnp.asarray(b.temp_wh_max, f32),
+            cool_cap, heat_cap, jnp.full((n,), s, dtype=f32),
+            dt,
+        )
+
+        # --- Merge optimal / fallback per home.
+        pick = lambda a, fbv: jnp.where(solved, a, fbv)
+        cool0 = pick(mpc.cool[:, 0], fb.cool_on)
+        heat0 = pick(mpc.heat[:, 0], fb.heat_on)
+        wh0 = pick(mpc.wh[:, 0], fb.wh_on)
+        # Fallback: battery idles, PV drops out of p_grid — the reference's
+        # fallback path likewise excludes battery/PV from p_grid
+        # (dragg/mpc_calc.py:590-593).
+        p_ch0 = pick(mpc.p_ch[:, 0], jnp.zeros((n,), f32))
+        p_d0 = pick(mpc.p_disch[:, 0], jnp.zeros((n,), f32))
+        p_pv0 = pick(mpc.p_pv[:, 0], jnp.zeros((n,), f32))
+        u_curt0 = pick(mpc.u_curt[:, 0], jnp.zeros((n,), f32))
+        p_load0 = (
+            jnp.asarray(b.hvac_p_c, f32) * cool0
+            + jnp.asarray(b.hvac_p_h, f32) * heat0
+            + jnp.asarray(b.wh_p, f32) * wh0
+        )
+        p_grid0 = p_load0 + (p_ch0 + p_d0) - p_pv0
+        price0 = price_total[:, 0]
+        # Optimal path records cost on the raw (s-scaled) grid variable,
+        # fallback on the physical one (dragg/mpc_calc.py:500 vs :594).
+        cost0 = jnp.where(solved, price0 * s * p_grid0, price0 * p_grid0)
+        temp_in_next = pick(mpc.temp_in1, fb.temp_in)
+        temp_wh_next = pick(mpc.temp_wh1, fb.temp_wh)
+        e_batt_next = pick(mpc.e_batt[:, 1], state.e_batt)
+        # forecast_p_grid_opt = plan's step-1 grid power (0 at the horizon
+        # end; dragg/mpc_calc.py:491), fallback falls back to p_load (:591).
+        fore = mpc.p_grid[:, 1] / s if H > 1 else jnp.zeros((n,), f32)
+        fore = jnp.where(solved, fore, p_load0)
+
+        sel2 = solved[:, None]
+        new_state = CommunityState(
+            temp_in=temp_in_next,
+            temp_wh=temp_wh_next,
+            e_batt=e_batt_next,
+            counter=jnp.where(solved, 0, fb.counter).astype(jnp.int32),
+            plan_cool=jnp.where(sel2, mpc.cool, state.plan_cool),
+            plan_heat=jnp.where(sel2, mpc.heat, state.plan_heat),
+            plan_wh=jnp.where(sel2, mpc.wh, state.plan_wh),
+            warm_x=sol.x,
+            warm_y_eq=sol.y_eq,
+            warm_y_box=sol.y_box,
+            warm_rho=sol.rho,
+            key=state.key,
+        )
+        out = StepOutputs(
+            p_grid=p_grid0,
+            forecast_p_grid=fore,
+            p_load=p_load0,
+            temp_in=temp_in_next,
+            temp_wh=temp_wh_next,
+            hvac_cool_on=cool0 / s,
+            hvac_heat_on=heat0 / s,
+            wh_heat_on=wh0 / s,
+            cost=cost0,
+            waterdraws=draw_size[:, 0],
+            correct_solve=solved.astype(f32),
+            p_pv=p_pv0,
+            u_pv_curt=u_curt0,
+            e_batt=e_batt_next,
+            p_batt_ch=p_ch0,
+            p_batt_disch=p_d0,
+            agg_load=jnp.sum(p_grid0 * self._check_mask),
+            forecast_load=jnp.sum(fore * self._check_mask),
+            agg_cost=jnp.sum(cost0 * self._check_mask),
+            admm_iters=sol.iters,
+        )
+        return new_state, out
+
+    def _chunk(self, state: CommunityState, t0, rps):
+        """Scan ``rps.shape[0]`` timesteps on device (the sim hot loop —
+        replaces dragg/aggregator.py:771-778's per-step pool fan-out)."""
+
+        def body(carry, inp):
+            i, rp = inp
+            return self._step(carry, t0 + i, rp)
+
+        n_steps = rps.shape[0]
+        return lax.scan(body, state, (jnp.arange(n_steps), rps))
+
+    # ------------------------------------------------------------------ api
+    def step(self, state: CommunityState, t: int, rp) -> tuple[CommunityState, StepOutputs]:
+        """Run a single timestep (jitted)."""
+        return self._step_fn(state, jnp.asarray(t), jnp.asarray(rp, dtype=jnp.float32))
+
+    def run_chunk(self, state: CommunityState, t0: int, rps) -> tuple[CommunityState, StepOutputs]:
+        """Run a chunk of timesteps with a device-side scan.  ``rps`` is
+        (n_steps, H) reward prices (zeros for the baseline case).  Returns
+        (final_state, outputs stacked along time)."""
+        return self._chunk_fn(state, jnp.asarray(t0), jnp.asarray(rps, dtype=jnp.float32))
+
+
+def make_engine(batch, env, config, start_index: int) -> Engine:
+    """Construct an :class:`Engine` from a HomeBatch + EnvironmentData +
+    validated config dict."""
+    hems = config["home"]["hems"]
+    dt = int(config["agg"]["subhourly_steps"])
+    tpu_cfg = config.get("tpu", {})
+    params = EngineParams(
+        horizon=max(1, int(hems["prediction_horizon"]) * dt),
+        dt=dt,
+        s=float(max(1, int(hems["sub_subhourly_steps"]))),
+        discount=float(hems["discount_factor"]),
+        start_index=int(start_index),
+        admm_iters=int(tpu_cfg.get("admm_iters", 1500)),
+        admm_rho=float(tpu_cfg.get("admm_rho", 0.1)),
+        admm_eps=float(tpu_cfg.get("admm_eps", 1e-4)),
+        admm_sigma=float(tpu_cfg.get("admm_sigma", 1e-6)),
+        admm_alpha=float(tpu_cfg.get("admm_alpha", 1.6)),
+        seed=int(config["simulation"]["random_seed"]),
+    )
+    check_type = config["simulation"].get("check_type", "all")
+    if check_type == "all":
+        mask = np.ones(batch.n_homes)
+    else:
+        from dragg_tpu.homes import TYPE_CODES
+
+        mask = (np.asarray(batch.type_code) == TYPE_CODES[check_type]).astype(np.float64)
+    return Engine(params, batch, env.oat, env.ghi, env.tou, check_mask=mask)

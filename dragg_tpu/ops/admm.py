@@ -38,11 +38,12 @@ RHO_MIN, RHO_MAX = 1e-6, 1e6
 
 class ADMMSolution(NamedTuple):
     x: jnp.ndarray        # (B, n) primal solution (unscaled, box-projected)
-    y_eq: jnp.ndarray     # (B, m_eq) duals on equality rows (scaled problem)
-    y_box: jnp.ndarray    # (B, n) duals on box rows (scaled problem)
+    y_eq: jnp.ndarray     # (B, m_eq) duals on equality rows (UNSCALED units)
+    y_box: jnp.ndarray    # (B, n) duals on box rows (UNSCALED units)
     r_prim: jnp.ndarray   # (B,) inf-norm primal residual (unscaled)
     r_dual: jnp.ndarray   # (B,) inf-norm dual residual (unscaled, cost-descaled)
     solved: jnp.ndarray   # (B,) bool
+    infeasible: jnp.ndarray  # (B,) bool — certified primal-infeasible (OSQP §3.4)
     iters: jnp.ndarray    # scalar iterations executed
     rho: jnp.ndarray      # (B,) final per-home rho (for warm starting)
 
@@ -119,9 +120,12 @@ def admm_solve(
     rho0: jnp.ndarray | None = None,
 ) -> ADMMSolution:
     """Solve B problems  min 1/2 x'(reg I)x + q'x  s.t. A_eq x = b_eq,
-    l <= x <= u  simultaneously.  Warm-startable via x0/y_eq0/y_box0/rho0
-    (duals are in the scaled problem's units, as returned by a prior call
-    with identical matrices)."""
+    l <= x <= u  simultaneously.  Warm-startable via x0/y_eq0/y_box0/rho0.
+    All warm-start quantities are in UNSCALED (original-problem) units — the
+    internal Ruiz/cost scaling is recomputed per call and applied at the
+    boundary, so warm starts transfer across calls whose matrices differ
+    (e.g. consecutive MPC timesteps where only the water-mix band, RHS, and
+    price vector move)."""
     B, m_eq, n = A_eq.shape
     dtype = A_eq.dtype
 
@@ -150,8 +154,9 @@ def admm_solve(
 
     rho_b = jnp.full((B,), rho, dtype=dtype) if rho0 is None else rho0.astype(dtype)
     x = jnp.zeros((B, n), dtype=dtype) if x0 is None else (x0.astype(dtype) / d)
-    y_eq = jnp.zeros((B, m_eq), dtype=dtype) if y_eq0 is None else y_eq0.astype(dtype)
-    y_box = jnp.zeros((B, n), dtype=dtype) if y_box0 is None else y_box0.astype(dtype)
+    # Unscaled → scaled duals: y = E ŷ / c  ⇒  ŷ = c y / e.
+    y_eq = jnp.zeros((B, m_eq), dtype=dtype) if y_eq0 is None else (c * y_eq0.astype(dtype) / e_eq)
+    y_box = jnp.zeros((B, n), dtype=dtype) if y_box0 is None else (c * y_box0.astype(dtype) / e_box)
     z_box = jnp.clip(w * x, ls, us)
 
     def residuals(x, z_box, y_eq, y_box):
@@ -196,30 +201,62 @@ def admm_solve(
         y_eq_new = y_eq + rho_eq[:, None] * alpha * (z_t_eq - bs)
         return x_new, z_box_new, y_eq_new, y_box_new
 
+    def primal_infeasible(dy_eq, dy_box):
+        """OSQP primal-infeasibility certificate (Stellato et al. §3.4) on
+        the dual-change direction accumulated over one check window.  An
+        infeasible QP's duals diverge along a ray δy with A'δy = 0 and
+        support value u'(δy)+ + l'(δy)- < 0; detecting it lets certifiably
+        infeasible homes exit the iteration loop instead of burning the full
+        budget (they route to the fallback controller regardless)."""
+        dy_eq_u = e_eq * dy_eq / c          # unscale: y = E ŷ / c
+        dy_box_u = e_box * dy_box / c
+        At_dy = _mv_t(A_eq, dy_eq_u) + dy_box_u
+        norm_dy = jnp.maximum(
+            jnp.max(jnp.abs(dy_eq_u), axis=1), jnp.max(jnp.abs(dy_box_u), axis=1)
+        )
+        eps_inf = 1e-4 * jnp.maximum(norm_dy, 1e-12)
+        cond1 = jnp.max(jnp.abs(At_dy), axis=1) <= eps_inf
+        dy_pos = jnp.maximum(dy_box_u, 0.0)
+        dy_neg = jnp.minimum(dy_box_u, 0.0)
+        # inf bounds: a nonzero δy component against an infinite bound makes
+        # the support value +inf, correctly blocking the certificate (the
+        # non-selected inf*0 branch of the where is discarded).
+        sup = (
+            jnp.sum(b_eq * dy_eq_u, axis=1)
+            + jnp.sum(jnp.where(dy_pos > 0, u_box * dy_pos, 0.0), axis=1)
+            + jnp.sum(jnp.where(dy_neg < 0, l_box * dy_neg, 0.0), axis=1)
+        )
+        cond2 = sup <= -eps_inf
+        return cond1 & cond2 & (norm_dy > 1e-10)
+
     def chunk(carry):
-        state, rho_b, L, it, _ = carry
+        state, rho_b, L, it, _, pinf = carry
+        x0_, z0_, y_eq_prev, y_box_prev = state
         state = lax.fori_loop(0, check_every, lambda _, cc: one_iter(L, rho_b, cc), state)
         x, z_box, y_eq, y_box = state
         r_prim, r_dual, p_sc, d_sc, ok = residuals(x, z_box, y_eq, y_box)
+        pinf = pinf | primal_infeasible(y_eq - y_eq_prev, y_box - y_box_prev)
+        done = ok | pinf
         if adaptive_rho:
             ratio = jnp.sqrt(
                 (r_prim / jnp.maximum(p_sc, 1e-10)) / jnp.maximum(r_dual / jnp.maximum(d_sc, 1e-10), 1e-10)
             )
             rho_new = jnp.clip(rho_b * ratio, RHO_MIN, RHO_MAX)
             update = (ratio > 5.0) | (ratio < 0.2)
-            rho_next = jnp.where(update & ~ok, rho_new, rho_b)
+            rho_next = jnp.where(update & ~done, rho_new, rho_b)
             L = lax.cond(jnp.any(rho_next != rho_b), factor, lambda _: L, rho_next)
             rho_b = rho_next
-        return state, rho_b, L, it + check_every, jnp.all(ok)
+        return state, rho_b, L, it + check_every, jnp.all(done), pinf
 
     def cond(carry):
-        _, _, _, it, all_ok = carry
-        return (it < iters) & (~all_ok)
+        _, _, _, it, all_done, _ = carry
+        return (it < iters) & (~all_done)
 
     L = factor(rho_b)
     state = (x, z_box, y_eq, y_box)
-    state, rho_b, L, it, _ = lax.while_loop(
-        cond, chunk, (state, rho_b, L, jnp.asarray(0), jnp.asarray(False))
+    pinf0 = jnp.zeros((B,), dtype=bool)
+    state, rho_b, L, it, _, pinf = lax.while_loop(
+        cond, chunk, (state, rho_b, L, jnp.asarray(0), jnp.asarray(False), pinf0)
     )
     x, z_box, y_eq, y_box = state
     r_prim, r_dual, _, _, ok = residuals(x, z_box, y_eq, y_box)
@@ -228,6 +265,7 @@ def admm_solve(
     # values even at loose tolerance.
     x_out = jnp.clip(d * x, l_box, u_box)
     return ADMMSolution(
-        x=x_out, y_eq=y_eq, y_box=y_box,
-        r_prim=r_prim, r_dual=r_dual, solved=ok, iters=it, rho=rho_b,
+        x=x_out, y_eq=e_eq * y_eq / c, y_box=e_box * y_box / c,
+        r_prim=r_prim, r_dual=r_dual, solved=ok & ~pinf, infeasible=pinf,
+        iters=it, rho=rho_b,
     )
