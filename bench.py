@@ -1,0 +1,99 @@
+"""Benchmark harness — one JSON line on stdout.
+
+Headline metric (BASELINE.json): MPC sim-timesteps/sec on the single-chip
+batched community — 10k homes, 24 h prediction horizon, mixed home types.
+``vs_baseline`` is measured against the north-star target rate of
+50 sim-timesteps/s (BASELINE.md: 100k homes over a 4-chip v4-8 slice
+→ 25k homes/chip; we report the per-chip rate at 10k homes, so ≥1.0 means
+the single-chip engine is on pace for the pod-slice target).
+
+Usage: python bench.py [--homes N] [--horizon-hours H] [--steps K]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+TARGET_TS_PER_S = 50.0  # BASELINE.md north star
+
+
+def build(n_homes: int, horizon_hours: int, admm_iters: int):
+    import numpy as np
+
+    from dragg_tpu.config import default_config
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = n_homes
+    # Mixed population, reference default ratio-ish: 40% PV, 10% battery,
+    # 10% pv_battery.
+    cfg["community"]["homes_pv"] = int(0.4 * n_homes)
+    cfg["community"]["homes_battery"] = int(0.1 * n_homes)
+    cfg["community"]["homes_pv_battery"] = int(0.1 * n_homes)
+    cfg["simulation"]["start_datetime"] = "2015-01-01 00"
+    cfg["simulation"]["end_datetime"] = "2015-01-08 00"
+    cfg["home"]["hems"]["prediction_horizon"] = horizon_hours
+    cfg["tpu"]["admm_iters"] = admm_iters
+
+    env = load_environment(cfg, data_dir=None)
+    dt = int(cfg["agg"]["subhourly_steps"])
+    waterdraw = load_waterdraw_profiles(None, seed=12)
+    homes = create_homes(cfg, 24 * 7 * dt, dt, waterdraw)
+    hems = cfg["home"]["hems"]
+    batch = build_home_batch(
+        homes, max(1, int(hems["prediction_horizon"]) * dt), dt,
+        int(hems["sub_subhourly_steps"]),
+    )
+    engine = make_engine(batch, env, cfg, 0)
+    return engine, np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    # Default sized to what the tunneled single-chip test rig executes
+    # reliably today; the BASELINE target config is --homes 10000.
+    ap.add_argument("--homes", type=int, default=1_000)
+    ap.add_argument("--horizon-hours", type=int, default=24)
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--admm-iters", type=int, default=1000)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU run (50 homes, 4h horizon) for verification")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+        args.homes, args.horizon_hours, args.steps = 50, 4, 4
+
+    engine, np = build(args.homes, args.horizon_hours, args.admm_iters)
+    H = engine.params.horizon
+    state = engine.init_state()
+    rps = np.zeros((args.steps, H), dtype=np.float32)
+
+    # Warmup with the SAME chunk shape as the timed run — the scan length is
+    # baked into the compiled program, so a different shape would put a full
+    # recompile inside the timed window.
+    state, outs = engine.run_chunk(state, 0, rps)
+    jax.block_until_ready(outs.agg_load)
+
+    t0 = time.perf_counter()
+    state, outs = engine.run_chunk(state, args.steps, rps)
+    jax.block_until_ready(outs.agg_load)
+    elapsed = time.perf_counter() - t0
+
+    rate = args.steps / elapsed
+    print(json.dumps({
+        "metric": f"sim_timesteps_per_s_{args.homes}homes_{args.horizon_hours}h_horizon",
+        "value": round(rate, 3),
+        "unit": "timesteps/s",
+        "vs_baseline": round(rate / TARGET_TS_PER_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -69,7 +69,6 @@ class CommunityState(NamedTuple):
     plan_heat: jnp.ndarray   # (n, H)
     plan_wh: jnp.ndarray     # (n, H)
     warm_x: jnp.ndarray      # (n, nvar) ADMM warm-start primal
-    warm_y_eq: jnp.ndarray   # (n, m_eq) ADMM warm-start equality duals
     warm_y_box: jnp.ndarray  # (n, nvar) ADMM warm-start box duals
     warm_rho: jnp.ndarray    # (n,) ADMM warm-start rho
     key: jnp.ndarray         # PRNG key for the seasonal forecast noise
@@ -173,7 +172,6 @@ class Engine:
             plan_heat=jnp.zeros((n, H), dtype=f32),
             plan_wh=jnp.zeros((n, H), dtype=f32),
             warm_x=jnp.zeros((n, self.layout.n), dtype=f32),
-            warm_y_eq=jnp.zeros((n, self.layout.m_eq), dtype=f32),
             warm_y_box=jnp.zeros((n, self.layout.n), dtype=f32),
             warm_rho=jnp.full((n,), self.params.admm_rho, dtype=f32),
             key=jax.random.PRNGKey(self.params.seed),
@@ -242,7 +240,7 @@ class Engine:
             rho=p.admm_rho, sigma=p.admm_sigma, alpha=p.admm_alpha,
             eps_abs=p.admm_eps, eps_rel=p.admm_eps,
             iters=p.admm_iters,
-            x0=state.warm_x, y_eq0=state.warm_y_eq, y_box0=state.warm_y_box,
+            x0=state.warm_x, y_box0=state.warm_y_box,
             rho0=state.warm_rho,
         )
         mpc = recover_solution(sol.x, lay, b, ghi_w, price_total, s)
@@ -306,7 +304,6 @@ class Engine:
             plan_heat=jnp.where(sel2, mpc.heat, state.plan_heat),
             plan_wh=jnp.where(sel2, mpc.wh, state.plan_wh),
             warm_x=sol.x,
-            warm_y_eq=sol.y_eq,
             warm_y_box=sol.y_box,
             warm_rho=sol.rho,
             key=state.key,

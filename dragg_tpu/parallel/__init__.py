@@ -1,0 +1,29 @@
+"""Multi-chip parallelism over the home axis.
+
+The reference's only parallelism strategy is an embarrassingly-parallel
+process-pool fan-out over homes with Redis as the communication backend
+(dragg/aggregator.py:723-724, dragg/redis_client.py:13-25).  The TPU-native
+equivalent (SURVEY.md §2.3) shards the home axis of the batched community
+program over a ``jax.sharding.Mesh``: every per-home array is placed with
+``NamedSharding(mesh, P("homes"))``, the engine step is jitted over the mesh,
+and XLA's SPMD partitioner inserts the collectives — the community's one
+reduction (``agg_load = Σ p_grid``, dragg/aggregator.py:751) becomes a single
+``psum`` riding ICI.  No KV store, no pickling, no host round-trips in the
+hot loop.
+"""
+
+from dragg_tpu.parallel.mesh import (
+    ShardedEngine,
+    make_mesh,
+    make_sharded_engine,
+    pad_batch,
+    shard_state,
+)
+
+__all__ = [
+    "ShardedEngine",
+    "make_mesh",
+    "make_sharded_engine",
+    "pad_batch",
+    "shard_state",
+]

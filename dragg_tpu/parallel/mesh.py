@@ -1,0 +1,147 @@
+"""Mesh construction, batch padding, and the sharded community engine.
+
+Design (SURVEY.md §2.3, §7 step 4): the community is data-parallel over the
+home axis — the reference fans one process per home over a pathos pool
+(dragg/aggregator.py:723-724); here the axis is sharded over the TPU mesh and
+XLA inserts the collectives.  Environment series (OAT/GHI/TOU) are replicated
+— they are the analog of the reference pushing full series into Redis once
+(dragg/aggregator.py:653-662) — while every per-home tensor (state, QP
+coefficients, water-draw schedules) is sharded on mesh axis ``"homes"``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dragg_tpu.engine import CommunityState, Engine, EngineParams
+
+HOMES_AXIS = "homes"
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = HOMES_AXIS,
+              devices=None) -> Mesh:
+    """A 1-D device mesh over the home axis.
+
+    Homes are independent problems, so a single mesh axis is the whole
+    parallelism taxonomy for this workload (SURVEY.md §2.3: TP/PP/SP/EP are
+    structurally absent in the reference; DP-over-homes is the core
+    strategy).  Multi-host pod slices extend the same axis over DCN —
+    ``jax.devices()`` already enumerates all processes' devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def pad_batch(batch, multiple: int):
+    """Pad every per-home array to a multiple of the shard count.
+
+    Padding replicates the last home (edge padding) so the dummy problems
+    remain well-posed (no zero tank sizes / RC constants); the returned mask
+    is 0 for padded homes so aggregate reductions are unchanged.
+    """
+    n = batch.n_homes
+    n_pad = (-n) % multiple
+    if n_pad == 0:
+        return batch, np.ones(n)
+    padded = type(batch)(*[
+        np.pad(np.asarray(f), [(0, n_pad)] + [(0, 0)] * (np.asarray(f).ndim - 1),
+               mode="edge")
+        for f in batch
+    ])
+    mask = np.concatenate([np.ones(n), np.zeros(n_pad)])
+    return padded, mask
+
+
+def shard_state(state: CommunityState, mesh: Mesh,
+                axis_name: str = HOMES_AXIS) -> CommunityState:
+    """Place a CommunityState on the mesh: per-home leaves sharded on dim 0,
+    the PRNG key replicated."""
+    sharded = NamedSharding(mesh, P(axis_name))
+    replicated = NamedSharding(mesh, P())
+    return CommunityState(*[
+        jax.device_put(v, replicated if name == "key" else sharded)
+        for name, v in zip(CommunityState._fields, state)
+    ])
+
+
+class ShardedEngine(Engine):
+    """An :class:`~dragg_tpu.engine.Engine` whose home axis is sharded over a
+    device mesh.
+
+    The step function itself is unchanged — sharding is expressed purely
+    through data placement: per-home constants (QP coefficients, draw
+    schedules, the check mask) and the threaded state are committed with
+    ``NamedSharding(mesh, P("homes"))``; XLA's SPMD partitioner propagates
+    the sharding through the batched program and lowers the aggregate-load
+    sum to a cross-device all-reduce.  This is the "annotate shardings, let
+    XLA insert collectives" recipe — the opposite of the reference's
+    explicit Redis message-passing (dragg/redis_client.py, SURVEY.md §5.8).
+
+    The home count is padded to a multiple of the mesh size with masked-out
+    replica homes; callers index real homes as ``[:true_n_homes]``.
+    """
+
+    def __init__(self, params: EngineParams, batch, env_oat, env_ghi, env_tou,
+                 check_mask=None, mesh: Mesh | None = None,
+                 axis_name: str = HOMES_AXIS):
+        if mesh is None:
+            mesh = make_mesh(axis_name=axis_name)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.true_n_homes = batch.n_homes
+        n_shards = mesh.devices.size
+        if check_mask is None:
+            check_mask = np.ones(batch.n_homes)
+        batch, pad_mask = pad_batch(batch, n_shards)
+        check_mask = np.pad(np.asarray(check_mask, dtype=np.float64),
+                            (0, batch.n_homes - self.true_n_homes)) * pad_mask
+        super().__init__(params, batch, env_oat, env_ghi, env_tou,
+                         check_mask=check_mask)
+
+        shard = NamedSharding(mesh, P(axis_name))
+        rep = NamedSharding(mesh, P())
+        put_s = lambda a: jax.device_put(jnp.asarray(np.asarray(a)), shard)
+        put_r = lambda a: jax.device_put(jnp.asarray(np.asarray(a)), rep)
+
+        # Replicated environment series; sharded per-home device constants.
+        self._oat = put_r(self._oat)
+        self._ghi = put_r(self._ghi)
+        self._tou = put_r(self._tou)
+        self._draws = put_s(self._draws)
+        self._tank = put_s(self._tank)
+        self._check_mask = put_s(self._check_mask)
+        # QP static: shared sparsity indices stay host-side numpy constants;
+        # per-home coefficient arrays are sharded.
+        st = self.static
+        self.static = type(st)(
+            rows=st.rows, cols=st.cols, whmix_pos=st.whmix_pos,
+            vals=put_s(st.vals), a_in=put_s(st.a_in), a_wh=put_s(st.a_wh),
+            kin=put_s(st.kin), kwh=put_s(st.kwh), awr=put_s(st.awr),
+        )
+        # HomeBatch fields re-committed as sharded device arrays so the
+        # ``jnp.asarray(...)`` closures in the traced step pick up the
+        # sharding instead of baking replicated host constants.
+        self.batch = type(batch)(*[put_s(f) for f in batch])
+
+    def init_state(self) -> CommunityState:
+        return shard_state(super().init_state(), self.mesh, self.axis_name)
+
+
+def make_sharded_engine(batch, env, config, start_index: int,
+                        mesh: Mesh | None = None) -> ShardedEngine:
+    """Sharded counterpart of :func:`dragg_tpu.engine.make_engine`."""
+    from dragg_tpu.engine import make_engine
+
+    proto = make_engine(batch, env, config, start_index)
+    axis = config.get("tpu", {}).get("mesh_axis", HOMES_AXIS)
+    return ShardedEngine(
+        proto.params, batch, env.oat, env.ghi, env.tou,
+        check_mask=np.asarray(proto._check_mask), mesh=mesh, axis_name=axis,
+    )

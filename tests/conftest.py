@@ -8,10 +8,16 @@ TPU.  Must set env vars before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Force the 8-device virtual CPU mesh via jax.config (not env vars): the
+# image's sitecustomize imports jax and pins the tunneled single-chip TPU
+# platform before conftest runs, so JAX_PLATFORMS / XLA_FLAGS set here are
+# too late — the config API still works until a backend is initialized.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

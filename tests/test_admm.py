@@ -111,6 +111,6 @@ class TestADMMvsScipy:
         cold = admm_solve(*args, iters=4000, eps_abs=1e-4, eps_rel=1e-4, check_every=10)
         warm = admm_solve(
             *args, iters=4000, eps_abs=1e-4, eps_rel=1e-4, check_every=10,
-            x0=cold.x, y_eq0=cold.y_eq, y_box0=cold.y_box, rho0=cold.rho,
+            x0=cold.x, y_box0=cold.y_box, rho0=cold.rho,
         )
         assert int(warm.iters) <= int(cold.iters)
