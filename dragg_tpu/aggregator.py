@@ -184,10 +184,14 @@ class Aggregator:
                 d["p_batt_disch"] = []
             self.collected_data[home["name"]] = d
 
-    def _collect_chunk(self, outs: StepOutputs) -> None:
+    def _collect_chunk(self, outs: StepOutputs, track_setpoints: bool = True) -> None:
         """Append a chunk of stacked step outputs to collected_data — the
         analog of per-step ``collect_data`` Redis reads
-        (dragg/aggregator.py:728-755), amortized over the whole chunk."""
+        (dragg/aggregator.py:728-755), amortized over the whole chunk.
+
+        ``track_setpoints=False`` skips the host-side ``gen_setpoint`` loop:
+        the RL-aggregator scan already tracks the setpoint on device and
+        overwrites ``all_sps`` with the authoritative values."""
         host = {f: np.asarray(getattr(outs, f)) for f in StepOutputs._fields}
         n_steps = host["p_grid"].shape[0]
         for i, home in enumerate(self.all_homes):
@@ -215,9 +219,10 @@ class Aggregator:
             self.forecast_load = float(host["forecast_load"][k])
             self.agg_cost = float(host["agg_cost"][k])
             self.timestep += 1
-            self.agg_setpoint = self.gen_setpoint()
-            if self.timestep < self.num_timesteps:
-                self.all_sps[self.timestep] = self.agg_setpoint
+            if track_setpoints:
+                self.agg_setpoint = self.gen_setpoint()
+                if self.timestep < self.num_timesteps:
+                    self.all_sps[self.timestep] = self.agg_setpoint
 
     # ----------------------------------------------------------- RL setpoint
     def gen_setpoint(self) -> float:

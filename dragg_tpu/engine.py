@@ -355,13 +355,12 @@ class Engine:
         return self._chunk_fn(state, jnp.asarray(t0), jnp.asarray(rps, dtype=jnp.float32))
 
 
-def make_engine(batch, env, config, start_index: int) -> Engine:
-    """Construct an :class:`Engine` from a HomeBatch + EnvironmentData +
-    validated config dict."""
+def engine_params(config, start_index: int) -> EngineParams:
+    """Derive the static engine configuration from a validated config dict."""
     hems = config["home"]["hems"]
     dt = int(config["agg"]["subhourly_steps"])
     tpu_cfg = config.get("tpu", {})
-    params = EngineParams(
+    return EngineParams(
         horizon=max(1, int(hems["prediction_horizon"]) * dt),
         dt=dt,
         s=float(max(1, int(hems["sub_subhourly_steps"]))),
@@ -374,11 +373,21 @@ def make_engine(batch, env, config, start_index: int) -> Engine:
         admm_alpha=float(tpu_cfg.get("admm_alpha", 1.6)),
         seed=int(config["simulation"]["random_seed"]),
     )
+
+
+def check_mask_for(batch, config) -> np.ndarray:
+    """check_type → aggregate-reduction mask (dragg/aggregator.py:767-770)."""
     check_type = config["simulation"].get("check_type", "all")
     if check_type == "all":
-        mask = np.ones(batch.n_homes)
-    else:
-        from dragg_tpu.homes import TYPE_CODES
+        return np.ones(batch.n_homes)
+    from dragg_tpu.homes import TYPE_CODES
 
-        mask = (np.asarray(batch.type_code) == TYPE_CODES[check_type]).astype(np.float64)
+    return (np.asarray(batch.type_code) == TYPE_CODES[check_type]).astype(np.float64)
+
+
+def make_engine(batch, env, config, start_index: int) -> Engine:
+    """Construct an :class:`Engine` from a HomeBatch + EnvironmentData +
+    validated config dict."""
+    params = engine_params(config, start_index)
+    mask = check_mask_for(batch, config)
     return Engine(params, batch, env.oat, env.ghi, env.tou, check_mask=mask)

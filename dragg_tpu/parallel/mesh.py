@@ -137,11 +137,10 @@ class ShardedEngine(Engine):
 def make_sharded_engine(batch, env, config, start_index: int,
                         mesh: Mesh | None = None) -> ShardedEngine:
     """Sharded counterpart of :func:`dragg_tpu.engine.make_engine`."""
-    from dragg_tpu.engine import make_engine
+    from dragg_tpu.engine import check_mask_for, engine_params
 
-    proto = make_engine(batch, env, config, start_index)
     axis = config.get("tpu", {}).get("mesh_axis", HOMES_AXIS)
     return ShardedEngine(
-        proto.params, batch, env.oat, env.ghi, env.tou,
-        check_mask=np.asarray(proto._check_mask), mesh=mesh, axis_name=axis,
+        engine_params(config, start_index), batch, env.oat, env.ghi, env.tou,
+        check_mask=check_mask_for(batch, config), mesh=mesh, axis_name=axis,
     )

@@ -1,0 +1,22 @@
+"""Reinforcement-learning price-signal aggregator (reference L3, dragg/agent.py).
+
+TPU-native re-design: the reference's linear actor-critic (polynomial/Fourier
+state bases, Gaussian policy, twin-Q critic fit by batch Ridge regression over
+a replay buffer, dragg/agent.py:42-232) becomes a pure-functional JAX core —
+one jittable ``train_step`` whose replay buffer, ridge solve and policy update
+all live on device — so the whole RL loop composes with the community engine
+inside a single ``lax.scan``.
+"""
+
+from dragg_tpu.rl.agent import RLAgent, UtilityAgent
+from dragg_tpu.rl.core import AgentParams, AgentCarry, RLObservation, init_carry, train_step
+
+__all__ = [
+    "RLAgent",
+    "UtilityAgent",
+    "AgentParams",
+    "AgentCarry",
+    "RLObservation",
+    "init_carry",
+    "train_step",
+]

@@ -1,0 +1,192 @@
+"""Host-facing RL agent classes with the reference's API surface.
+
+``RLAgent`` mirrors the reference's abstract class (dragg/agent.py:42-123):
+``train(env)``, ``get_policy_action(state)``, ``memorize``, rl_data
+recording/writing, and reload from a previous run — but every numeric update
+is delegated to the jitted functional core (:mod:`dragg_tpu.rl.core`), so the
+host class is just bookkeeping around one device call per step.
+
+``UtilityAgent`` is the concrete price-signal designer: the reference leaves
+``calc_state``/``reward`` abstract (dragg/agent.py:67-69,113-123) and ships no
+subclass; the concrete state (forecast error/trend, time-of-day, action delta
+— exactly the keys its bases consume, dragg/agent.py:89-107) and the
+negative-quadratic tracking reward ("encourages the agent to move towards a
+state with curr_error = 0 … negative reward values", dragg/agent.py:114-118)
+are therefore our minimal faithful concretization.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dragg_tpu.rl.core import (
+    AgentCarry,
+    AgentParams,
+    RLObservation,
+    StepRecord,
+    init_carry,
+    params_from_config,
+    train_step,
+)
+
+RL_DATA_KEYS = (
+    "theta_q", "theta_mu", "q_obs", "q_pred", "action",
+    "average_reward", "cumulative_reward", "reward", "mu",
+)
+
+
+class RLAgent:
+    """Linear actor-critic price-signal agent (dragg/agent.py:42).
+
+    Subclasses provide ``calc_state(env) -> RLObservation-fields dict`` and
+    ``reward(env) -> float``; ``train(env)`` runs one jitted core step.
+    """
+
+    name = "agent"
+
+    def __init__(self, config: dict, seed: int | None = None):
+        self.config = config
+        self.params: AgentParams = params_from_config(config)
+        if seed is None:
+            seed = int(config["simulation"]["random_seed"])
+        self.carry: AgentCarry = init_carry(self.params, seed)
+        self._step = jax.jit(lambda c, o: train_step(c, o, self.params))
+        self.rl_data: dict = {k: [] for k in RL_DATA_KEYS}
+        self.rl_data["parameters"] = {
+            "alpha_q": self.params.alpha_q,
+            "alpha_mu": self.params.alpha_mu,
+            "alpha_r": self.params.alpha_r,
+            "beta": self.params.beta,
+            "batch_size": self.params.batch_size,
+            "twin_q": self.params.n_q == 2,
+            "sigma": self.params.sigma,
+        }
+
+    # -- abstract surface (dragg/agent.py:67-69,113-123) --------------------
+    def calc_state(self, env) -> dict:
+        raise NotImplementedError
+
+    def reward(self, env) -> float:
+        raise NotImplementedError
+
+    # ----------------------------------------------------------------- train
+    def train(self, env) -> float:
+        """One RL step (dragg/agent.py:130-149). Returns the next action."""
+        s = self.calc_state(env)
+        obs = RLObservation(
+            fcst_error=jnp.float32(s["fcst_error"]),
+            forecast_trend=jnp.float32(s["forecast_trend"]),
+            time_of_day=jnp.float32(s["time_of_day"]),
+            delta_action=jnp.float32(s["delta_action"]),
+            reward=jnp.float32(self.reward(env)),
+        )
+        self.carry, rec = self._step(self.carry, obs)
+        self.record_rl_data(rec)
+        return float(self.carry.next_action)
+
+    def get_policy_action(self, state: dict) -> float:
+        """Sample a ~ N(θ_μ·φ(s), σ) without updating (dragg/agent.py:151-165)."""
+        from dragg_tpu.rl.core import _policy_action
+
+        key, sub = jax.random.split(self.carry.key)
+        self.carry = self.carry._replace(key=key)
+        sv = jnp.asarray(
+            [state["fcst_error"], state["forecast_trend"], state["time_of_day"], state["delta_action"]],
+            dtype=jnp.float32,
+        )
+        a, _ = _policy_action(self.carry.theta_mu, sv, self.params.sigma, sub)
+        return float(a)
+
+    # ------------------------------------------------------------- telemetry
+    def record_rl_data(self, rec: StepRecord) -> None:
+        """Append one step of telemetry (dragg/agent.py:247-256)."""
+        self.rl_data["theta_q"].append(np.asarray(rec.theta_q).tolist())
+        self.rl_data["theta_mu"].append(np.asarray(rec.theta_mu).tolist())
+        self.rl_data["q_obs"].append(float(rec.q_obs))
+        self.rl_data["q_pred"].append(float(rec.q_pred))
+        self.rl_data["action"].append(float(rec.action))
+        self.rl_data["average_reward"].append(float(rec.average_reward))
+        self.rl_data["cumulative_reward"].append(float(rec.cumulative_reward))
+        self.rl_data["reward"].append(float(rec.reward))
+        self.rl_data["mu"].append(float(rec.mu))
+
+    def record_chunk(self, recs: StepRecord) -> None:
+        """Append a stacked chunk of StepRecords (device-scan output)."""
+        n = np.asarray(recs.q_obs).shape[0]
+        tq = np.asarray(recs.theta_q)
+        tm = np.asarray(recs.theta_mu)
+        for k in range(n):
+            self.rl_data["theta_q"].append(tq[k].tolist())
+            self.rl_data["theta_mu"].append(tm[k].tolist())
+            for name, field in (
+                ("q_obs", recs.q_obs), ("q_pred", recs.q_pred), ("action", recs.action),
+                ("average_reward", recs.average_reward),
+                ("cumulative_reward", recs.cumulative_reward),
+                ("reward", recs.reward), ("mu", recs.mu),
+            ):
+                self.rl_data[name].append(float(np.asarray(field)[k]))
+
+    def write_rl_data(self, output_dir: str) -> None:
+        """<output_dir>/<name>_agent-results.json (dragg/agent.py:270-273)."""
+        path = os.path.join(output_dir, f"{self.name}_agent-results.json")
+        with open(path, "w") as f:
+            json.dump(self.rl_data, f, indent=4)
+
+    def load_from_previous(self, file: str) -> None:
+        """Warm-start θ from a previous agent-results file
+        (dragg/agent.py:275-282)."""
+        with open(file) as f:
+            data = json.load(f)
+        if data.get("theta_mu"):
+            theta_mu = jnp.asarray(data["theta_mu"][-1], dtype=jnp.float32)
+            self.carry = self.carry._replace(theta_mu=theta_mu)
+        if data.get("theta_q"):
+            col = jnp.asarray(data["theta_q"][-1], dtype=jnp.float32)
+            tq = jnp.stack([col] * self.params.n_q, axis=1)
+            self.carry = self.carry._replace(theta_q=tq)
+
+
+class UtilityAgent(RLAgent):
+    """Concrete community price-signal designer (see module docstring).
+
+    ``env`` duck-type: ``agg_load``, ``forecast_load``, ``prev_forecast_load``,
+    ``agg_setpoint``, ``timestep``, ``dt``, ``norm`` (max possible community
+    load, for scale-free features), ``prev_action``, ``action``.
+
+    State and reward are the single shared definition in
+    :func:`dragg_tpu.rl.env.observe` — the same function the fused device
+    scans trace — so the host API and the on-device RL loop cannot diverge.
+    """
+
+    name = "utility"
+
+    def _observe(self, env):
+        from dragg_tpu.rl.env import EnvCarry, observe
+
+        ec = EnvCarry(
+            agg_load=jnp.float32(env.agg_load),
+            forecast_load=jnp.float32(env.forecast_load),
+            prev_forecast_load=jnp.float32(env.prev_forecast_load),
+            setpoint=jnp.float32(env.agg_setpoint),
+            prev_action=jnp.float32(env.prev_action),
+            action=jnp.float32(env.action),
+            tracker=None,  # not consumed by observe()
+        )
+        return observe(ec, jnp.int32(env.timestep), env.dt, env.norm)
+
+    def calc_state(self, env) -> dict:
+        o = self._observe(env)
+        return {
+            "fcst_error": float(o.fcst_error),
+            "forecast_trend": float(o.forecast_trend),
+            "time_of_day": float(o.time_of_day),
+            "delta_action": float(o.delta_action),
+        }
+
+    def reward(self, env) -> float:
+        return float(self._observe(env).reward)

@@ -1,0 +1,48 @@
+"""Hand-built polynomial × Fourier feature bases for the linear RL agent.
+
+Same feature construction as the reference (dragg/agent.py:88-111): quadratic
+bases in each state scalar, outer products flattened with the constant term
+dropped, crossed with a Fourier time-of-day basis.  Dimensions: state basis
+23, state-action basis 71.  Pure ``jnp`` so they trace, ``vmap`` and ``grad``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+STATE_DIM = 23
+STATE_ACTION_DIM = 71
+
+
+def _quad(x):
+    """(1, x, x²) quadratic basis in a scalar."""
+    return jnp.stack([jnp.ones_like(x), x, x * x])
+
+
+def _time_fourier(time_of_day):
+    """(1, sin 2πt, cos 2πt) Fourier basis (dragg/agent.py:91)."""
+    ang = 2.0 * jnp.pi * time_of_day
+    return jnp.stack([jnp.ones_like(time_of_day), jnp.sin(ang), jnp.cos(ang)])
+
+
+def state_basis(fcst_error, forecast_trend, time_of_day):
+    """φ(s) ∈ R^23 (dragg/agent.py:88-96)."""
+    fe = _quad(fcst_error)
+    ft = _quad(forecast_trend)
+    tb = _time_fourier(time_of_day)
+    phi = jnp.outer(fe, ft).flatten()[1:]
+    return jnp.outer(phi, tb).flatten()[1:]
+
+
+def state_action_basis(fcst_error, forecast_trend, time_of_day, delta_action, action):
+    """φ(s, a) ∈ R^71 (dragg/agent.py:98-111)."""
+    ab = _quad(action)
+    dab = _quad(delta_action)
+    tb = _time_fourier(time_of_day)
+    fe = _quad(fcst_error)
+    ft = _quad(forecast_trend)
+    v = jnp.outer(ft, ab).flatten()[1:]
+    w = jnp.outer(fe, ab).flatten()[1:]
+    z = jnp.outer(fe, dab).flatten()[1:]
+    phi = jnp.concatenate([v, w, z])
+    return jnp.outer(phi, tb).flatten()[1:]

@@ -1,0 +1,292 @@
+"""Functional RL agent core — the reference ``RLAgent`` re-expressed as one
+jittable step over explicit state.
+
+Capability parity with dragg/agent.py:42-232:
+
+* Gaussian policy with linearly-parameterized mean μ = θ_μ·φ(s), fixed σ
+  (dragg/agent.py:151-165);
+* twin-Q linear critic with alternating update index (dragg/agent.py:189-201);
+* replay buffer + batch Ridge regression targets
+  y = r + β·min_i θ_qᵢ·φ(s', a'~π) (dragg/agent.py:167-213) — the sklearn
+  ``Ridge(α).fit`` becomes the closed-form device solve
+  (ΦᵀΦ + αI)⁻¹Φᵀy (SURVEY.md §2.2);
+* eligibility-trace policy update with TD-error clipped to ±1
+  (dragg/agent.py:215-232).
+
+Deviation (documented): the reference's twin-Q ridge blend uses
+``theta_q.flatten()`` (dragg/agent.py:213), which is shape-inconsistent when
+two critics exist; we blend against the updated column ``theta_q[:, i]``.
+
+Everything is fixed-shape: the replay buffer is a circular device array and
+the batch update is gated by masking rather than Python control flow, so the
+step composes into ``lax.scan`` alongside the community engine.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from dragg_tpu.rl.basis import (
+    STATE_ACTION_DIM,
+    STATE_DIM,
+    state_action_basis,
+    state_basis,
+)
+
+MEMORY_CAP = 2048  # circular replay capacity (reference list is unbounded)
+
+
+class AgentParams(NamedTuple):
+    """Hyperparameters (dragg/agent.py:78-86; config [rl.parameters])."""
+
+    alpha_q: float
+    alpha_mu: float
+    alpha_r: float
+    beta: float
+    sigma: float
+    batch_size: int
+    n_q: int           # 2 if twin_q else 1
+    lam_theta: float   # eligibility-trace decay (dragg/agent.py:61)
+    ridge_alpha: float  # Ridge regularization (dragg/agent.py:210)
+    action_low: float
+    action_high: float
+
+
+class RLObservation(NamedTuple):
+    """One environment observation s_{t+1} plus the reward r_t.
+
+    The four state scalars are the reference's state dict keys
+    (dragg/agent.py:89-107): normalized forecast error, forecast trend,
+    fractional time-of-day, and the change in action.
+    """
+
+    fcst_error: jnp.ndarray
+    forecast_trend: jnp.ndarray
+    time_of_day: jnp.ndarray
+    delta_action: jnp.ndarray
+    reward: jnp.ndarray
+
+
+class AgentCarry(NamedTuple):
+    """Explicit agent state threaded through ``lax.scan``."""
+
+    theta_mu: jnp.ndarray     # (STATE_DIM,)
+    theta_q: jnp.ndarray      # (STATE_ACTION_DIM, n_q)
+    z_theta_mu: jnp.ndarray   # (STATE_DIM,) eligibility trace
+    state: jnp.ndarray        # (4,) current state scalars
+    next_action: jnp.ndarray  # () action chosen for the upcoming step
+    avg_reward: jnp.ndarray   # ()
+    cum_reward: jnp.ndarray   # ()
+    i: jnp.ndarray            # () int32 twin-Q index
+    t: jnp.ndarray            # () int32 steps taken
+    mem_s: jnp.ndarray        # (CAP, 4) replay: state
+    mem_a: jnp.ndarray        # (CAP,)   replay: action
+    mem_r: jnp.ndarray        # (CAP,)   replay: reward
+    mem_s1: jnp.ndarray       # (CAP, 4) replay: next state
+    key: jnp.ndarray          # PRNG key
+
+
+class StepRecord(NamedTuple):
+    """Per-step telemetry — the reference's rl_data fields
+    (dragg/agent.py:247-256)."""
+
+    theta_q: jnp.ndarray
+    theta_mu: jnp.ndarray
+    q_obs: jnp.ndarray
+    q_pred: jnp.ndarray
+    action: jnp.ndarray
+    average_reward: jnp.ndarray
+    cumulative_reward: jnp.ndarray
+    reward: jnp.ndarray
+    mu: jnp.ndarray
+
+
+def init_carry(params: AgentParams, seed: int) -> AgentCarry:
+    """Fresh agent state.  θ_q ~ N(0, 0.3) matches the reference's lazy critic
+    init (dragg/agent.py:199); θ_μ starts at zero (dragg/agent.py:161)."""
+    key = jax.random.PRNGKey(seed)
+    key, kq = jax.random.split(key)
+    f32 = jnp.float32
+    return AgentCarry(
+        theta_mu=jnp.zeros((STATE_DIM,), f32),
+        theta_q=0.3 * jax.random.normal(kq, (STATE_ACTION_DIM, params.n_q), f32),
+        z_theta_mu=jnp.zeros((STATE_DIM,), f32),
+        state=jnp.zeros((4,), f32),
+        next_action=jnp.zeros((), f32),
+        avg_reward=jnp.zeros((), f32),
+        cum_reward=jnp.zeros((), f32),
+        i=jnp.zeros((), jnp.int32),
+        t=jnp.zeros((), jnp.int32),
+        mem_s=jnp.zeros((MEMORY_CAP, 4), f32),
+        mem_a=jnp.zeros((MEMORY_CAP,), f32),
+        mem_r=jnp.zeros((MEMORY_CAP,), f32),
+        mem_s1=jnp.zeros((MEMORY_CAP, 4), f32),
+        key=key,
+    )
+
+
+def _phi_s(s):
+    return state_basis(s[0], s[1], s[2])
+
+
+def _phi_sa(s, a):
+    return state_action_basis(s[0], s[1], s[2], s[3], a)
+
+
+def _policy_action(theta_mu, s, sigma, key):
+    """a ~ N(θ_μ·φ(s), σ) (dragg/agent.py:151-165)."""
+    mu = theta_mu @ _phi_s(s)
+    return mu + sigma * jax.random.normal(key, (), jnp.float32), mu
+
+
+def _ridge_update(carry: AgentCarry, params: AgentParams, key):
+    """Batch critic refit (dragg/agent.py:203-213) as a closed-form solve.
+
+    Samples ``batch_size`` experiences from the valid prefix of the circular
+    buffer, recomputes stochastic next actions under the current policy,
+    builds TD targets with the min over critics, and ridge-fits θ.
+    """
+    B = params.batch_size
+    # carry.t here is the post-increment step count; t=0 stored nothing, so
+    # the dense valid prefix holds t−1 experiences.
+    valid = jnp.minimum(carry.t - 1, MEMORY_CAP)
+    kidx, kact = jax.random.split(key)
+    idx = jax.random.randint(kidx, (B,), 0, jnp.maximum(valid, 1))
+    s = carry.mem_s[idx]          # (B, 4)
+    a = carry.mem_a[idx]          # (B,)
+    r = carry.mem_r[idx]          # (B,)
+    s1 = carry.mem_s1[idx]        # (B, 4)
+    a1_keys = jax.random.split(kact, B)
+    a1, _ = jax.vmap(lambda sv, k: _policy_action(carry.theta_mu, sv, params.sigma, k))(s1, a1_keys)
+    phi1 = jax.vmap(_phi_sa)(s1, a1)          # (B, DIM)
+    q1 = jnp.min(phi1 @ carry.theta_q, axis=1)  # min over critics (dragg/agent.py:174)
+    y = r + params.beta * q1
+    phi = jax.vmap(_phi_sa)(s, a)             # (B, DIM)
+    # sklearn Ridge(fit_intercept=True) centers features and targets; mirror
+    # that so the coefficient vector matches Ridge.coef_ semantics.
+    phi_c = phi - jnp.mean(phi, axis=0)
+    y_c = y - jnp.mean(y)
+    gram = phi_c.T @ phi_c + params.ridge_alpha * jnp.eye(STATE_ACTION_DIM, dtype=phi.dtype)
+    theta_r = jnp.linalg.solve(gram, phi_c.T @ y_c)
+    i = carry.i
+    blended = params.alpha_q * theta_r + (1.0 - params.alpha_q) * carry.theta_q[:, i]
+    do = (carry.t - 1) > B  # len(memory) > BATCH_SIZE (dragg/agent.py:203)
+    new_col = jnp.where(do, blended, carry.theta_q[:, i])
+    return carry.theta_q.at[:, i].set(new_col)
+
+
+def train_step(carry: AgentCarry, obs: RLObservation, params: AgentParams):
+    """One agent step — the reference's ``train(env)`` (dragg/agent.py:130-149)
+    with the env observation passed in explicitly.
+
+    Returns ``(new_carry, record)``; ``new_carry.next_action`` is the action
+    to apply next timestep (the reward-price scalar before clipping).
+    """
+    f32 = jnp.float32
+    next_state = jnp.stack([
+        obs.fcst_error.astype(f32),
+        obs.forecast_trend.astype(f32),
+        obs.time_of_day.astype(f32),
+        obs.delta_action.astype(f32),
+    ])
+    # Timestep 0: state ← next_state, action stays 0 (dragg/agent.py:132-136).
+    first = carry.t == 0
+    state = jnp.where(first, next_state, carry.state)
+    action = carry.next_action
+    r = obs.reward.astype(f32)
+
+    key, k_next, k_ridge = jax.random.split(carry.key, 3)
+    xu_k = _phi_sa(state, action)
+    next_action, _ = _policy_action(carry.theta_mu, next_state, params.sigma, k_next)
+    xu_k1 = _phi_sa(next_state, next_action)
+
+    # memorize (dragg/agent.py:125-128).  The reference skips t=0 (its
+    # falsy-action guard); we likewise drop the degenerate t=0 self-loop
+    # (s1, 0, r0, s1) so the buffer holds only real transitions — slot k-1
+    # stores step k's experience, keeping the valid prefix dense.
+    slot = jnp.mod(jnp.maximum(carry.t - 1, 0), MEMORY_CAP)
+    keep = lambda old, new: jnp.where(first, old, new)
+    mem_s = carry.mem_s.at[slot].set(keep(carry.mem_s[slot], state))
+    mem_a = carry.mem_a.at[slot].set(keep(carry.mem_a[slot], action))
+    mem_r = carry.mem_r.at[slot].set(keep(carry.mem_r[slot], r))
+    mem_s1 = carry.mem_s1.at[slot].set(keep(carry.mem_s1[slot], next_state))
+
+    # Twin-Q index flip BEFORE the TD pair (dragg/agent.py:190-201).
+    i = jnp.mod(carry.i + 1, params.n_q)
+    q_pred = carry.theta_q[:, i] @ xu_k
+    q_obs = r + params.beta * (carry.theta_q[:, i] @ xu_k1)
+
+    mid = carry._replace(
+        mem_s=mem_s, mem_a=mem_a, mem_r=mem_r, mem_s1=mem_s1,
+        i=i, t=carry.t + 1, state=state,
+    )
+    theta_q = _ridge_update(mid, params, k_ridge)
+
+    # Policy update (dragg/agent.py:215-232).  Two documented deviations from
+    # the reference, which as written cannot improve its policy:
+    # * TD error: standard target-minus-prediction (q_obs − q_pred); the
+    #   reference computes the negation (dragg/agent.py:222), which performs
+    #   gradient DESCENT on return;
+    # * Gaussian score: ∇_μ log π = (a−μ)/σ²·φ(s); the reference multiplies
+    #   by σ² (dragg/agent.py:229), mis-scaling updates by σ⁴ (≈1.6e5× too
+    #   small at the default σ=0.05).
+    x_k = _phi_s(state)
+    delta = jnp.clip(q_obs - q_pred, -1.0, 1.0)
+    avg_reward = carry.avg_reward + params.alpha_r * delta
+    cum_reward = carry.cum_reward + r
+    mu = jnp.clip(carry.theta_mu @ x_k, params.action_low, params.action_high)
+    grad_pi_mu = (action - mu) / (params.sigma ** 2) * x_k
+    z = params.lam_theta * carry.z_theta_mu + grad_pi_mu
+    theta_mu = carry.theta_mu + params.alpha_mu * delta * z
+
+    new_carry = AgentCarry(
+        theta_mu=theta_mu,
+        theta_q=theta_q,
+        z_theta_mu=z,
+        state=next_state,
+        next_action=next_action,
+        avg_reward=avg_reward,
+        cum_reward=cum_reward,
+        i=i,
+        t=carry.t + 1,
+        mem_s=mem_s,
+        mem_a=mem_a,
+        mem_r=mem_r,
+        mem_s1=mem_s1,
+        key=key,
+    )
+    record = StepRecord(
+        theta_q=theta_q[:, i],
+        theta_mu=theta_mu,
+        q_obs=q_obs,
+        q_pred=q_pred,
+        action=action,
+        average_reward=avg_reward,
+        cumulative_reward=cum_reward,
+        reward=r,
+        mu=mu,
+    )
+    return new_carry, record
+
+
+def params_from_config(config: dict) -> AgentParams:
+    """Build AgentParams from the [rl] config tables (dragg/agent.py:71-86)."""
+    p = config["rl"]["parameters"]
+    space = config["rl"]["utility"]["action_space"]
+    alpha = float(p["alpha"])
+    return AgentParams(
+        alpha_q=alpha,
+        alpha_mu=alpha,
+        alpha_r=alpha * 4.0,   # ALPHA_r = alpha·2² (dragg/agent.py:82)
+        beta=float(p["beta"]),
+        sigma=float(p["epsilon"]),
+        batch_size=int(p["batch_size"]),
+        n_q=2 if p.get("twin_q", True) else 1,
+        lam_theta=0.01,
+        ridge_alpha=0.01,
+        action_low=float(space[0]),
+        action_high=float(space[1]),
+    )

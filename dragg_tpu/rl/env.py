@@ -1,0 +1,99 @@
+"""Environment-side device functions for the RL loop.
+
+* the utility setpoint tracker — ``gen_setpoint``'s trailing-average load
+  (dragg/aggregator.py:677-696) as a pure scan-able update;
+* the simplified linear community response — ``test_response``'s
+  ``load ← load − c·rp·(setpoint − load)`` model (dragg/aggregator.py:898-911),
+  the reference's cheap stand-in for the whole MPC fleet and our RL-loop test
+  fixture (SURVEY.md §4);
+* the observation builder shared by the host agent and the fused device scans.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from dragg_tpu.rl.core import RLObservation
+
+
+class SetpointTracker(NamedTuple):
+    """Device state of ``gen_setpoint`` (dragg/aggregator.py:677-696)."""
+
+    tracked: jnp.ndarray   # (prev_n,) trailing loads
+    max_load: jnp.ndarray  # ()
+    min_load: jnp.ndarray  # ()
+
+
+def init_tracker(prev_n: int, max_poss_load: float) -> SetpointTracker:
+    """timestep<2 initialization: tracked ← 0.5·max_possible_load
+    (dragg/aggregator.py:683-686)."""
+    return SetpointTracker(
+        tracked=jnp.full((prev_n,), 0.5 * max_poss_load, dtype=jnp.float32),
+        max_load=jnp.float32(-jnp.inf),
+        min_load=jnp.float32(jnp.inf),
+    )
+
+
+def tracker_step(tr: SetpointTracker, agg_load, timestep) -> tuple[SetpointTracker, jnp.ndarray]:
+    """Update the trailing window with the latest community load and return
+    (new_tracker, setpoint = avg(tracked)) (dragg/aggregator.py:687-696)."""
+    fresh = timestep < 2
+    rolled = jnp.concatenate([tr.tracked[1:], jnp.reshape(agg_load, (1,))])
+    tracked = jnp.where(fresh, tr.tracked, rolled)
+    day_tick = jnp.mod(timestep, 24) == 0
+    max_load = jnp.where((agg_load > tr.max_load) | day_tick, agg_load, tr.max_load)
+    min_load = jnp.where((agg_load < tr.min_load) | day_tick, agg_load, tr.min_load)
+    sp = jnp.mean(tracked)
+    return SetpointTracker(tracked, max_load, min_load), sp
+
+
+class EnvCarry(NamedTuple):
+    """Community-level measurements threaded through the RL scan — the
+    aggregator attributes the agent's state reads (setup_rl_agg_run,
+    dragg/aggregator.py:876-896)."""
+
+    agg_load: jnp.ndarray
+    forecast_load: jnp.ndarray
+    prev_forecast_load: jnp.ndarray
+    setpoint: jnp.ndarray
+    prev_action: jnp.ndarray  # action applied two steps ago
+    action: jnp.ndarray       # action applied last step
+    tracker: SetpointTracker
+
+
+def init_env_carry(n_homes: int, prev_n: int, max_poss_load: float) -> EnvCarry:
+    """setup_rl_agg_run initial guesses: forecast = agg = 3 kW/home
+    (dragg/aggregator.py:889-893)."""
+    f32 = jnp.float32
+    fl = jnp.asarray(3.0 * n_homes, f32)
+    tr = init_tracker(prev_n, max_poss_load)
+    sp = jnp.mean(tr.tracked)
+    return EnvCarry(
+        agg_load=fl, forecast_load=fl, prev_forecast_load=fl,
+        setpoint=sp, prev_action=jnp.zeros((), f32), action=jnp.zeros((), f32),
+        tracker=tr,
+    )
+
+
+def observe(env: EnvCarry, t, dt: int, norm: float) -> RLObservation:
+    """Build the agent observation + reward from community measurements
+    (concretization of the abstract calc_state/reward — see
+    dragg_tpu/rl/agent.py docstring)."""
+    day = 24 * dt
+    err = (env.agg_load - env.setpoint) / norm
+    return RLObservation(
+        fcst_error=(env.forecast_load - env.setpoint) / norm,
+        forecast_trend=(env.forecast_load - env.prev_forecast_load) / norm,
+        time_of_day=jnp.mod(t, day).astype(jnp.float32) / day,
+        delta_action=env.action - env.prev_action,
+        reward=-(err * err),
+    )
+
+
+def simplified_response(agg_load, rp, setpoint, response_rate):
+    """One step of the linear community model (dragg/aggregator.py:903-909):
+    ``load ← load − c·rp·(setpoint − load)``; cost = load·rp."""
+    load = agg_load - response_rate * rp * (setpoint - agg_load)
+    return load, load * rp
