@@ -1,0 +1,69 @@
+"""CLI entry point — the reference's L0 (dragg/main.py:1-19) plus the
+post-processing step it ships commented out.
+
+    python -m dragg_tpu run        # Aggregator().run() (dragg/main.py:4-9)
+    python -m dragg_tpu reformat   # Reformat().main()  (dragg/main.py:11-17)
+    python -m dragg_tpu bench      # the repo-root bench harness
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dragg_tpu",
+                                description="TPU-native community energy MPC simulator")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="run the simulation cases enabled in the config")
+    run.add_argument("--config", default=None, help="TOML config path (default: $DATA_DIR/$CONFIG_FILE)")
+    run.add_argument("--data-dir", default=None, help="directory with nsrdb.csv / waterdraw profiles")
+    run.add_argument("--outputs-dir", default="outputs")
+
+    ref = sub.add_parser("reformat", help="discover finished runs and build comparison figures")
+    ref.add_argument("--config", default=None)
+    ref.add_argument("--outputs-dir", default=None, help="default: $OUTPUT_DIR or ./outputs")
+    ref.add_argument("--home", default=None, help="sample home name for per-home plots")
+    ref.add_argument("--no-save", action="store_true", help="don't write PNGs")
+
+    sub.add_parser("bench", help="run the benchmark harness (prints one JSON line)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "run":
+        from dragg_tpu.aggregator import Aggregator
+
+        Aggregator(config=args.config, data_dir=args.data_dir,
+                   outputs_dir=args.outputs_dir).run()
+        return 0
+    if args.cmd == "reformat":
+        from dragg_tpu.reformat import Reformat
+
+        r = Reformat(config=args.config, outputs_dir=args.outputs_dir)
+        if args.home:
+            r.sample_home = args.home
+        r.main(save=not args.no_save)
+        return 0
+    if args.cmd == "bench":
+        import runpy
+
+        # bench.py lives at the repo root next to the package, not inside it;
+        # resolve it by path so the command works from any CWD.
+        import dragg_tpu
+
+        bench = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(dragg_tpu.__file__))), "bench.py")
+        if not os.path.isfile(bench):
+            print(f"bench.py not found at {bench}", file=sys.stderr)
+            return 1
+        runpy.run_path(bench, run_name="__main__")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,7 @@ class Aggregator:
         )
         self.start_time = None
         self.end_time = None
+        self.extra_summary: dict = {}  # case-specific Summary additions
         self.version = self.config["simulation"].get("named_version", "test")
         self.run_dir = None
         self._solve_iters: list[int] = []
@@ -335,6 +336,7 @@ class Aggregator:
         # The reference wraps the price series in a 1-tuple — a trailing-comma
         # bug (dragg/aggregator.py:814-816) we do NOT reproduce.
         self.collected_data["Summary"]["TOU"] = self.env.tou[sim_slice].tolist()
+        self.collected_data["Summary"].update(self.extra_summary)
 
     def write_outputs(self) -> None:
         """Serialize collected_data → <run_dir>/<case>/results.json

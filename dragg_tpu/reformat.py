@@ -1,0 +1,445 @@
+"""Post-processing / analysis — capability parity with the reference's
+``Reformat`` (dragg/reformat.py:20-509).
+
+Same responsibilities, rebuilt cleanly:
+
+* **Run discovery** by parameter permutation over the reference's output
+  layout ``outputs/<start>_<end>/<params>/version-<V>/<case>/results.json``
+  (dragg/reformat.py:101-171) — our Aggregator writes the identical layout,
+  so either framework's outputs are discoverable;
+* **Daily statistics** (daily max/min/range/avg/std, composite typical day,
+  dragg/reformat.py:429-473) as pure numpy functions plus a dependency-free
+  text table (the reference used PrettyTable);
+* **Figures** — aggregate-load comparison, typical-day profile, per-home
+  traces with thermal bounds, reward-price histograms
+  (dragg/reformat.py:257-505) — via matplotlib (always available in this
+  image); ``fig.savefig`` replaces plotly's ``write_image``.
+"""
+
+from __future__ import annotations
+
+import itertools as it
+import json
+import os
+from datetime import datetime, timedelta
+
+import numpy as np
+
+from dragg_tpu.config import load_config
+from dragg_tpu.logger import Logger
+
+
+# --------------------------------------------------------------------------
+# Pure statistics (dragg/reformat.py:429-473 inner computations)
+# --------------------------------------------------------------------------
+
+def _legend(ax, size):
+    if ax.get_legend_handles_labels()[0]:
+        ax.legend(fontsize=size)
+
+
+def daily_stats(loads: np.ndarray, steps_per_day: int) -> dict:
+    """Daily aggregate-load statistics over whole days.
+
+    Returns {} when fewer than one whole day of data exists (the reference
+    warns "Not enough data collected", dragg/reformat.py:470-471).
+    """
+    loads = np.asarray(loads, dtype=float)
+    n_days = len(loads) // steps_per_day
+    if n_days < 1:
+        return {}
+    days = loads[: n_days * steps_per_day].reshape(n_days, steps_per_day)
+    daily_max = days.max(axis=1)
+    daily_min = days.min(axis=1)
+    return {
+        "daily_max": daily_max,
+        "daily_min": daily_min,
+        "daily_range": daily_max - daily_min,
+        "daily_avg": days.mean(axis=1),
+        "daily_std": days.std(axis=1),
+        "composite_day": days.mean(axis=0),
+        "avg_daily_max": float(daily_max.mean()),
+        "std_daily_max": float(daily_max.std()),
+        "overall_max": float(daily_max.max()),
+        "avg_daily_range": float((daily_max - daily_min).mean()),
+    }
+
+
+def stats_table(rows: list[tuple[str, dict]]) -> str:
+    """Dependency-free fixed-width table of per-run daily stats — the
+    PrettyTable at dragg/reformat.py:430,469-472."""
+    headers = ["run name", "avg daily max", "std daily max", "overall max", "avg daily range"]
+    body = []
+    for name, st in rows:
+        if not st:
+            body.append([name, "-", "-", "-", "-"])
+        else:
+            body.append([
+                name,
+                f"{st['avg_daily_max']:.3f}", f"{st['std_daily_max']:.3f}",
+                f"{st['overall_max']:.3f}", f"{st['avg_daily_range']:.3f}",
+            ])
+    widths = [max(len(str(r[i])) for r in [headers] + body) for i in range(len(headers))]
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    fmt = lambda r: "| " + " | ".join(str(v).ljust(w) for v, w in zip(r, widths)) + " |"
+    return "\n".join([sep, fmt(headers), sep] + [fmt(r) for r in body] + [sep])
+
+
+# --------------------------------------------------------------------------
+# Run discovery
+# --------------------------------------------------------------------------
+
+class Reformat:
+    """Discover finished runs and build comparison figures
+    (dragg/reformat.py:20-47).
+
+    Parameters default to the reference's env-var resolution
+    (``DATA_DIR``/``OUTPUT_DIR``/``CONFIG_FILE``, dragg/reformat.py:24-29);
+    a config dict or path can be passed directly.
+    """
+
+    def __init__(self, config=None, outputs_dir: str | None = None):
+        self.log = Logger("reformat")
+        self.outputs_dir = os.path.expanduser(
+            outputs_dir if outputs_dir is not None else os.environ.get("OUTPUT_DIR", "outputs")
+        )
+        if not os.path.isdir(self.outputs_dir):
+            raise FileNotFoundError(f"No outputs directory found: {self.outputs_dir}")
+        if isinstance(config, dict):
+            self.config = config
+        else:
+            self.config = load_config(config)
+
+        self.date_ranges = self._date_ranges()
+        self.mpc_params = self._mpc_params()
+        self.versions = {self.config["simulation"].get("named_version", "test")}
+        self.date_folders = self.set_date_folders()
+        self.mpc_folders = self.set_mpc_folders()
+        self.files = self.set_files()
+        self.sample_home: str | None = None
+        self._results_cache: dict = {}
+        self.save_path = os.path.join(
+            self.outputs_dir, "images", datetime.now().strftime("%m%dT%H%M%S")
+        )
+
+    # -------------------------------------------------- parameter spaces
+    def _date_ranges(self) -> dict:
+        """Single-config permutation seed (dragg/reformat.py:80-84); callers
+        can add more values to the sets before re-running discovery."""
+        sim = self.config["simulation"]
+        return {
+            "start_datetime": {datetime.strptime(sim["start_datetime"], "%Y-%m-%d %H")},
+            "end_datetime": {datetime.strptime(sim["end_datetime"], "%Y-%m-%d %H")},
+        }
+
+    def _mpc_params(self) -> dict:
+        """(dragg/reformat.py:86-99)."""
+        cfg = self.config
+        return {
+            "n_houses": {cfg["community"]["total_number_homes"]},
+            "mpc_prediction_horizons": {cfg["home"]["hems"]["prediction_horizon"]},
+            "mpc_hourly_steps": {cfg["home"]["hems"]["sub_subhourly_steps"]},
+            "check_type": {cfg["simulation"]["check_type"]},
+            "agg_interval": {cfg["agg"]["subhourly_steps"]},
+            "solver": {cfg["home"]["hems"].get("solver", "admm")},
+        }
+
+    def _load(self, path: str) -> dict:
+        """Memoized results.json loader — each plot method iterates the same
+        files; parse each (potentially huge) JSON once per Reformat."""
+        if path not in self._results_cache:
+            with open(path) as f:
+                self._results_cache[path] = json.load(f)
+        return self._results_cache[path]
+
+    @staticmethod
+    def _permute(space: dict) -> list[dict]:
+        keys, values = zip(*space.items())
+        return [dict(zip(keys, v)) for v in it.product(*values)]
+
+    # ---------------------------------------------------------- discovery
+    def set_date_folders(self) -> list[dict]:
+        """(dragg/reformat.py:101-123)."""
+        found = []
+        perms = sorted(self._permute(self.date_ranges),
+                       key=lambda i: i["end_datetime"], reverse=True)
+        for p in perms:
+            folder = os.path.join(
+                self.outputs_dir,
+                f"{p['start_datetime'].strftime('%Y-%m-%dT%H')}_"
+                f"{p['end_datetime'].strftime('%Y-%m-%dT%H')}",
+            )
+            if os.path.isdir(folder):
+                hours = int((p["end_datetime"] - p["start_datetime"]).total_seconds() / 3600)
+                found.append({"folder": folder, "hours": hours, "start_dt": p["start_datetime"]})
+        if not found:
+            self.log.logger.error("No files found for the date ranges specified.")
+        return found
+
+    def set_mpc_folders(self) -> list[dict]:
+        """(dragg/reformat.py:125-142)."""
+        found = []
+        for j in self.date_folders:
+            for p in self._permute(self.mpc_params):
+                folder = os.path.join(
+                    j["folder"],
+                    f"{p['check_type']}-homes_{p['n_houses']}"
+                    f"-horizon_{p['mpc_prediction_horizons']}"
+                    f"-interval_{60 // p['agg_interval']}"
+                    f"-{60 // p['mpc_hourly_steps'] // p['agg_interval']}"
+                    f"-solver_{p['solver']}",
+                )
+                if os.path.isdir(folder):
+                    timesteps = j["hours"] * p["agg_interval"]
+                    minutes = 60 // p["agg_interval"]
+                    x_lims = [j["start_dt"] + timedelta(minutes=minutes * x) for x in range(timesteps)]
+                    entry = {"path": folder, "agg_dt": p["agg_interval"], "ts": timesteps, "x_lims": x_lims}
+                    if entry["path"] not in [e["path"] for e in found]:
+                        found.append(entry)
+        return found
+
+    def set_files(self) -> list[dict]:
+        """Collect every case's results.json under each version dir
+        (dragg/reformat.py:144-171)."""
+        files = []
+        for j in self.mpc_folders:
+            for version in self.versions:
+                vdir = os.path.join(j["path"], f"version-{version}")
+                if not os.path.isdir(vdir):
+                    continue
+                for case_dir in sorted(os.listdir(vdir)):
+                    path = os.path.join(vdir, case_dir, "results.json")
+                    if os.path.isfile(path):
+                        entry = {
+                            "results": path,
+                            "name": f"{case_dir}, v = {version}",
+                            "case": case_dir,
+                            "parent": j,
+                        }
+                        agent = os.path.join(vdir, case_dir, "utility_agent-results.json")
+                        if os.path.isfile(agent):
+                            entry["q_results"] = agent
+                        files.append(entry)
+                        self.log.logger.info(f"Adding results file at {path}")
+        return files
+
+    def get_type_list(self, home_type: str) -> set:
+        """Home names of a given type present in EVERY discovered run
+        (dragg/reformat.py:173-194)."""
+        type_list: set = set()
+        for i, file in enumerate(self.files):
+            data = self._load(file["results"])
+            names = {
+                n for n, h in data.items()
+                if isinstance(h, dict) and h.get("type") == home_type
+            }
+            type_list = names if i == 0 else type_list & names
+        return type_list
+
+    # ------------------------------------------------------------- figures
+    def _new_fig(self):
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(12, 7))
+        return fig, ax
+
+    def plot_baseline(self, ax=None):
+        """Aggregate + cumulative community load per run
+        (dragg/reformat.py:311-320)."""
+        fig = None
+        if ax is None:
+            fig, ax = self._new_fig()
+        for file in self.files:
+            data = self._load(file["results"])
+            loads = np.asarray(data["Summary"]["p_grid_aggregate"], dtype=float)
+            x = file["parent"]["x_lims"][: len(loads)]
+            ax.step(x, loads[: len(x)], where="post", label=f"Agg Load - {file['name']}")
+        ax.set_xlabel("Time")
+        ax.set_ylabel("Agg. Demand (kW)")
+        _legend(ax, 8)
+        if fig is not None:
+            fig.suptitle("Aggregate Load Comparison")
+        return fig
+
+    def plot_typ_day(self, ax=None):
+        """Composite (average) daily load profile per run
+        (dragg/reformat.py:322-376)."""
+        fig = None
+        if ax is None:
+            fig, ax = self._new_fig()
+        for file in self.files:
+            data = self._load(file["results"])
+            spd = 24 * file["parent"]["agg_dt"]
+            st = daily_stats(data["Summary"]["p_grid_aggregate"], spd)
+            if not st:
+                self.log.logger.warning(
+                    "Not enough data collected to have daily stats, try running the aggregator for longer."
+                )
+                continue
+            ax.plot(np.arange(spd) / file["parent"]["agg_dt"], st["composite_day"],
+                    alpha=0.6, label=file["name"])
+        ax.set_title("Avg Daily Load Profile")
+        ax.set_xlabel("Time of Day")
+        ax.set_ylabel("Agg. Demand (kW)")
+        _legend(ax, 8)
+        return fig
+
+    def plot_parametric(self, ax=None):
+        """Setpoint + daily max/min/range/avg/std traces per run, and the
+        daily stats table printed to the log (dragg/reformat.py:429-473)."""
+        fig = None
+        if ax is None:
+            fig, ax = self._new_fig()
+        table_rows = []
+        for file in self.files:
+            data = self._load(file["results"])
+            agg_dt = file["parent"]["agg_dt"]
+            spd = 24 * agg_dt
+            loads = np.asarray(data["Summary"]["p_grid_aggregate"], dtype=float)
+            st = daily_stats(loads, spd)
+            table_rows.append((file["name"], st))
+            if not st:
+                continue
+            x = file["parent"]["x_lims"][: len(loads)]
+            sp = np.asarray(data["Summary"].get("p_grid_setpoint", []), dtype=float)
+            if sp.size:
+                ax.plot(x[: sp.size], sp[: len(x)], alpha=0.5,
+                        label=f"{file['name']} - setpoint")
+            # Daily stats cover whole days only; align x to that prefix.
+            n_whole = len(st["daily_max"]) * spd
+            xd = x[:n_whole]
+            per_step = lambda a: np.repeat(a, spd)[: len(xd)]
+            ax.step(xd, per_step(st["daily_max"]), where="post", alpha=0.5,
+                    linestyle=":", label=f"{file['name']} - daily max")
+            ax.step(xd, per_step(st["daily_min"]), where="post", alpha=0.5,
+                    linestyle="--", label=f"{file['name']} - daily min")
+        self.table = stats_table(table_rows)
+        print(self.table)
+        ax.set_ylabel("Agg. Demand (kW)")
+        _legend(ax, 7)
+        return fig
+
+    def rl2baseline(self, ax=None):
+        """Baseline-vs-RL comparison (dragg/reformat.py:475-486)."""
+        fig = None
+        if ax is None:
+            fig, ax = self._new_fig()
+        if not self.files:
+            self.log.logger.warning("No aggregator runs found for analysis.")
+            return fig
+        self.plot_baseline(ax)
+        self.plot_parametric(ax)
+        ax.set_title("RL Baseline Comparison")
+        return fig
+
+    def plot_single_home(self, name: str | None = None, ax=None):
+        """Per-home temperature traces with thermal bounds; PV/battery series
+        when the home has them (dragg/reformat.py:257-296)."""
+        fig = None
+        if ax is None:
+            fig, ax = self._new_fig()
+        if name is None:
+            name = self.sample_home
+        if name is None:
+            candidates = sorted(self.get_type_list("base"))
+            if not candidates:
+                self.log.logger.error("No homes found to plot.")
+                return fig
+            name = candidates[0]
+            self.log.logger.info(f'Proceeding with home: "{name}"')
+        self.sample_home = name
+
+        bounds_drawn = False
+        for file in self.files:
+            comm = self._load(file["results"])
+            if name not in comm:
+                self.log.logger.error(f"No home with name: {name}")
+                continue
+            data = comm[name]
+            x = file["parent"]["x_lims"]
+            nts = min(len(x), len(data["temp_in_opt"]))
+            ax.plot(x[:nts], data["temp_in_opt"][:nts], label=f"Tin - {file['name']}")
+            ax.plot(x[:nts], data["temp_wh_opt"][:nts], label=f"Twh - {file['name']}")
+            if not bounds_drawn:
+                self._thermal_bounds(ax, x, name)
+                bounds_drawn = True
+            if "pv" in data["type"]:
+                ax.step(x[:nts], data["p_pv_opt"][:nts], where="post", alpha=0.5,
+                        label=f"Ppv (kW) - {file['name']}")
+            if "batt" in data["type"]:
+                nb = min(len(x), len(data["e_batt_opt"]))
+                ax.step(x[:nb], data["e_batt_opt"][:nb], where="post", alpha=0.5,
+                        label=f"SOC (kWh) - {file['name']}")
+            ax.set_title(f"{name} - {data['type']} type")
+        ax.set_xlabel("Time of Day (hour)")
+        ax.set_ylabel("Temperature (deg C)")
+        _legend(ax, 7)
+        return fig
+
+    def _thermal_bounds(self, ax, x, name) -> None:
+        """Comfort-band shading from the cached population file
+        (dragg/reformat.py:213-227)."""
+        path = os.path.join(
+            self.outputs_dir,
+            f"all_homes-{self.config['community']['total_number_homes']}-config.json",
+        )
+        if not os.path.isfile(path):
+            return
+        with open(path) as f:
+            homes = json.load(f)
+        home = next((h for h in homes if h["name"] == name), None)
+        if home is None:
+            return
+        ax.fill_between(x, home["hvac"]["temp_in_min"], home["hvac"]["temp_in_max"],
+                        color="lightsteelblue", alpha=0.3, label="Tin bounds")
+        ax.fill_between(x, home["wh"]["temp_wh_min"], home["wh"]["temp_wh_max"],
+                        color="pink", alpha=0.3, label="Twh bounds")
+
+    def all_rps(self, ax=None):
+        """Reward-price histograms per run, with the μ−RP residual histogram
+        when agent telemetry exists (dragg/reformat.py:488-505)."""
+        fig = None
+        if ax is None:
+            fig, ax = self._new_fig()
+        for file in self.files:
+            data = self._load(file["results"])
+            rps = np.asarray(data["Summary"].get("RP", []), dtype=float)
+            if rps.size:
+                ax.hist(rps, bins=30, alpha=0.5, label=file["name"])
+            if "q_results" in file:
+                with open(file["q_results"]) as f:
+                    agent = json.load(f)
+                mu = np.asarray(agent.get("mu", []), dtype=float)
+                if mu.size == rps.size and rps.size:
+                    ax.hist(mu - rps, bins=30, alpha=0.3,
+                            label=f"mu - RP - {file['name']}")
+        ax.set_xlabel("Reward price ($/kWh)")
+        _legend(ax, 8)
+        return fig
+
+    # ----------------------------------------------------------------- main
+    def main(self, save: bool = True) -> list:
+        """Default figure set (dragg/reformat.py:41-47): RL-vs-baseline and a
+        sample home; saves PNGs under outputs/images/<timestamp>/."""
+        figs = [("rl2baseline", self.rl2baseline()),
+                ("single_home", self.plot_single_home()),
+                ("typical_day", self.plot_typ_day()),
+                ("all_rps", self.all_rps())]
+        self.images = [f for _, f in figs if f is not None]
+        if save:
+            self.save_images(figs)
+        return self.images
+
+    def save_images(self, figs=None) -> None:
+        """(dragg/reformat.py:69-78)."""
+        os.makedirs(self.save_path, exist_ok=True)
+        if figs is None:
+            figs = [(f"figure_{i}", f) for i, f in enumerate(self.images)]
+        for title, fig in figs:
+            if fig is None:
+                continue
+            path = os.path.join(self.save_path, f"{title}.png")
+            self.log.logger.info(f"Saving image to {path}.")
+            fig.savefig(path, dpi=100, bbox_inches="tight")

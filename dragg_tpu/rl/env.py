@@ -19,11 +19,15 @@ from dragg_tpu.rl.core import RLObservation
 
 
 class SetpointTracker(NamedTuple):
-    """Device state of ``gen_setpoint`` (dragg/aggregator.py:677-696)."""
+    """Device state of ``gen_setpoint`` (dragg/aggregator.py:677-696).
+
+    Only the trailing-load window matters: the setpoint is its average.  The
+    reference also tracks ``max_load``/``min_load`` instance attributes, but
+    nothing ever consumes them — the host-side ``gen_setpoint`` keeps that
+    bookkeeping for API parity; the device carry does not.
+    """
 
     tracked: jnp.ndarray   # (prev_n,) trailing loads
-    max_load: jnp.ndarray  # ()
-    min_load: jnp.ndarray  # ()
 
 
 def init_tracker(prev_n: int, max_poss_load: float) -> SetpointTracker:
@@ -31,8 +35,6 @@ def init_tracker(prev_n: int, max_poss_load: float) -> SetpointTracker:
     (dragg/aggregator.py:683-686)."""
     return SetpointTracker(
         tracked=jnp.full((prev_n,), 0.5 * max_poss_load, dtype=jnp.float32),
-        max_load=jnp.float32(-jnp.inf),
-        min_load=jnp.float32(jnp.inf),
     )
 
 
@@ -42,11 +44,8 @@ def tracker_step(tr: SetpointTracker, agg_load, timestep) -> tuple[SetpointTrack
     fresh = timestep < 2
     rolled = jnp.concatenate([tr.tracked[1:], jnp.reshape(agg_load, (1,))])
     tracked = jnp.where(fresh, tr.tracked, rolled)
-    day_tick = jnp.mod(timestep, 24) == 0
-    max_load = jnp.where((agg_load > tr.max_load) | day_tick, agg_load, tr.max_load)
-    min_load = jnp.where((agg_load < tr.min_load) | day_tick, agg_load, tr.min_load)
     sp = jnp.mean(tracked)
-    return SetpointTracker(tracked, max_load, min_load), sp
+    return SetpointTracker(tracked), sp
 
 
 class EnvCarry(NamedTuple):

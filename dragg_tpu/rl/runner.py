@@ -49,16 +49,22 @@ def _rl_settings(config: dict):
 # RL aggregator driving the MPC community (case "rl_agg")
 # --------------------------------------------------------------------------
 
-def _fused_step(engine, aparams, dt, norm, max_rp, carry, t):
+def _fused_step(engine, aparams, dt, norm, max_rp, rp_len, carry, t):
     """One fused RL + community-MPC timestep.
 
     Ordering parity with the reference's per-step flow: the agent trains on
     the measurements of the previous step (train → next_action,
-    dragg/agent.py:130-149), the new reward price is broadcast to the fleet
-    (redis_set_current_values, dragg/aggregator.py:664-675; a short rp list
-    broadcasts across the horizon via dragg/mpc_calc.py:353,636), the
-    community solves, and the setpoint tracker advances
-    (collect_data → gen_setpoint, dragg/aggregator.py:726-755).
+    dragg/agent.py:130-149), the new reward price is announced to the fleet
+    (redis_set_current_values, dragg/aggregator.py:664-675), the community
+    solves, and the setpoint tracker advances (collect_data → gen_setpoint,
+    dragg/aggregator.py:726-755).
+
+    ``rp_len = action_horizon·dt`` is the announced-price window.  With the
+    default window of 1 the price broadcasts across the whole MPC horizon —
+    exact parity with the reference's length-1 Redis list broadcasting at
+    dragg/mpc_calc.py:353.  Longer windows price only the first ``rp_len``
+    horizon steps (zero beyond) — a well-defined generalization of a case
+    the reference mis-shapes on.
     """
     cstate, acarry, env = carry
     obs = observe(env, t, dt, norm)
@@ -66,7 +72,10 @@ def _fused_step(engine, aparams, dt, norm, max_rp, carry, t):
     action = jnp.clip(acarry.next_action, aparams.action_low, aparams.action_high)
     rp_scalar = jnp.clip(action, -max_rp, max_rp)
     H = engine.params.horizon
-    rp_vec = jnp.full((H,), rp_scalar, dtype=jnp.float32)
+    if rp_len <= 1 or rp_len >= H:
+        rp_vec = jnp.full((H,), rp_scalar, dtype=jnp.float32)
+    else:
+        rp_vec = jnp.where(jnp.arange(H) < rp_len, rp_scalar, 0.0).astype(jnp.float32)
     cstate, outs = engine._step(cstate, t, rp_vec)
     tracker, sp = tracker_step(env.tracker, outs.agg_load, t + 1)
     new_env = EnvCarry(
@@ -102,7 +111,7 @@ def run_rl_agg(agg) -> None:
 
     step = partial(
         _fused_step, agg.engine, agent.params, agg.engine.params.dt, norm,
-        settings["max_rp"],
+        settings["max_rp"], settings["action_horizon"] * agg.engine.params.dt,
     )
 
     @jax.jit
@@ -110,6 +119,8 @@ def run_rl_agg(agg) -> None:
         return lax.scan(lambda c, t: step(c, t), carry, ts)
 
     agg.checkpoint_interval = agg._checkpoint_steps()
+    if agg.run_dir is None:
+        agg.set_run_dir()
     agg.log.logger.info(
         f"Performing RL AGG run for horizon: {config['home']['hems']['prediction_horizon']}"
     )
@@ -196,37 +207,20 @@ def run_rl_simplified(agg) -> None:
     )
     agent.carry = acarry
     agent.record_chunk(recs)
-    agg.end_time = time.time()
 
-    loads = np.asarray(loads)
-    agg.baseline_agg_load_list = loads.tolist()
+    # Reuse the aggregator's Summary builder + results writer
+    # (summarize_baseline/write_outputs, aggregator.py) — no per-home blocks
+    # exist in this case, only the Summary.
+    agg.collected_data = {}
+    agg._solve_iters = []
+    agg.baseline_agg_load_list = np.asarray(loads).tolist()
     agg.all_rps = np.asarray(rps, dtype=np.float64)
     agg.all_sps = np.asarray(sps, dtype=np.float64)
-
+    agg.extra_summary = {"agg_cost": np.asarray(costs).tolist()}
     if agg.run_dir is None:
         agg.set_run_dir()
+    agg.write_outputs()
+    agg.extra_summary = {}
     case_dir = os.path.join(agg.run_dir, agg.case)
-    os.makedirs(case_dir, exist_ok=True)
-    sim_slice = slice(agg.start_index, agg.start_index + agg.num_timesteps)
-    summary = {
-        "case": agg.case,
-        "start_datetime": agg.start_dt.strftime("%Y-%m-%d %H"),
-        "end_datetime": agg.end_dt.strftime("%Y-%m-%d %H"),
-        "solve_time": agg.end_time - agg.start_time,
-        "horizon": config["home"]["hems"]["prediction_horizon"],
-        "num_homes": n_homes,
-        "p_max_aggregate": float(np.max(loads)) if loads.size else 0.0,
-        "p_grid_aggregate": loads.tolist(),
-        "agg_cost": np.asarray(costs).tolist(),
-        "OAT": agg.env.oat[sim_slice].tolist(),
-        "GHI": agg.env.ghi[sim_slice].tolist(),
-        "TOU": agg.env.tou[sim_slice].tolist(),
-        "RP": agg.all_rps.tolist(),
-        "p_grid_setpoint": agg.all_sps.tolist(),
-    }
-    import json
-
-    with open(os.path.join(case_dir, "results.json"), "w") as f:
-        json.dump({"Summary": summary}, f, indent=4)
     agent.write_rl_data(case_dir)
     agg.agent = agent

@@ -1,0 +1,177 @@
+"""Analysis-layer tests: run discovery, daily statistics, figure generation,
+and the CLI entry point."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dragg_tpu.config import default_config
+from dragg_tpu.reformat import Reformat, daily_stats, stats_table
+
+
+def test_daily_stats_known_values():
+    # Two days of hourly data: day1 = 0..23, day2 = 10s.
+    loads = np.concatenate([np.arange(24.0), np.full(24, 10.0)])
+    st = daily_stats(loads, 24)
+    assert st["daily_max"].tolist() == [23.0, 10.0]
+    assert st["daily_min"].tolist() == [0.0, 10.0]
+    assert st["avg_daily_range"] == pytest.approx((23.0 + 0.0) / 2)
+    assert st["overall_max"] == 23.0
+    np.testing.assert_allclose(
+        st["composite_day"], (np.arange(24.0) + 10.0) / 2
+    )
+
+
+def test_daily_stats_insufficient_data():
+    assert daily_stats(np.arange(10.0), 24) == {}
+
+
+def test_stats_table_formats():
+    st = daily_stats(np.arange(24.0), 24)
+    txt = stats_table([("run-a", st), ("run-b", {})])
+    assert "run-a" in txt and "run-b" in txt
+    assert "23.000" in txt  # overall max
+    assert txt.count("\n") >= 5
+
+
+@pytest.fixture(scope="module")
+def finished_run(tmp_path_factory):
+    """A tiny finished baseline run in a temp outputs dir."""
+    from dragg_tpu.aggregator import Aggregator
+
+    td = tmp_path_factory.mktemp("outputs_root")
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = 3
+    cfg["community"]["homes_pv"] = 1
+    cfg["community"]["homes_battery"] = 1
+    cfg["community"]["homes_pv_battery"] = 0
+    cfg["simulation"]["end_datetime"] = "2015-01-02 00"
+    cfg["simulation"]["run_rl_simplified"] = True
+    cfg["home"]["hems"]["prediction_horizon"] = 2
+    cfg["tpu"]["admm_iters"] = 200
+    out = str(td / "outputs")
+    agg = Aggregator(cfg, data_dir=None, outputs_dir=out)
+    agg.run()
+    return cfg, out, agg
+
+
+def test_discovery_finds_cases(finished_run):
+    cfg, out, agg = finished_run
+    r = Reformat(config=cfg, outputs_dir=out)
+    cases = {f["case"] for f in r.files}
+    assert cases == {"baseline", "simplified"}
+    # The simplified case carries agent telemetry.
+    simp = next(f for f in r.files if f["case"] == "simplified")
+    assert "q_results" in simp
+
+
+def test_get_type_list(finished_run):
+    cfg, out, agg = finished_run
+    r = Reformat(config=cfg, outputs_dir=out)
+    base_homes = r.get_type_list("base")
+    # simplified results have no per-home data → intersection over runs with
+    # per-home blocks only; baseline has 1 base home.
+    data = json.load(open(next(f for f in r.files if f["case"] == "baseline")["results"]))
+    expected = {n for n, h in data.items() if isinstance(h, dict) and h.get("type") == "base"}
+    assert base_homes <= expected
+
+
+def test_figures_and_save(finished_run):
+    cfg, out, agg = finished_run
+    r = Reformat(config=cfg, outputs_dir=out)
+    figs = r.main(save=True)
+    assert len(figs) >= 3
+    pngs = os.listdir(r.save_path)
+    assert any(p.endswith(".png") for p in pngs)
+    assert hasattr(r, "table") and "baseline" in r.table
+
+
+def test_missing_outputs_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        Reformat(config=default_config(), outputs_dir=str(tmp_path / "nope"))
+
+
+_CLI_TOML = """
+[community]
+total_number_homes = 2
+homes_battery = 0
+homes_pv = 0
+homes_pv_battery = 0
+house_p_avg = 1.2
+
+[simulation]
+start_datetime = "2015-01-01 00"
+end_datetime = "2015-01-01 06"
+random_seed = 12
+check_type = "all"
+run_rbo_mpc = true
+checkpoint_interval = "daily"
+named_version = "test"
+
+[agg]
+base_price = 0.07
+subhourly_steps = 1
+tou_enabled = true
+
+[home.hvac]
+r_dist = [6.8, 9.2]
+c_dist = [4.25, 5.75]
+p_cool_dist = [3.5, 3.5]
+p_heat_dist = [3.5, 3.5]
+temp_sp_dist = [18, 22]
+temp_deadband_dist = [2, 3]
+
+[home.wh]
+r_dist = [18.7, 25.3]
+p_dist = [2.5, 2.5]
+sp_dist = [45.5, 48.5]
+deadband_dist = [9, 12]
+size_dist = [200, 300]
+waterdraw_file = "waterdraw_profiles.csv"
+
+[home.battery]
+max_rate = [3, 5]
+capacity = [9.0, 13.5]
+lower_bound = [0.01, 0.15]
+upper_bound = [0.85, 0.99]
+charge_eff = [0.85, 0.95]
+discharge_eff = [0.97, 0.99]
+
+[home.pv]
+area = [20, 32]
+efficiency = [0.15, 0.2]
+
+[home.hems]
+prediction_horizon = 2
+sub_subhourly_steps = 6
+discount_factor = 0.92
+solver = "admm"
+
+[tpu]
+admm_iters = 200
+"""
+
+
+def test_cli_run_and_reformat(tmp_path):
+    """End-to-end CLI: run a tiny sim from a TOML file, then reformat it —
+    the reference's main.py flow (dragg/main.py:4-17)."""
+    from dragg_tpu.__main__ import main
+
+    cfg_path = str(tmp_path / "config.toml")
+    with open(cfg_path, "w") as f:
+        f.write(_CLI_TOML)
+    out = str(tmp_path / "outputs")
+    assert main(["run", "--config", cfg_path, "--outputs-dir", out]) == 0
+    assert main(["reformat", "--config", cfg_path, "--outputs-dir", out, "--no-save"]) == 0
+
+
+def test_cli_parser():
+    from dragg_tpu.__main__ import build_parser
+
+    p = build_parser()
+    args = p.parse_args(["run", "--outputs-dir", "x"])
+    assert args.cmd == "run" and args.outputs_dir == "x"
+    args = p.parse_args(["reformat", "--home", "Bob-ABCDE", "--no-save"])
+    assert args.cmd == "reformat" and args.home == "Bob-ABCDE"
