@@ -109,6 +109,12 @@ class Aggregator:
         self.start_time = None
         self.end_time = None
         self.extra_summary: dict = {}  # case-specific Summary additions
+        self.resumed_from: str | None = None  # checkpoint dir a run resumed from
+        # Stop after N scan chunks (None = run to completion).  Each chunk
+        # ends at a checkpoint boundary, so stopping here is equivalent to
+        # the process being killed right after a checkpoint — the hook the
+        # resume tests (and operators doing staged runs) use.
+        self.stop_after_chunks: int | None = None
         self.version = self.config["simulation"].get("named_version", "test")
         self.run_dir = None
         self._solve_iters: list[int] = []
@@ -252,6 +258,113 @@ class Aggregator:
             total += max(float(h["hvac"]["p_c"]), float(h["hvac"]["p_h"])) + float(h["wh"]["p"])
         return total
 
+    # ------------------------------------------------------------ checkpoint
+    def _checkpoint_root(self) -> str:
+        return os.path.join(self.run_dir, self.case, "checkpoint")
+
+    def save_checkpoint(self, state, extra_json: dict | None = None) -> None:
+        """Persist the scan carry + host bookkeeping so the run can resume
+        mid-simulation (capability the reference lacks — its checkpoints are
+        write-only outputs, dragg/aggregator.py:776-778).
+
+        Atomicity: each checkpoint is a self-contained versioned directory
+        (state.npz + progress.json + collected.json [+ extras]) staged under
+        a ``.tmp`` name and renamed into place, after which the ``LATEST``
+        pointer is atomically replaced.  A kill at any instant leaves either
+        the previous complete checkpoint or the new complete one — never a
+        torn mix.  results.json stays a user-facing output; resume never
+        reads it."""
+        from dragg_tpu.checkpoint import save_progress, save_pytree
+
+        root = self._checkpoint_root()
+        os.makedirs(root, exist_ok=True)
+        name = f"ckpt_t{self.timestep:08d}"
+        tmp = os.path.join(root, name + ".tmp")
+        if os.path.isdir(tmp):
+            import shutil
+
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        save_pytree(os.path.join(tmp, "state.npz"), state)
+        save_progress(os.path.join(tmp, "collected.json"), self.collected_data)
+        for fname, obj in (extra_json or {}).items():
+            save_progress(os.path.join(tmp, fname), obj)
+        save_progress(os.path.join(tmp, "progress.json"), {
+            "timestep": self.timestep,
+            "elapsed": time.time() - self.start_time,
+            "baseline_agg_load_list": self.baseline_agg_load_list,
+            "all_rps": self.all_rps.tolist(),
+            "all_sps": self.all_sps.tolist(),
+            "solve_iters": self._solve_iters,
+            "tracked_loads": getattr(self, "tracked_loads", None),
+            "max_load": getattr(self, "max_load", None),
+            "min_load": getattr(self, "min_load", None),
+        })
+        final = os.path.join(root, name)
+        os.rename(tmp, final)
+        latest_tmp = os.path.join(root, "LATEST.tmp")
+        with open(latest_tmp, "w") as f:
+            f.write(name)
+        os.replace(latest_tmp, os.path.join(root, "LATEST"))
+        # Prune superseded checkpoints.
+        import shutil
+
+        for entry in os.listdir(root):
+            if entry.startswith("ckpt_") and entry != name:
+                shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
+
+    def clear_checkpoint(self) -> None:
+        """Drop the resume checkpoint once a run completes, so a later
+        invocation with ``resume=true`` starts fresh instead of re-running
+        the final chunk over completed results."""
+        import shutil
+
+        shutil.rmtree(self._checkpoint_root(), ignore_errors=True)
+
+    def _latest_checkpoint_dir(self) -> str | None:
+        root = self._checkpoint_root()
+        pointer = os.path.join(root, "LATEST")
+        if not os.path.isfile(pointer):
+            return None
+        with open(pointer) as f:
+            name = f.read().strip()
+        d = os.path.join(root, name)
+        return d if os.path.isdir(d) else None
+
+    def try_resume(self, template_state):
+        """Restore (state, t) from the latest complete checkpoint if one
+        exists and ``simulation.resume`` is enabled; else (template_state, 0).
+        Sets ``self.resumed_from`` to the checkpoint directory so callers can
+        restore their own extras (e.g. RL agent telemetry)."""
+        from dragg_tpu.checkpoint import load_progress, load_pytree
+
+        self.resumed_from = None
+        if not self.config["simulation"].get("resume", False):
+            return template_state, 0
+        d = self._latest_checkpoint_dir()
+        if d is None:
+            return template_state, 0
+        prog = load_progress(os.path.join(d, "progress.json"))
+        state = load_pytree(os.path.join(d, "state.npz"), template_state)
+        collected = load_progress(os.path.join(d, "collected.json"))
+        for name, series in collected.items():
+            if name in self.collected_data:
+                self.collected_data[name].update(series)
+        self.timestep = int(prog["timestep"])
+        self.baseline_agg_load_list = list(prog["baseline_agg_load_list"])
+        self.all_rps = np.asarray(prog["all_rps"], dtype=np.float64)
+        self.all_sps = np.asarray(prog["all_sps"], dtype=np.float64)
+        self._solve_iters = list(prog["solve_iters"])
+        if prog.get("tracked_loads") is not None:
+            self.tracked_loads = list(prog["tracked_loads"])
+            self.max_load = prog["max_load"]
+            self.min_load = prog["min_load"]
+        # Keep cumulative solve_time meaningful across the restart.
+        self.start_time = time.time() - float(prog.get("elapsed", 0.0))
+        self.resumed_from = d
+        self.log.logger.info(f"Resuming {self.case} from timestep {self.timestep}.")
+        return state, self.timestep
+
     # ------------------------------------------------------------------ runs
     def run_baseline(self) -> None:
         """The baseline community simulation (dragg/aggregator.py:757-778):
@@ -259,18 +372,24 @@ class Aggregator:
         horizon_h = self.config["home"]["hems"]["prediction_horizon"]
         self.log.logger.info(f"Performing baseline run for horizon: {horizon_h}")
         self.start_time = time.time()
-        state = self.engine.init_state()
+        state, t = self.try_resume(self.engine.init_state())
         H = self.engine.params.horizon
-        t = 0
+        chunks = 0
         while t < self.num_timesteps:
             n_steps = min(self.checkpoint_interval, self.num_timesteps - t)
             rps = np.zeros((n_steps, H), dtype=np.float32)
             state, outs = self.engine.run_chunk(state, t, rps)
             self._collect_chunk(outs)
             t += n_steps
+            chunks += 1
             if t < self.num_timesteps:
                 self.log.logger.info("Creating a checkpoint file.")
                 self.write_outputs()
+                self.save_checkpoint(state)
+                if self.stop_after_chunks is not None and chunks >= self.stop_after_chunks:
+                    self.log.logger.info(f"Stopping early after {chunks} chunks.")
+                    self._state = state
+                    return
         self._state = state
 
     def check_baseline_vals(self) -> None:
@@ -292,22 +411,25 @@ class Aggregator:
 
     # --------------------------------------------------------------- outputs
     def set_run_dir(self) -> None:
-        """Reference directory layout (dragg/aggregator.py:818-829):
-        outputs/<start>_<end>/<type>-homes_<N>-horizon_<H>-interval_<X>-<Y>-solver_<S>/version-<V>."""
+        """Reference directory layout (dragg/aggregator.py:818-829) via the
+        shared name builder (dragg_tpu.utils.layout) that Reformat's
+        discovery also uses."""
+        from dragg_tpu.utils import date_folder_name, run_dir_name
+
         cfg = self.config
-        date_output = os.path.join(
+        self.run_dir = os.path.join(
             self.outputs_dir,
-            f"{self.start_dt.strftime('%Y-%m-%dT%H')}_{self.end_dt.strftime('%Y-%m-%dT%H')}",
+            date_folder_name(self.start_dt, self.end_dt),
+            run_dir_name(
+                self.check_type,
+                cfg["community"]["total_number_homes"],
+                cfg["home"]["hems"]["prediction_horizon"],
+                self.dt,
+                int(cfg["home"]["hems"]["sub_subhourly_steps"]),
+                cfg["home"]["hems"].get("solver", "admm"),
+            ),
+            f"version-{self.version}",
         )
-        sub = int(cfg["home"]["hems"]["sub_subhourly_steps"])
-        solver = cfg["home"]["hems"].get("solver", "admm")
-        mpc_output = os.path.join(
-            date_output,
-            f"{self.check_type}-homes_{cfg['community']['total_number_homes']}"
-            f"-horizon_{cfg['home']['hems']['prediction_horizon']}"
-            f"-interval_{self.dt_interval}-{self.dt_interval // sub}-solver_{solver}",
-        )
-        self.run_dir = os.path.join(mpc_output, f"version-{self.version}")
         os.makedirs(self.run_dir, exist_ok=True)
 
     def summarize_baseline(self) -> None:
@@ -344,8 +466,11 @@ class Aggregator:
         self.summarize_baseline()
         case_dir = os.path.join(self.run_dir, self.case)
         os.makedirs(case_dir, exist_ok=True)
-        with open(os.path.join(case_dir, "results.json"), "w") as f:
+        path = os.path.join(case_dir, "results.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(self.collected_data, f, indent=4)
+        os.replace(tmp, path)
 
     # ------------------------------------------------------------------- run
     def _checkpoint_steps(self) -> int:
@@ -370,8 +495,12 @@ class Aggregator:
             self._build_engine()
             self.reset_collected_data()
             self.run_baseline()
-            self.check_baseline_vals()
-            self.write_outputs()
+            if self.timestep >= self.num_timesteps:
+                self.check_baseline_vals()
+                self.write_outputs()
+                self.clear_checkpoint()
+            # else: stopped early at a checkpoint boundary — results.json and
+            # the resume checkpoint were already written there.
         if self.config["simulation"].get("run_rl_agg", False):
             from dragg_tpu.rl.runner import run_rl_agg
 

@@ -1,0 +1,66 @@
+"""Checkpoint / resume of device state.
+
+The reference only checkpoints its *outputs*: collected_data is re-serialized
+to results.json every checkpoint interval (dragg/aggregator.py:776-778,
+831-844) but a killed run must restart from t=0.  Here the carried device
+state (the ``CommunityState`` scan carry — thermal/SoC state, fallback plans,
+ADMM warm starts, PRNG key — and, for RL runs, the agent/environment carries)
+is persisted alongside results.json, so a run resumes mid-simulation
+bit-exactly: the same chunked ``lax.scan`` continues from the saved carry.
+
+Format: one ``.npz`` with leaves in ``jax.tree_util.tree_flatten`` order.
+Loading requires a template pytree with the same structure (engines and
+agents can always rebuild their initial carries), which avoids serializing
+tree structure and keeps the format dumb and portable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+
+def save_pytree(path: str, tree) -> None:
+    """Write a pytree of arrays as an npz (leaves in flatten order)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    arrays = {f"leaf_{i:04d}": np.asarray(l) for i, l in enumerate(leaves)}
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, path)
+
+
+def load_pytree(path: str, template):
+    """Load an npz produced by :func:`save_pytree` into ``template``'s
+    structure.  Shapes must match the template's leaves."""
+    data = np.load(path)
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    keys = sorted(data.files)
+    if len(keys) != len(leaves):
+        raise ValueError(
+            f"Checkpoint {path} has {len(keys)} leaves; template has {len(leaves)}"
+        )
+    new_leaves = []
+    for key, tmpl in zip(keys, leaves):
+        arr = data[key]
+        tshape = np.shape(tmpl)
+        if tuple(arr.shape) != tuple(tshape):
+            raise ValueError(
+                f"Checkpoint leaf {key} shape {arr.shape} != template {tshape}"
+            )
+        new_leaves.append(jax.numpy.asarray(arr, dtype=np.asarray(tmpl).dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def save_progress(path: str, progress: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(progress, f)
+    os.replace(tmp, path)
+
+
+def load_progress(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

@@ -178,16 +178,18 @@ class Reformat:
 
     def set_mpc_folders(self) -> list[dict]:
         """(dragg/reformat.py:125-142)."""
+        from dragg_tpu.utils import run_dir_name
+
         found = []
         for j in self.date_folders:
             for p in self._permute(self.mpc_params):
                 folder = os.path.join(
                     j["folder"],
-                    f"{p['check_type']}-homes_{p['n_houses']}"
-                    f"-horizon_{p['mpc_prediction_horizons']}"
-                    f"-interval_{60 // p['agg_interval']}"
-                    f"-{60 // p['mpc_hourly_steps'] // p['agg_interval']}"
-                    f"-solver_{p['solver']}",
+                    run_dir_name(
+                        p["check_type"], p["n_houses"],
+                        p["mpc_prediction_horizons"], p["agg_interval"],
+                        p["mpc_hourly_steps"], p["solver"],
+                    ),
                 )
                 if os.path.isdir(folder):
                     timesteps = j["hours"] * p["agg_interval"]
@@ -226,15 +228,20 @@ class Reformat:
     def get_type_list(self, home_type: str) -> set:
         """Home names of a given type present in EVERY discovered run
         (dragg/reformat.py:173-194)."""
-        type_list: set = set()
-        for i, file in enumerate(self.files):
+        type_list: set | None = None
+        for file in self.files:
             data = self._load(file["results"])
+            # Skip Summary-only runs (e.g. the simplified-response case has
+            # no per-home blocks) — they would empty the intersection.
+            if not any(isinstance(h, dict) and "type" in h for n, h in data.items()
+                       if n != "Summary"):
+                continue
             names = {
                 n for n, h in data.items()
                 if isinstance(h, dict) and h.get("type") == home_type
             }
-            type_list = names if i == 0 else type_list & names
-        return type_list
+            type_list = names if type_list is None else type_list & names
+        return type_list or set()
 
     # ------------------------------------------------------------- figures
     def _new_fig(self):

@@ -15,6 +15,7 @@ becomes a pure carry with zero host↔device round-trips inside a chunk.
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from functools import partial
@@ -59,12 +60,12 @@ def _fused_step(engine, aparams, dt, norm, max_rp, rp_len, carry, t):
     solves, and the setpoint tracker advances (collect_data → gen_setpoint,
     dragg/aggregator.py:726-755).
 
-    ``rp_len = action_horizon·dt`` is the announced-price window.  With the
-    default window of 1 the price broadcasts across the whole MPC horizon —
-    exact parity with the reference's length-1 Redis list broadcasting at
-    dragg/mpc_calc.py:353.  Longer windows price only the first ``rp_len``
-    horizon steps (zero beyond) — a well-defined generalization of a case
-    the reference mis-shapes on.
+    ``rp_len = action_horizon·dt`` is the announced-price window.  A
+    single-hour announcement (action_horizon ≤ 1, i.e. rp_len ≤ dt)
+    broadcasts across the whole MPC horizon — parity with the reference's
+    length-1 Redis list broadcasting at dragg/mpc_calc.py:353.  Multi-hour
+    windows price only the first ``rp_len`` horizon steps (zero beyond) — a
+    well-defined generalization of a case the reference mis-shapes on.
     """
     cstate, acarry, env = carry
     obs = observe(env, t, dt, norm)
@@ -72,7 +73,7 @@ def _fused_step(engine, aparams, dt, norm, max_rp, rp_len, carry, t):
     action = jnp.clip(acarry.next_action, aparams.action_low, aparams.action_high)
     rp_scalar = jnp.clip(action, -max_rp, max_rp)
     H = engine.params.horizon
-    if rp_len <= 1 or rp_len >= H:
+    if rp_len <= dt or rp_len >= H:
         rp_vec = jnp.full((H,), rp_scalar, dtype=jnp.float32)
     else:
         rp_vec = jnp.where(jnp.arange(H) < rp_len, rp_scalar, 0.0).astype(jnp.float32)
@@ -125,8 +126,16 @@ def run_rl_agg(agg) -> None:
         f"Performing RL AGG run for horizon: {config['home']['hems']['prediction_horizon']}"
     )
     agg.start_time = time.time()
-    carry = (cstate, acarry, env)
-    t = 0
+    case_dir = os.path.join(agg.run_dir, agg.case)
+    carry, t = agg.try_resume((cstate, acarry, env))
+    if agg.resumed_from is not None:
+        # Restore the agent's telemetry saved inside the same atomic
+        # checkpoint directory.
+        rl_file = os.path.join(agg.resumed_from, "rl_data.json")
+        if os.path.isfile(rl_file):
+            with open(rl_file) as f:
+                agent.rl_data = json.load(f)
+    chunks = 0
     while t < agg.num_timesteps:
         n_steps = min(agg.checkpoint_interval, agg.num_timesteps - t)
         carry, (outs, recs, rps, sps) = chunk(carry, jnp.arange(t, t + n_steps))
@@ -135,13 +144,20 @@ def run_rl_agg(agg) -> None:
         agg.all_rps[t:t + n_steps] = np.asarray(rps)
         agg.all_sps[t:t + n_steps] = np.asarray(sps)
         t += n_steps
+        chunks += 1
         if t < agg.num_timesteps:
             agg.write_outputs()
+            agg.save_checkpoint(carry, extra_json={"rl_data.json": agent.rl_data})
+            if agg.stop_after_chunks is not None and chunks >= agg.stop_after_chunks:
+                agg.log.logger.info(f"Stopping early after {chunks} chunks.")
+                agg._state, agent.carry, _ = carry
+                agg.agent = agent
+                return
     agg._state, agent.carry, _ = carry
     agg.check_baseline_vals()
     agg.write_outputs()
-    case_dir = os.path.join(agg.run_dir, agg.case)
     agent.write_rl_data(case_dir)
+    agg.clear_checkpoint()
     agg.agent = agent
 
 

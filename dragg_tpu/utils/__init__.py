@@ -1,0 +1,5 @@
+"""Shared small utilities."""
+
+from dragg_tpu.utils.layout import date_folder_name, run_dir_name
+
+__all__ = ["date_folder_name", "run_dir_name"]

@@ -71,11 +71,12 @@ def test_get_type_list(finished_run):
     cfg, out, agg = finished_run
     r = Reformat(config=cfg, outputs_dir=out)
     base_homes = r.get_type_list("base")
-    # simplified results have no per-home data → intersection over runs with
-    # per-home blocks only; baseline has 1 base home.
+    # Summary-only runs (the simplified case) must NOT empty the
+    # intersection: the result equals the baseline's base homes exactly.
     data = json.load(open(next(f for f in r.files if f["case"] == "baseline")["results"]))
     expected = {n for n, h in data.items() if isinstance(h, dict) and h.get("type") == "base"}
-    assert base_homes <= expected
+    assert base_homes == expected
+    assert len(base_homes) >= 1
 
 
 def test_figures_and_save(finished_run):
