@@ -96,7 +96,6 @@ class Aggregator:
         self.engine: Engine | None = None
         self._state = None
         self.timestep = 0
-        self.collected_data: dict = {}
         self.baseline_agg_load_list: list[float] = []
         self.all_rps = np.zeros(self.num_timesteps)
         self.all_sps = np.zeros(self.num_timesteps)
@@ -110,6 +109,9 @@ class Aggregator:
         self.end_time = None
         self.extra_summary: dict = {}  # case-specific Summary additions
         self.resumed_from: str | None = None  # checkpoint dir a run resumed from
+        self.collector = None  # SeriesCollector, built by reset_collected_data
+        self._home_static: dict = {}
+        self.summary_only_case = False  # simplified case: no per-home blocks
         # Stop after N scan chunks (None = run to completion).  Each chunk
         # ends at a checkpoint boundary, so stopping here is equivalent to
         # the process being killed right after a checkpoint — the hook the
@@ -159,60 +161,66 @@ class Aggregator:
         self.engine = make_engine(batch, self.env, self.config, self.start_index)
 
     # ------------------------------------------------------------- data mgmt
+    def _home_selected(self, home: dict) -> bool:
+        """check_type selection (dragg/aggregator.py:767-770)."""
+        return self.check_type == "all" or home["type"] == self.check_type
+
+    def _home_keys(self, home: dict) -> list[str]:
+        keys = list(_BASE_KEYS)
+        if "pv" in home["type"]:
+            keys += list(_PV_KEYS)
+        if "battery" in home["type"]:
+            keys += list(_BATT_KEYS)
+        return keys
+
     def reset_collected_data(self) -> None:
-        """Initialize the per-home series dict (dragg/aggregator.py:589-615)."""
+        """Initialize the per-home series store (dragg/aggregator.py:589-615).
+
+        Series live in a :class:`~dragg_tpu.native.SeriesCollector` (C++
+        when the native library builds, pure-Python otherwise — identical
+        API), which is the single source of truth for per-home time series;
+        static per-home fields stay in ``self._home_static``."""
+        from dragg_tpu.native import SeriesCollector
+
         self.timestep = 0
         self.baseline_agg_load_list = []
-        self.collected_data = {}
         self._solve_iters = []
-        for home in self.all_homes:
-            d = {
+        if getattr(self, "collector", None) is not None:
+            self.collector.close()
+        n = len(self.all_homes)
+        self.collector = SeriesCollector(n)
+        self._home_static = {}
+        temp_in_init = np.zeros((1, n))
+        temp_wh_init = np.zeros((1, n))
+        e_batt_init = np.zeros((1, n))
+        for i, home in enumerate(self.all_homes):
+            self._home_static[home["name"]] = {
                 "type": home["type"],
                 "temp_in_sp": home["hvac"]["temp_in_sp"],
                 "temp_wh_sp": home["wh"]["temp_wh_sp"],
-                "temp_in_opt": [home["hvac"]["temp_in_init"]],
-                "temp_wh_opt": [home["wh"]["temp_wh_init"]],
-                "p_grid_opt": [],
-                "forecast_p_grid_opt": [],
-                "p_load_opt": [],
-                "hvac_cool_on_opt": [],
-                "hvac_heat_on_opt": [],
-                "wh_heat_on_opt": [],
-                "cost_opt": [],
-                "waterdraws": [],
-                "correct_solve": [],
             }
-            if "pv" in home["type"]:
-                d["p_pv_opt"] = []
-                d["u_pv_curt_opt"] = []
+            temp_in_init[0, i] = home["hvac"]["temp_in_init"]
+            temp_wh_init[0, i] = home["wh"]["temp_wh_init"]
             if "battery" in home["type"]:
-                d["e_batt_opt"] = [home["battery"]["e_batt_init"]]
-                d["p_batt_ch"] = []
-                d["p_batt_disch"] = []
-            self.collected_data[home["name"]] = d
+                e_batt_init[0, i] = home["battery"]["e_batt_init"]
+        # Leading initial elements (dragg/aggregator.py:600-603,612).
+        self.collector.add_chunk("temp_in_opt", temp_in_init)
+        self.collector.add_chunk("temp_wh_opt", temp_wh_init)
+        self.collector.add_chunk("e_batt_opt", e_batt_init)
 
     def _collect_chunk(self, outs: StepOutputs, track_setpoints: bool = True) -> None:
-        """Append a chunk of stacked step outputs to collected_data — the
+        """Append a chunk of stacked step outputs to the series store — the
         analog of per-step ``collect_data`` Redis reads
-        (dragg/aggregator.py:728-755), amortized over the whole chunk.
+        (dragg/aggregator.py:728-755), amortized over the whole chunk: one
+        native append per (series, chunk) instead of per-home Python loops.
 
         ``track_setpoints=False`` skips the host-side ``gen_setpoint`` loop:
         the RL-aggregator scan already tracks the setpoint on device and
         overwrites ``all_sps`` with the authoritative values."""
         host = {f: np.asarray(getattr(outs, f)) for f in StepOutputs._fields}
         n_steps = host["p_grid"].shape[0]
-        for i, home in enumerate(self.all_homes):
-            if not (self.check_type == "all" or home["type"] == self.check_type):
-                continue
-            d = self.collected_data[home["name"]]
-            for out_key, field in _BASE_KEYS.items():
-                d[out_key].extend(float(v) for v in host[field][:, i])
-            if "pv" in home["type"]:
-                for out_key, field in _PV_KEYS.items():
-                    d[out_key].extend(float(v) for v in host[field][:, i])
-            if "battery" in home["type"]:
-                for out_key, field in _BATT_KEYS.items():
-                    d[out_key].extend(float(v) for v in host[field][:, i])
+        for out_key, field in (*_BASE_KEYS.items(), *_PV_KEYS.items(), *_BATT_KEYS.items()):
+            self.collector.add_chunk(out_key, host[field])
         agg_loads = host["agg_load"]
         self.baseline_agg_load_list.extend(float(v) for v in agg_loads)
         self._solve_iters.extend(int(v) for v in host["admm_iters"])
@@ -286,7 +294,8 @@ class Aggregator:
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         save_pytree(os.path.join(tmp, "state.npz"), state)
-        save_progress(os.path.join(tmp, "collected.json"), self.collected_data)
+        self.collector.write_json(os.path.join(tmp, "collected.json"),
+                                  self._results_plan(None))
         for fname, obj in (extra_json or {}).items():
             save_progress(os.path.join(tmp, fname), obj)
         save_progress(os.path.join(tmp, "progress.json"), {
@@ -347,9 +356,13 @@ class Aggregator:
         prog = load_progress(os.path.join(d, "progress.json"))
         state = load_pytree(os.path.join(d, "state.npz"), template_state)
         collected = load_progress(os.path.join(d, "collected.json"))
-        for name, series in collected.items():
-            if name in self.collected_data:
-                self.collected_data[name].update(series)
+        for i, home in enumerate(self.all_homes):
+            series = collected.get(home["name"])
+            if not series or not self._home_selected(home):
+                continue
+            for key, values in series.items():
+                if isinstance(values, list):
+                    self.collector.import_series(key, i, values)
         self.timestep = int(prog["timestep"])
         self.baseline_agg_load_list = list(prog["baseline_agg_load_list"])
         self.all_rps = np.asarray(prog["all_rps"], dtype=np.float64)
@@ -395,19 +408,14 @@ class Aggregator:
     def check_baseline_vals(self) -> None:
         """Result-shape check over the check_type-selected homes
         (dragg/aggregator.py:698-709)."""
-        selected = {
-            h["name"] for h in self.all_homes
-            if self.check_type == "all" or h["type"] == self.check_type
-        }
-        for home, vals in self.collected_data.items():
-            if home == "Summary" or home not in selected:
+        for i, home in enumerate(self.all_homes):
+            if not self._home_selected(home):
                 continue
-            for k, v2 in vals.items():
-                if not isinstance(v2, list):
-                    continue
+            for k in self._home_keys(home):
                 want = self.num_timesteps + 1 if k in ("temp_in_opt", "temp_wh_opt", "e_batt_opt") else self.num_timesteps
-                if len(v2) != want:
-                    self.log.logger.error(f"Incorrect number of hours. {home}: {k} {len(v2)}")
+                got = self.collector.length(k, i)
+                if got != want:
+                    self.log.logger.error(f"Incorrect number of hours. {home['name']}: {k} {got}")
 
     # --------------------------------------------------------------- outputs
     def set_run_dir(self) -> None:
@@ -432,14 +440,14 @@ class Aggregator:
         )
         os.makedirs(self.run_dir, exist_ok=True)
 
-    def summarize_baseline(self) -> None:
+    def summarize_baseline(self) -> dict:
         """Build the Summary block (dragg/aggregator.py:783-816)."""
         self.end_time = time.time()
         t_diff = self.end_time - self.start_time
         cfg = self.config
         sim_slice = slice(self.start_index, self.start_index + self.num_timesteps)
         self.max_agg_load = max(self.baseline_agg_load_list) if self.baseline_agg_load_list else 0.0
-        self.collected_data["Summary"] = {
+        summary = {
             "case": self.case,
             "start_datetime": self.start_dt.strftime("%Y-%m-%d %H"),
             "end_datetime": self.end_dt.strftime("%Y-%m-%d %H"),
@@ -457,20 +465,63 @@ class Aggregator:
         }
         # The reference wraps the price series in a 1-tuple — a trailing-comma
         # bug (dragg/aggregator.py:814-816) we do NOT reproduce.
-        self.collected_data["Summary"]["TOU"] = self.env.tou[sim_slice].tolist()
-        self.collected_data["Summary"].update(self.extra_summary)
+        summary["TOU"] = self.env.tou[sim_slice].tolist()
+        summary.update(self.extra_summary)
+        return summary
+
+    def _results_plan(self, summary: dict | None) -> list[tuple]:
+        """Build the streaming write plan for results.json: raw JSON
+        fragments for structure/static fields, series references for the
+        hot numeric arrays (expanded by the native writer)."""
+        plan: list[tuple] = [("raw", "{")]
+        first = True
+        if self.all_homes:
+            for i, home in enumerate(self.all_homes):
+                if not first:
+                    plan.append(("raw", ", "))
+                first = False
+                statics = self._home_static[home["name"]]
+                frag = json.dumps(home["name"]) + ": {"
+                frag += ", ".join(
+                    f"{json.dumps(k)}: {json.dumps(v)}" for k, v in statics.items()
+                )
+                plan.append(("raw", frag))
+                selected = self._home_selected(home)
+                for key in self._home_keys(home):
+                    plan.append(("raw", f", {json.dumps(key)}: "))
+                    if selected:
+                        plan.append(("series", key, i))
+                    elif key == "temp_in_opt":
+                        plan.append(("raw", json.dumps([home["hvac"]["temp_in_init"]])))
+                    elif key == "temp_wh_opt":
+                        plan.append(("raw", json.dumps([home["wh"]["temp_wh_init"]])))
+                    elif key == "e_batt_opt":
+                        plan.append(("raw", json.dumps([home["battery"]["e_batt_init"]])))
+                    else:
+                        plan.append(("raw", "[]"))
+                plan.append(("raw", "}"))
+        if summary is not None:
+            if not first:
+                plan.append(("raw", ", "))
+            plan.append(("raw", '"Summary": ' + json.dumps(summary)))
+        plan.append(("raw", "}"))
+        return plan
 
     def write_outputs(self) -> None:
-        """Serialize collected_data → <run_dir>/<case>/results.json
-        (dragg/aggregator.py:831-844)."""
-        self.summarize_baseline()
+        """Serialize per-home series + Summary → <run_dir>/<case>/results.json
+        (dragg/aggregator.py:831-844), streamed by the native writer."""
+        summary = self.summarize_baseline()
         case_dir = os.path.join(self.run_dir, self.case)
         os.makedirs(case_dir, exist_ok=True)
         path = os.path.join(case_dir, "results.json")
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.collected_data, f, indent=4)
-        os.replace(tmp, path)
+        include_homes = self.all_homes is not None and not self.summary_only_case
+        if include_homes:
+            self.collector.write_json(path, self._results_plan(summary))
+        else:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"Summary": summary}, f, indent=4)
+            os.replace(tmp, path)
 
     # ------------------------------------------------------------------- run
     def _checkpoint_steps(self) -> int:
@@ -499,12 +550,17 @@ class Aggregator:
                 self.check_baseline_vals()
                 self.write_outputs()
                 self.clear_checkpoint()
-            # else: stopped early at a checkpoint boundary — results.json and
-            # the resume checkpoint were already written there.
+            else:
+                # Stopped early at a checkpoint boundary — results.json and
+                # the resume checkpoint were already written there.  Behave
+                # like a kill: do not fall through to the RL cases.
+                return
         if self.config["simulation"].get("run_rl_agg", False):
             from dragg_tpu.rl.runner import run_rl_agg
 
             run_rl_agg(self)
+            if self.timestep < self.num_timesteps:
+                return  # halted at a checkpoint boundary (see above)
         if self.config["simulation"].get("run_rl_simplified", False):
             from dragg_tpu.rl.runner import run_rl_simplified
 

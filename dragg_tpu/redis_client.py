@@ -1,0 +1,37 @@
+"""Reference-compatible state-bus client (L4 parity).
+
+The reference's ``RedisClient`` is a singleton wrapping a redis-py
+connection pool to an external C Redis server (dragg/redis_client.py:4-25).
+Here the same API fronts the in-process C++ state bus
+(:mod:`dragg_tpu.native`) — no server, no TCP, no serialization across a
+socket — so orchestration code written against the reference's client
+(``RedisClient().conn.hset/hgetall/rpush/lrange/...``) runs unchanged.
+
+The TPU engine itself never touches this bus (community state is device
+arrays; SURVEY.md §2.2 "Redis server → eliminated on-device"); it exists
+for reference-parity tooling and host-side CPU-reference mode.
+"""
+
+from __future__ import annotations
+
+from dragg_tpu.native import StateBus
+
+
+class Singleton(type):
+    """Same singleton metaclass shape as the reference
+    (dragg/redis_client.py:4-11)."""
+
+    _instances: dict = {}
+
+    def __call__(cls, *args, **kwargs):
+        if cls not in cls._instances:
+            cls._instances[cls] = super().__call__(*args, **kwargs)
+        return cls._instances[cls]
+
+
+class RedisClient(metaclass=Singleton):
+    """Singleton exposing ``.conn`` with the Redis verbs the reference uses
+    (dragg/redis_client.py:13-25)."""
+
+    def __init__(self):
+        self.conn = StateBus()

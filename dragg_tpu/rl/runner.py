@@ -227,16 +227,17 @@ def run_rl_simplified(agg) -> None:
     # Reuse the aggregator's Summary builder + results writer
     # (summarize_baseline/write_outputs, aggregator.py) — no per-home blocks
     # exist in this case, only the Summary.
-    agg.collected_data = {}
     agg._solve_iters = []
     agg.baseline_agg_load_list = np.asarray(loads).tolist()
     agg.all_rps = np.asarray(rps, dtype=np.float64)
     agg.all_sps = np.asarray(sps, dtype=np.float64)
     agg.extra_summary = {"agg_cost": np.asarray(costs).tolist()}
+    agg.summary_only_case = True
     if agg.run_dir is None:
         agg.set_run_dir()
     agg.write_outputs()
     agg.extra_summary = {}
+    agg.summary_only_case = False
     case_dir = os.path.join(agg.run_dir, agg.case)
     agent.write_rl_data(case_dir)
     agg.agent = agent

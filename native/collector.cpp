@@ -1,0 +1,218 @@
+// collector — native per-home time-series accumulation + streaming
+// results.json writer.
+//
+// The reference's collect path reads every home's Redis hash and appends
+// Python floats list-by-list each timestep (dragg/aggregator.py:728-755),
+// then re-serializes the whole collected_data dict to JSON every checkpoint
+// interval (dragg/aggregator.py:831-844).  At 10k–100k homes both become
+// host bottlenecks.  Here chunked device outputs land as one memcpy-like
+// append per (series, chunk), and the JSON writer streams number formatting
+// with C++17 std::to_chars (shortest round-trip, Python-json compatible).
+//
+// The writer takes a length-prefixed "plan" composed by Python — raw JSON
+// fragments (object keys, static fields, the Summary block) interleaved
+// with series references — so all schema knowledge stays in Python and the
+// native side only does the hot work: buffering doubles and printing them.
+//
+// Plan format (bytes):
+//   'R' ' ' <len> '\n' <len raw bytes>            — write bytes verbatim
+//   'S' ' ' <len> ' ' <home_idx> '\n' <len key bytes>
+//                                                  — write JSON array of
+//                                                    series[key][home_idx]
+// Records repeat until the plan ends.
+
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Collector {
+    // series[key] is a column store: per home, a growing vector<double>.
+    std::map<std::string, std::vector<std::vector<double>>> series;
+    int64_t n_homes = 0;
+};
+
+std::mutex g_mu;
+std::map<int64_t, Collector *> g_cols;
+int64_t g_next = 1;
+
+Collector *get(int64_t h) {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = g_cols.find(h);
+    return it == g_cols.end() ? nullptr : it->second;
+}
+
+void write_double(std::string &out, double v) {
+    // Non-finite values use Python json's literals (NaN/Infinity), which
+    // json.load round-trips; std::to_chars would emit "nan"/"inf", which it
+    // rejects.
+    if (std::isnan(v)) {
+        out.append("NaN");
+        return;
+    }
+    if (std::isinf(v)) {
+        out.append(v < 0 ? "-Infinity" : "Infinity");
+        return;
+    }
+    char buf[32];
+    auto res = std::to_chars(buf, buf + sizeof buf, v);
+    out.append(buf, res.ptr);
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t col_new(int64_t n_homes) {
+    auto *c = new Collector();
+    c->n_homes = n_homes;
+    std::lock_guard<std::mutex> lock(g_mu);
+    int64_t h = g_next++;
+    g_cols[h] = c;
+    return h;
+}
+
+void col_free(int64_t h) {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = g_cols.find(h);
+    if (it != g_cols.end()) {
+        delete it->second;
+        g_cols.erase(it);
+    }
+}
+
+// Append a (n_steps, n_homes) row-major chunk to series `key`.
+int col_add_chunk(int64_t h, const char *key, const double *data,
+                  int64_t n_steps, int64_t n_homes) {
+    Collector *c = get(h);
+    if (c == nullptr || n_homes != c->n_homes) return -1;
+    auto &cols = c->series[key];
+    if (cols.empty()) cols.resize(static_cast<size_t>(n_homes));
+    for (int64_t i = 0; i < n_homes; ++i) {
+        auto &v = cols[static_cast<size_t>(i)];
+        size_t old = v.size();
+        v.resize(old + static_cast<size_t>(n_steps));
+        for (int64_t t = 0; t < n_steps; ++t) {
+            v[old + static_cast<size_t>(t)] = data[t * n_homes + i];
+        }
+    }
+    return 0;
+}
+
+// Replace series[key][home_idx] wholesale (checkpoint import).
+int col_import_series(int64_t h, const char *key, int64_t home_idx,
+                      const double *data, int64_t n) {
+    Collector *c = get(h);
+    if (c == nullptr || home_idx < 0 || home_idx >= c->n_homes) return -1;
+    auto &cols = c->series[key];
+    if (cols.empty()) cols.resize(static_cast<size_t>(c->n_homes));
+    auto &v = cols[static_cast<size_t>(home_idx)];
+    v.assign(data, data + n);
+    return 0;
+}
+
+int64_t col_series_len(int64_t h, const char *key, int64_t home_idx) {
+    Collector *c = get(h);
+    if (c == nullptr) return -1;
+    auto it = c->series.find(key);
+    if (it == c->series.end() || home_idx < 0 ||
+        home_idx >= static_cast<int64_t>(it->second.size())) {
+        return 0;
+    }
+    return static_cast<int64_t>(it->second[static_cast<size_t>(home_idx)].size());
+}
+
+// Copy series[key][home_idx] into out (caller-allocated, cap doubles).
+int64_t col_get_series(int64_t h, const char *key, int64_t home_idx,
+                       double *out, int64_t cap) {
+    Collector *c = get(h);
+    if (c == nullptr) return -1;
+    auto it = c->series.find(key);
+    if (it == c->series.end() || home_idx < 0 ||
+        home_idx >= static_cast<int64_t>(it->second.size())) {
+        return 0;
+    }
+    const auto &v = it->second[static_cast<size_t>(home_idx)];
+    int64_t n = static_cast<int64_t>(v.size());
+    if (n > cap) n = cap;
+    std::memcpy(out, v.data(), static_cast<size_t>(n) * sizeof(double));
+    return n;
+}
+
+// Execute a write plan (see header comment).  Returns 0 on success.
+int col_write_json(int64_t h, const char *path, const char *plan,
+                   int64_t plan_len) {
+    Collector *c = get(h);
+    if (c == nullptr) return -1;
+    std::string tmp_path = std::string(path) + ".tmp";
+    std::FILE *f = std::fopen(tmp_path.c_str(), "wb");
+    if (f == nullptr) return -2;
+
+    std::string buf;
+    buf.reserve(1 << 20);
+    const char *p = plan;
+    const char *end = plan + plan_len;
+    int rc = 0;
+    while (p < end && rc == 0) {
+        char kind = *p;
+        p += 2;  // skip kind + space
+        char *after = nullptr;
+        long long len = std::strtoll(p, &after, 10);
+        p = after;
+        long long home_idx = -1;
+        if (kind == 'S') {
+            home_idx = std::strtoll(p, &after, 10);
+            p = after;
+        }
+        if (p >= end || *p != '\n') { rc = -3; break; }
+        ++p;
+        if (p + len > end) { rc = -3; break; }
+        if (kind == 'R') {
+            buf.append(p, static_cast<size_t>(len));
+        } else if (kind == 'S') {
+            std::string key(p, static_cast<size_t>(len));
+            auto it = c->series.find(key);
+            buf.push_back('[');
+            if (it != c->series.end() && home_idx >= 0 &&
+                home_idx < static_cast<int64_t>(it->second.size())) {
+                const auto &v = it->second[static_cast<size_t>(home_idx)];
+                for (size_t i = 0; i < v.size(); ++i) {
+                    if (i != 0) buf.append(", ");
+                    write_double(buf, v[i]);
+                }
+            }
+            buf.push_back(']');
+        } else {
+            rc = -3;
+            break;
+        }
+        p += len;
+        if (buf.size() > (1 << 20)) {
+            if (std::fwrite(buf.data(), 1, buf.size(), f) != buf.size()) rc = -5;
+            buf.clear();
+        }
+    }
+    if (rc == 0 && !buf.empty()) {
+        if (std::fwrite(buf.data(), 1, buf.size(), f) != buf.size()) rc = -5;
+    }
+    // A short write or close failure (e.g. ENOSPC) must NOT rename a
+    // truncated file into place — the checkpoint atomicity contract depends
+    // on it.
+    if (std::fclose(f) != 0 && rc == 0) rc = -5;
+    if (rc == 0) {
+        if (std::rename(tmp_path.c_str(), path) != 0) rc = -4;
+    }
+    if (rc != 0) {
+        std::remove(tmp_path.c_str());
+    }
+    return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,190 @@
+// statebus — in-process C++ replacement for the reference's Redis server.
+//
+// The reference routes ALL inter-component state through a C Redis server
+// over TCP (dragg/redis_client.py:13-25; schema: series lists, the
+// current_values hash, the reward_price list, per-home result hashes —
+// dragg/aggregator.py:640-675, dragg/mpc_calc.py:100-132).  The TPU-native
+// engine eliminates that bus from the hot loop entirely (state is device
+// arrays), but the host runtime still offers the same verbs for
+// reference-compatible orchestration and for multi-process CPU-reference
+// mode: set/get, hset/hget/hgetall, rpush/lrange/llen, del, flushall.
+//
+// Design: one process-wide store keyed by (db, key); values are either a
+// string, a vector<string> (list), or an unordered_map<string,string>
+// (hash) — exactly Redis's model restricted to the verbs the reference
+// uses.  Thread-safe via a shared_mutex (readers concurrent, writers
+// exclusive), matching the structural race-safety the reference relies on
+// (workers write disjoint keys; readers join first — SURVEY.md §5.2).
+//
+// C ABI: every entry point is extern "C" with C-string I/O so ctypes can
+// bind without any build-time Python dependency.  Returned strings are
+// heap-allocated copies; callers free them with sb_free().
+
+#include <cstring>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Value {
+    // tag: 0 = string, 1 = list, 2 = hash
+    int tag = 0;
+    std::string str;
+    std::vector<std::string> list;
+    // std::map keeps hgetall output deterministic (sorted by field).
+    std::map<std::string, std::string> hash;
+};
+
+struct Store {
+    std::unordered_map<std::string, Value> data;
+    std::shared_mutex mu;
+};
+
+Store &store() {
+    static Store s;
+    return s;
+}
+
+char *dup_cstr(const std::string &s) {
+    char *out = static_cast<char *>(std::malloc(s.size() + 1));
+    if (out != nullptr) {
+        std::memcpy(out, s.c_str(), s.size() + 1);
+    }
+    return out;
+}
+
+// Serialize a list of strings with length prefixes: "<n>\n<len> <bytes>\n...".
+// Length-prefixed framing survives arbitrary payload bytes (values may
+// contain newlines or separators).
+std::string frame(const std::vector<std::pair<std::string, std::string>> &kvs,
+                  bool pairs) {
+    std::string out = std::to_string(kvs.size());
+    out.push_back('\n');
+    for (const auto &kv : kvs) {
+        out += std::to_string(kv.first.size());
+        out.push_back(' ');
+        out += kv.first;
+        out.push_back('\n');
+        if (pairs) {
+            out += std::to_string(kv.second.size());
+            out.push_back(' ');
+            out += kv.second;
+            out.push_back('\n');
+        }
+    }
+    return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void sb_free(char *p) { std::free(p); }
+
+void sb_flushall() {
+    std::unique_lock lock(store().mu);
+    store().data.clear();
+}
+
+void sb_del(const char *key) {
+    std::unique_lock lock(store().mu);
+    store().data.erase(key);
+}
+
+int sb_exists(const char *key) {
+    std::shared_lock lock(store().mu);
+    return store().data.count(key) ? 1 : 0;
+}
+
+// ---------------------------------------------------------------- strings
+void sb_set(const char *key, const char *val) {
+    std::unique_lock lock(store().mu);
+    Value &v = store().data[key];
+    v.tag = 0;
+    v.str = val;
+    v.list.clear();
+    v.hash.clear();
+}
+
+// Returns NULL when the key is absent or not a string.
+char *sb_get(const char *key) {
+    std::shared_lock lock(store().mu);
+    auto it = store().data.find(key);
+    if (it == store().data.end() || it->second.tag != 0) return nullptr;
+    return dup_cstr(it->second.str);
+}
+
+// ----------------------------------------------------------------- hashes
+void sb_hset(const char *key, const char *field, const char *val) {
+    std::unique_lock lock(store().mu);
+    Value &v = store().data[key];
+    if (v.tag != 2) {
+        v = Value{};
+        v.tag = 2;
+    }
+    v.hash[field] = val;
+}
+
+char *sb_hget(const char *key, const char *field) {
+    std::shared_lock lock(store().mu);
+    auto it = store().data.find(key);
+    if (it == store().data.end() || it->second.tag != 2) return nullptr;
+    auto f = it->second.hash.find(field);
+    if (f == it->second.hash.end()) return nullptr;
+    return dup_cstr(f->second);
+}
+
+// Framed "<n>\n<len> field\n<len> value\n..." dump of the hash; NULL if the
+// key is absent or not a hash.
+char *sb_hgetall(const char *key) {
+    std::shared_lock lock(store().mu);
+    auto it = store().data.find(key);
+    if (it == store().data.end() || it->second.tag != 2) return nullptr;
+    std::vector<std::pair<std::string, std::string>> kvs(
+        it->second.hash.begin(), it->second.hash.end());
+    return dup_cstr(frame(kvs, true));
+}
+
+// ------------------------------------------------------------------ lists
+void sb_rpush(const char *key, const char *val) {
+    std::unique_lock lock(store().mu);
+    Value &v = store().data[key];
+    if (v.tag != 1) {
+        v = Value{};
+        v.tag = 1;
+    }
+    v.list.emplace_back(val);
+}
+
+int64_t sb_llen(const char *key) {
+    std::shared_lock lock(store().mu);
+    auto it = store().data.find(key);
+    if (it == store().data.end() || it->second.tag != 1) return 0;
+    return static_cast<int64_t>(it->second.list.size());
+}
+
+// lrange with Redis's inclusive, negative-index semantics.  Framed
+// "<n>\n<len> item\n..."; NULL if absent or not a list.
+char *sb_lrange(const char *key, int64_t start, int64_t stop) {
+    std::shared_lock lock(store().mu);
+    auto it = store().data.find(key);
+    if (it == store().data.end() || it->second.tag != 1) return nullptr;
+    const auto &lst = it->second.list;
+    int64_t n = static_cast<int64_t>(lst.size());
+    if (start < 0) start += n;
+    if (stop < 0) stop += n;
+    if (start < 0) start = 0;
+    if (stop >= n) stop = n - 1;
+    std::vector<std::pair<std::string, std::string>> kvs;
+    for (int64_t i = start; i <= stop && i < n; ++i) {
+        kvs.emplace_back(lst[static_cast<size_t>(i)], std::string());
+    }
+    return dup_cstr(frame(kvs, false));
+}
+
+}  // extern "C"

@@ -1,0 +1,197 @@
+"""Native host-runtime tests: the C++ state bus and series collector, their
+Python fallbacks, and cross-backend equivalence."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from dragg_tpu.native import SeriesCollector, StateBus, load_library
+
+
+def test_native_library_builds():
+    """The image ships g++; the native path must actually be exercised here,
+    not silently fall back."""
+    assert load_library() is not None
+
+
+@pytest.fixture()
+def bus():
+    b = StateBus()
+    b.flushall()
+    yield b
+    b.flushall()
+
+
+def test_statebus_strings_and_hashes(bus):
+    bus.set("start_hour_index", 42)
+    assert bus.get("start_hour_index") == "42"
+    assert bus.get("missing") is None
+    bus.hset("current_values", "timestep", 7)
+    bus.hset("current_values", "iteration", 3)
+    assert bus.hget("current_values", "timestep") == "7"
+    assert bus.hgetall("current_values") == {"timestep": "7", "iteration": "3"}
+    bus.delete("current_values")
+    assert bus.hgetall("current_values") == {}
+
+
+def test_statebus_lists_redis_semantics(bus):
+    vals = [0.0, 0.01, -0.02, 3.5]
+    bus.rpush("reward_price", *vals)
+    assert bus.llen("reward_price") == 4
+    # Redis lrange is inclusive and supports negative indices.
+    assert bus.lrange("reward_price", 0, -1) == [str(v) for v in vals]
+    assert bus.lrange("reward_price", 1, 2) == ["0.01", "-0.02"]
+    assert bus.lrange("reward_price", -2, -1) == ["-0.02", "3.5"]
+    assert bus.lrange("nope", 0, -1) == []
+
+
+def test_statebus_values_with_newlines(bus):
+    """Length-prefixed framing must survive payloads with separators."""
+    bus.hset("h", "a", "line1\nline2")
+    bus.hset("h", "b", "x y z")
+    assert bus.hgetall("h") == {"a": "line1\nline2", "b": "x y z"}
+    bus.rpush("l", "with\nnewline", "with space")
+    assert bus.lrange("l", 0, -1) == ["with\nnewline", "with space"]
+
+
+def test_statebus_process_global(bus):
+    """Every instance sees the same store (Redis-server semantics)."""
+    bus.set("k", "v")
+    assert StateBus().get("k") == "v"
+
+
+def test_statebus_concurrent_disjoint_writers(bus):
+    """The reference's structural race pattern: workers write disjoint hash
+    keys concurrently, reader joins afterwards (SURVEY.md §5.2)."""
+    def worker(i):
+        for t in range(50):
+            bus.hset(f"home_{i}", f"field_{t}", i * 1000 + t)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    for i in range(8):
+        h = bus.hgetall(f"home_{i}")
+        assert len(h) == 50
+        assert h["field_49"] == str(i * 1000 + 49)
+
+
+def test_redis_client_singleton():
+    from dragg_tpu.redis_client import RedisClient
+
+    a = RedisClient()
+    b = RedisClient()
+    assert a is b
+    a.conn.flushall()
+    a.conn.rpush("GHI", 1.0, 2.0)
+    assert b.conn.lrange("GHI", 0, -1) == ["1.0", "2.0"]
+    a.conn.flushall()
+
+
+# --------------------------------------------------------------- collector
+
+def test_collector_chunks_and_export():
+    col = SeriesCollector(3)
+    chunk1 = np.arange(12.0).reshape(4, 3)
+    chunk2 = np.arange(12.0, 18.0).reshape(2, 3)
+    col.add_chunk("p_grid_opt", chunk1)
+    col.add_chunk("p_grid_opt", chunk2)
+    assert col.length("p_grid_opt", 0) == 6
+    np.testing.assert_allclose(col.get("p_grid_opt", 1), [1, 4, 7, 10, 13, 16])
+    col.import_series("p_grid_opt", 1, [9.0, 8.0])
+    assert col.get("p_grid_opt", 1) == [9.0, 8.0]
+    col.close()
+
+
+def test_collector_shape_check():
+    col = SeriesCollector(3)
+    with pytest.raises(ValueError):
+        col.add_chunk("x", np.zeros((2, 4)))
+    col.close()
+
+
+def test_collector_write_json_matches_python_json(tmp_path):
+    """The native streaming writer must produce JSON that parses to exactly
+    the structure Python's json module would emit."""
+    col = SeriesCollector(2)
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(5, 2)) * 1e3
+    ints = np.arange(10.0).reshape(5, 2)
+    col.add_chunk("a", data)
+    col.add_chunk("b", ints)
+    path = str(tmp_path / "out.json")
+    plan = [
+        ("raw", '{"home0": {"type": "base", "a": '),
+        ("series", "a", 0),
+        ("raw", ', "b": '),
+        ("series", "b", 0),
+        ("raw", '}, "home1": {"a": '),
+        ("series", "a", 1),
+        ("raw", "}}"),
+    ]
+    col.write_json(path, plan)
+    with open(path) as f:
+        got = json.load(f)
+    np.testing.assert_allclose(got["home0"]["a"], data[:, 0], rtol=0, atol=0)
+    np.testing.assert_allclose(got["home1"]["a"], data[:, 1], rtol=0, atol=0)
+    assert got["home0"]["b"] == ints[:, 0].tolist()
+    assert got["home0"]["type"] == "base"
+    col.close()
+
+
+def test_collector_native_and_fallback_agree(tmp_path, monkeypatch):
+    """Force the fallback path and compare against the native output."""
+    import dragg_tpu.native as nat
+
+    data = np.linspace(-1, 1, 12).reshape(6, 2) * 1234.5678
+    plan = [("raw", '{"h": '), ("series", "s", 0), ("raw", "}")]
+
+    native_col = SeriesCollector(2)
+    assert native_col.native
+    native_col.add_chunk("s", data)
+    p1 = str(tmp_path / "native.json")
+    native_col.write_json(p1, plan)
+    native_col.close()
+
+    monkeypatch.setattr(nat, "_LIB", None)
+    monkeypatch.setattr(nat, "_LIB_TRIED", True)
+    fb_col = SeriesCollector(2)
+    assert not fb_col.native
+    fb_col.add_chunk("s", data)
+    p2 = str(tmp_path / "fallback.json")
+    fb_col.write_json(p2, plan)
+
+    a = json.load(open(p1))
+    b = json.load(open(p2))
+    assert a == b  # bit-identical doubles through both formatters
+
+
+def test_statebus_fallback_agrees(monkeypatch):
+    import dragg_tpu.native as nat
+
+    monkeypatch.setattr(nat, "_LIB", None)
+    monkeypatch.setattr(nat, "_LIB_TRIED", True)
+    bus = StateBus()
+    assert not bus.native
+    bus.flushall()
+    bus.rpush("l", "a", "b", "c")
+    assert bus.lrange("l", -2, -1) == ["b", "c"]
+    bus.hset("h", "f", 1)
+    assert bus.hgetall("h") == {"f": "1"}
+    bus.flushall()
+
+
+def test_collector_nonfinite_roundtrip(tmp_path):
+    """Non-finite doubles must emit Python-json literals (NaN/Infinity) so
+    results and checkpoints stay loadable."""
+    col = SeriesCollector(1)
+    col.add_chunk("s", np.array([[np.nan], [np.inf], [-np.inf], [1.5]]))
+    path = str(tmp_path / "nf.json")
+    col.write_json(path, [("raw", '{"s": '), ("series", "s", 0), ("raw", "}")])
+    got = json.load(open(path))["s"]
+    assert np.isnan(got[0]) and got[1] == np.inf and got[2] == -np.inf and got[3] == 1.5
+    col.close()
