@@ -132,10 +132,80 @@ def build_tou(
     return tou
 
 
+def load_spp(path: str, load_zone: str, dt: int) -> tuple[np.ndarray, datetime]:
+    """Ingest ERCOT DAM Settlement Point Prices (dragg/aggregator.py:167-204,
+    whose implementation is dead code on modern pandas — SURVEY.md §5.6; this
+    is the working equivalent).
+
+    Accepts the ERCOT workbook layout as .xlsx (all sheets concatenated —
+    needs an Excel engine like openpyxl) or a .csv with the same columns:
+    Delivery Date / Hour Ending / Settlement Point / Settlement Point Price.
+    Filters to ``load_zone``, converts $/MWh → $/kWh, shifts Hour Ending to
+    hour-beginning, and repeats hourly prices onto the dt-step grid.
+
+    Returns (prices at dt steps/hour, timestamp of index 0).
+    """
+    if path.endswith(".csv"):
+        df = pd.read_csv(path)
+    else:
+        try:
+            sheets = pd.read_excel(path, sheet_name=None)
+        except ImportError as e:
+            raise RuntimeError(
+                "Reading ERCOT .xlsx needs an Excel engine (openpyxl); "
+                "convert the workbook to .csv with the same columns instead"
+            ) from e
+        df = pd.concat(sheets.values(), ignore_index=True)
+    df = df[df["Settlement Point"] == load_zone].copy()
+    if df.empty:
+        raise ValueError(f"No SPP rows for load zone {load_zone!r} in {path}")
+    # "Hour Ending" is 1..24 (or "01:00".."24:00"); shift to hour-beginning
+    # 0..23 (dragg/aggregator.py:194-196).
+    he = df["Hour Ending"].astype(str).str.replace(":00", "", regex=False)
+    hour = pd.to_numeric(he) - 1
+    ts = pd.to_datetime(df["Delivery Date"]) + pd.to_timedelta(hour, unit="h")
+    spp = df["Settlement Point Price"].astype(float) / 1000.0  # $/MWh → $/kWh
+    out = pd.Series(spp.to_numpy(), index=ts).sort_index()
+    out = out[~out.index.duplicated(keep="first")]  # repeated-hour (DST) rows
+    # Fill interior gaps forward onto a contiguous hourly grid.
+    full = pd.date_range(out.index[0], out.index[-1], freq="h")
+    out = out.reindex(full).ffill()
+    prices = np.repeat(out.to_numpy(), dt)
+    return prices, out.index[0].to_pydatetime()
+
+
+def synth_spp(start: datetime, days: int, dt: int, seed: int = 0) -> np.ndarray:
+    """Synthetic day-ahead price series ($/kWh) with a morning/evening
+    double peak, for standalone runs without ERCOT data."""
+    rng = np.random.RandomState(seed ^ 0x599)
+    n = days * 24 * dt
+    hod = (np.arange(n) / dt + start.hour) % 24.0
+    base = 0.03 + 0.02 * np.exp(-0.5 * ((hod - 8) / 2.0) ** 2) \
+        + 0.035 * np.exp(-0.5 * ((hod - 18) / 2.5) ** 2)
+    noise = np.abs(rng.randn(n)) * 0.004
+    return base + noise
+
+
+def _align_price_series(prices: np.ndarray, price_start: datetime,
+                        data_start: datetime, n_steps: int, dt: int,
+                        base_price: float) -> np.ndarray:
+    """Align an independently-indexed price series onto the weather grid
+    (the reference's outer-merge + ffill, dragg/aggregator.py:219-230), with
+    out-of-span steps falling back to edge values / base price."""
+    if len(prices) == 0:
+        return np.full(n_steps, float(base_price))
+    offset = int(round((data_start - price_start).total_seconds() / 3600 * dt))
+    idx = np.clip(np.arange(n_steps) + offset, 0, len(prices) - 1)
+    return np.asarray(prices, dtype=np.float64)[idx]
+
+
 def load_environment(config: dict, data_dir: str | None = None) -> EnvironmentData:
     """Build the EnvironmentData from config: NSRDB file if present, else
-    synthetic weather covering the simulation year."""
+    synthetic weather covering the simulation year.  With ``spp_enabled``
+    the price series comes from ERCOT SPP data (or its synthesizer) instead
+    of the TOU schedule (dragg/aggregator.py:219-224)."""
     dt = int(config["agg"]["subhourly_steps"])
+    seed = int(config["simulation"]["random_seed"])
     ts_file = None
     if data_dir is not None:
         ts_file = os.path.join(data_dir, os.environ.get("SOLAR_TEMPERATURE_DATA_FILE", "nsrdb.csv"))
@@ -144,20 +214,37 @@ def load_environment(config: dict, data_dir: str | None = None) -> EnvironmentDa
     else:
         start = parse_dt(config["simulation"]["start_datetime"])
         year_start = datetime(start.year, 1, 1)
-        oat, ghi, data_start = synth_weather(year_start, days=366, dt=dt, seed=int(config["simulation"]["random_seed"]))
-    tou_cfg = config["agg"].get("tou", {})
-    tou = build_tou(
-        len(oat),
-        data_start,
-        dt,
-        base_price=config["agg"]["base_price"],
-        tou_enabled=bool(config["agg"].get("tou_enabled", False)),
-        shoulder_times=tuple(tou_cfg.get("shoulder_times", (9, 21))),
-        shoulder_price=float(tou_cfg.get("shoulder_price", 0.09)),
-        peak_times=tuple(tou_cfg.get("peak_times", (14, 18))),
-        peak_price=float(tou_cfg.get("peak_price", 0.13)),
-        fix_tou_peak=bool(config.get("tpu", {}).get("fix_tou_peak", False)),
-    )
+        oat, ghi, data_start = synth_weather(year_start, days=366, dt=dt, seed=seed)
+
+    if bool(config["agg"].get("spp_enabled", False)):
+        spp_file = None
+        if data_dir is not None:
+            spp_file = os.path.join(data_dir, os.environ.get("SPP_DATA_FILE", "spp_data.csv"))
+        if spp_file is not None and os.path.exists(spp_file):
+            prices, price_start = load_spp(
+                spp_file, config["simulation"].get("load_zone", "LZ_HOUSTON"), dt
+            )
+        else:
+            prices = synth_spp(data_start, days=len(oat) // (24 * dt) + 1, dt=dt, seed=seed)
+            price_start = data_start
+        tou = _align_price_series(
+            prices, price_start, data_start, len(oat), dt,
+            base_price=float(config["agg"]["base_price"]),
+        )
+    else:
+        tou_cfg = config["agg"].get("tou", {})
+        tou = build_tou(
+            len(oat),
+            data_start,
+            dt,
+            base_price=config["agg"]["base_price"],
+            tou_enabled=bool(config["agg"].get("tou_enabled", False)),
+            shoulder_times=tuple(tou_cfg.get("shoulder_times", (9, 21))),
+            shoulder_price=float(tou_cfg.get("shoulder_price", 0.09)),
+            peak_times=tuple(tou_cfg.get("peak_times", (14, 18))),
+            peak_price=float(tou_cfg.get("peak_price", 0.13)),
+            fix_tou_peak=bool(config.get("tpu", {}).get("fix_tou_peak", False)),
+        )
     return EnvironmentData(oat=oat, ghi=ghi, tou=tou, data_start=data_start, dt=dt)
 
 

@@ -1,0 +1,103 @@
+"""SPP (ERCOT day-ahead price) ingestion tests — the working equivalent of
+the reference's dead spp path (dragg/aggregator.py:167-204, SURVEY.md §5.6)."""
+
+from datetime import datetime
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dragg_tpu.config import default_config
+from dragg_tpu.data import _align_price_series, load_environment, load_spp, synth_spp
+
+
+def _ercot_csv(tmp_path, rows):
+    df = pd.DataFrame(rows, columns=[
+        "Delivery Date", "Hour Ending", "Repeated Hour Flag",
+        "Settlement Point", "Settlement Point Price",
+    ])
+    path = str(tmp_path / "spp_data.csv")
+    df.to_csv(path, index=False)
+    return path
+
+
+def test_load_spp_conversion_and_zone_filter(tmp_path):
+    rows = [
+        ["01/01/2015", "01:00", "N", "LZ_HOUSTON", 25.0],   # hour-beginning 0
+        ["01/01/2015", "02:00", "N", "LZ_HOUSTON", 30.0],
+        ["01/01/2015", "01:00", "N", "LZ_WEST", 99.0],      # other zone dropped
+        ["01/01/2015", "03:00", "N", "LZ_HOUSTON", 45.0],
+    ]
+    prices, start = load_spp(_ercot_csv(tmp_path, rows), "LZ_HOUSTON", dt=1)
+    assert start == datetime(2015, 1, 1, 0)
+    np.testing.assert_allclose(prices, [0.025, 0.030, 0.045])  # $/MWh → $/kWh
+
+
+def test_load_spp_subhourly_repeat_and_gap_fill(tmp_path):
+    rows = [
+        ["01/01/2015", "1", "N", "LZ_HOUSTON", 10.0],
+        # hour 2 missing → forward-filled
+        ["01/01/2015", "3", "N", "LZ_HOUSTON", 30.0],
+    ]
+    prices, start = load_spp(_ercot_csv(tmp_path, rows), "LZ_HOUSTON", dt=2)
+    np.testing.assert_allclose(prices, [0.01, 0.01, 0.01, 0.01, 0.03, 0.03])
+
+
+def test_load_spp_repeated_hour_dedup(tmp_path):
+    rows = [
+        ["11/01/2015", "1", "N", "LZ_HOUSTON", 10.0],
+        ["11/01/2015", "1", "Y", "LZ_HOUSTON", 20.0],  # DST repeated hour
+    ]
+    prices, _ = load_spp(_ercot_csv(tmp_path, rows), "LZ_HOUSTON", dt=1)
+    np.testing.assert_allclose(prices, [0.01])
+
+
+def test_load_spp_missing_zone_raises(tmp_path):
+    rows = [["01/01/2015", "1", "N", "LZ_WEST", 10.0]]
+    with pytest.raises(ValueError, match="LZ_HOUSTON"):
+        load_spp(_ercot_csv(tmp_path, rows), "LZ_HOUSTON", dt=1)
+
+
+def test_align_price_series_offsets():
+    prices = np.array([1.0, 2.0, 3.0, 4.0])
+    # Price series starts 2 hours after the weather grid: leading steps take
+    # the first price, trailing steps hold the last.
+    out = _align_price_series(
+        prices, datetime(2015, 1, 1, 2), datetime(2015, 1, 1, 0),
+        n_steps=8, dt=1, base_price=0.07,
+    )
+    np.testing.assert_allclose(out, [1, 1, 1, 2, 3, 4, 4, 4])
+    assert _align_price_series(np.array([]), datetime(2015, 1, 1),
+                               datetime(2015, 1, 1), 3, 1, 0.07).tolist() == [0.07] * 3
+
+
+def test_environment_spp_synth_path():
+    cfg = default_config()
+    cfg["agg"]["spp_enabled"] = True
+    env = load_environment(cfg, data_dir=None)
+    assert env.tou.shape == env.oat.shape
+    # Synthetic DAM prices: positive, sub-$0.2/kWh, with diurnal structure.
+    assert np.all(env.tou > 0) and np.all(env.tou < 0.2)
+    day = env.tou[: 24 * env.dt]
+    assert day.argmax() != 0
+
+
+def test_environment_spp_csv_path(tmp_path):
+    cfg = default_config()
+    cfg["agg"]["spp_enabled"] = True
+    cfg["simulation"]["load_zone"] = "LZ_HOUSTON"
+    rows = []
+    for d in range(3):
+        for h in range(1, 25):
+            rows.append([f"01/{d+1:02d}/2015", str(h), "N", "LZ_HOUSTON", 20.0 + h])
+    _ercot_csv(tmp_path, rows)
+    # weather is synthetic (no nsrdb.csv in tmp_path) but SPP comes from file
+    env = load_environment(cfg, data_dir=str(tmp_path))
+    assert env.tou[0] == pytest.approx(0.021)  # hour-beginning 0 ← HE 1
+    assert env.tou.shape == env.oat.shape
+
+
+def test_synth_spp_deterministic():
+    a = synth_spp(datetime(2015, 1, 1), days=2, dt=1, seed=5)
+    b = synth_spp(datetime(2015, 1, 1), days=2, dt=1, seed=5)
+    np.testing.assert_array_equal(a, b)
