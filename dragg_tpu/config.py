@@ -170,6 +170,7 @@ _DEFAULT: dict[str, Any] = {
         "admm_iters": 1500,
         "admm_rho": 0.1,
         "admm_sigma": 1e-6,
+        "admm_reg": 1e-3,
         "admm_alpha": 1.6,
         "admm_eps": 1e-4,
         "fix_tou_peak": False,  # reference bug parity: peak price is overwritten by shoulder (dragg/aggregator.py:214-215)

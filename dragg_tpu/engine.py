@@ -40,7 +40,7 @@ import numpy as np
 from jax import lax
 
 from dragg_tpu.models.fallback import fallback_control
-from dragg_tpu.ops.admm import admm_solve
+from dragg_tpu.ops.admm import admm_solve_qp
 from dragg_tpu.ops.qp import (
     QPLayout,
     TAP_TEMP,
@@ -122,6 +122,7 @@ class EngineParams(NamedTuple):
     admm_eps: float
     admm_sigma: float
     admm_alpha: float
+    admm_reg: float
     seed: int
 
 
@@ -235,10 +236,11 @@ class Engine:
             cool_cap=cool_cap, heat_cap=heat_cap, wh_cap=s,
             discount=p.discount,
         )
-        sol = admm_solve(
-            qp.A_eq, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+        sol = admm_solve_qp(
+            self.static.pattern, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
             rho=p.admm_rho, sigma=p.admm_sigma, alpha=p.admm_alpha,
             eps_abs=p.admm_eps, eps_rel=p.admm_eps,
+            reg=p.admm_reg,
             iters=p.admm_iters,
             x0=state.warm_x, y_box0=state.warm_y_box,
             rho0=state.warm_rho,
@@ -371,6 +373,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         admm_eps=float(tpu_cfg.get("admm_eps", 1e-4)),
         admm_sigma=float(tpu_cfg.get("admm_sigma", 1e-6)),
         admm_alpha=float(tpu_cfg.get("admm_alpha", 1.6)),
+        admm_reg=float(tpu_cfg.get("admm_reg", 1e-3)),
         seed=int(config["simulation"]["random_seed"]),
     )
 

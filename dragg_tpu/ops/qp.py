@@ -74,6 +74,69 @@ class QPLayout:
         self.m = self.m_eq + self.n
 
 
+class SparsePattern(NamedTuple):
+    """Static gather-padded sparsity of A_eq, shared across homes.
+
+    The dynamics matrix has ≤``K`` nonzeros per row and ≤``Kc`` per column
+    (banded RC recurrences), so both matvec directions become pure gathers +
+    elementwise sums — no scatter in the hot loop, which matters on TPU.
+    ``*_src`` index the flat nnz axis (-1 → empty slot, masked to 0).
+
+    All index structures are nested int tuples, so the pattern is hashable
+    and can be a ``jit`` static argument.
+    """
+
+    m: int                    # equality rows
+    n: int                    # variables
+    nnz: int
+    rows: tuple               # (nnz,) row of each entry
+    cols: tuple               # (nnz,) col of each entry
+    row_cols: tuple           # (m, K) column index per row slot (0-padded)
+    row_src: tuple            # (m, K) nnz index per row slot (-1-padded)
+    col_rows: tuple           # (n, Kc) row index per col slot (0-padded)
+    col_src: tuple            # (n, Kc) nnz index per col slot (-1-padded)
+
+
+def _tt(a: np.ndarray) -> tuple:
+    """ndarray → nested tuple (hashable)."""
+    if a.ndim == 1:
+        return tuple(int(v) for v in a)
+    return tuple(tuple(int(v) for v in row) for row in a)
+
+
+def _build_pattern(rows: np.ndarray, cols: np.ndarray, m: int, n: int) -> SparsePattern:
+    nnz = len(rows)
+    K = int(np.bincount(rows, minlength=m).max())
+    Kc = int(np.bincount(cols, minlength=n).max())
+    row_cols = np.zeros((m, K), dtype=np.int32)
+    row_src = np.full((m, K), -1, dtype=np.int32)
+    col_rows = np.zeros((n, Kc), dtype=np.int32)
+    col_src = np.full((n, Kc), -1, dtype=np.int32)
+    rfill = np.zeros(m, dtype=np.int64)
+    cfill = np.zeros(n, dtype=np.int64)
+    for e in range(nnz):
+        r, c = int(rows[e]), int(cols[e])
+        row_cols[r, rfill[r]] = c
+        row_src[r, rfill[r]] = e
+        rfill[r] += 1
+        col_rows[c, cfill[c]] = r
+        col_src[c, cfill[c]] = e
+        cfill[c] += 1
+    return SparsePattern(m=m, n=n, nnz=nnz, rows=_tt(rows), cols=_tt(cols),
+                         row_cols=_tt(row_cols), row_src=_tt(row_src),
+                         col_rows=_tt(col_rows), col_src=_tt(col_src))
+
+
+def densify_A(pat: SparsePattern, vals) -> jnp.ndarray:
+    """Materialize the dense (B, m, n) A_eq from sparse values (tests,
+    CPU-reference cross-checks, Schur factorization)."""
+    rows = np.asarray(pat.rows)
+    cols = np.asarray(pat.cols)
+    return jnp.zeros((vals.shape[0], pat.m, pat.n), dtype=vals.dtype).at[
+        :, rows, cols
+    ].add(vals)
+
+
 class HomeQPStatic(NamedTuple):
     """Per-home static pieces: the (row, col) sparsity (shared) plus the
     per-home coefficient values split into static entries and the indices of
@@ -83,6 +146,7 @@ class HomeQPStatic(NamedTuple):
     cols: np.ndarray          # (nnz,)
     vals: jnp.ndarray         # (n_homes, nnz) — static values; wh-mix band filled per step
     whmix_pos: np.ndarray     # (H,) positions in the nnz axis of the wh-mix coefficients
+    pattern: SparsePattern    # gather-padded sparsity for the solver hot loop
     a_in: jnp.ndarray         # (n_homes,) 3600 / (C * dt)
     a_wh: jnp.ndarray         # (n_homes,) 3600 / (wh_c * dt)
     kin: jnp.ndarray          # (n_homes,) 1 - a_in / R
@@ -153,11 +217,14 @@ def build_qp_static(batch, horizon: int, dt: int) -> HomeQPStatic:
         add(lay.r_ebd + k, lay.i_pd + k, -1.0 / (dse * dt))
     del ks
 
+    rows_np = np.array(rows, dtype=np.int64)
+    cols_np = np.array(cols, dtype=np.int64)
     return HomeQPStatic(
-        rows=np.array(rows, dtype=np.int64),
-        cols=np.array(cols, dtype=np.int64),
+        rows=rows_np,
+        cols=cols_np,
         vals=jnp.asarray(np.stack(vals, axis=1)),
         whmix_pos=whmix_pos,
+        pattern=_build_pattern(rows_np, cols_np, lay.m_eq, lay.n),
         a_in=jnp.asarray(a_in),
         a_wh=jnp.asarray(a_wh),
         kin=jnp.asarray(kin),
@@ -167,9 +234,11 @@ def build_qp_static(batch, horizon: int, dt: int) -> HomeQPStatic:
 
 
 class QPStep(NamedTuple):
-    """Everything the ADMM solver needs for one timestep, batched over homes."""
+    """Everything the ADMM solver needs for one timestep, batched over homes.
+    A_eq is carried sparsely (values on the shared pattern); use
+    :func:`densify_A` where a dense matrix is needed."""
 
-    A_eq: jnp.ndarray     # (n_homes, m_eq, n)
+    vals: jnp.ndarray     # (n_homes, nnz) A_eq values on the static pattern
     b_eq: jnp.ndarray     # (n_homes, m_eq)
     l_box: jnp.ndarray    # (n_homes, n)
     u_box: jnp.ndarray    # (n_homes, n)
@@ -203,10 +272,7 @@ def assemble_qp_step(
 
     rem = 1.0 - draw_frac  # remainder_frac (dragg/mpc_calc.py:202-204)
     whmix_vals = -(rem[:, 1:] * static.kwh[:, None])  # (n_homes, H)
-    vals = static.vals.at[:, static.whmix_pos].set(whmix_vals)
-
-    A_eq = jnp.zeros((n_homes, lay.m_eq, lay.n), dtype=dtype)
-    A_eq = A_eq.at[:, static.rows, static.cols].add(vals.astype(dtype))
+    vals = static.vals.at[:, static.whmix_pos].set(whmix_vals).astype(dtype)
 
     oat = jnp.asarray(oat_window)
     b = jnp.zeros((n_homes, lay.m_eq), dtype=dtype)
@@ -280,7 +346,7 @@ def assemble_qp_step(
         / 1000.0
     ).astype(dtype)
     q = q.at[:, lay.i_curt : lay.i_curt + H].set(wp * s * pvc)
-    return QPStep(A_eq=A_eq, b_eq=b, l_box=l, u_box=u, q=q)
+    return QPStep(vals=vals, b_eq=b, l_box=l, u_box=u, q=q)
 
 
 class MPCSolution(NamedTuple):

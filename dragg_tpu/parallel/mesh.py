@@ -122,6 +122,7 @@ class ShardedEngine(Engine):
         st = self.static
         self.static = type(st)(
             rows=st.rows, cols=st.cols, whmix_pos=st.whmix_pos,
+            pattern=st.pattern,
             vals=put_s(st.vals), a_in=put_s(st.a_in), a_wh=put_s(st.a_wh),
             kin=put_s(st.kin), kwh=put_s(st.kwh), awr=put_s(st.awr),
         )

@@ -46,7 +46,10 @@ class TestADMMvsScipy:
             jnp.asarray(np.stack(ls), dtype=jnp.float32),
             jnp.asarray(np.stack(us), dtype=jnp.float32),
             jnp.asarray(np.stack(qs), dtype=jnp.float32),
-            iters=2000, eps_abs=2e-3, eps_rel=2e-3,
+            # Kernel-level check on synthetic LPs: pin reg to the
+            # near-exact setting (the package default 1e-3 is tuned to the
+            # MPC problems' scaling and can bias arbitrary LPs past 1%).
+            iters=2000, eps_abs=2e-3, eps_rel=2e-3, reg=1e-6,
         )
         assert bool(np.all(np.asarray(sol.solved))), (
             f"unsolved: r_prim={np.asarray(sol.r_prim)}, r_dual={np.asarray(sol.r_dual)}"
@@ -79,7 +82,10 @@ class TestADMMvsScipy:
             jnp.asarray(l[None], dtype=jnp.float32),
             jnp.asarray(u[None], dtype=jnp.float32),
             jnp.asarray(q[None], dtype=jnp.float32),
-            iters=2000, eps_abs=2e-3, eps_rel=2e-3,
+            # Kernel-level check on synthetic LPs: pin reg to the
+            # near-exact setting (the package default 1e-3 is tuned to the
+            # MPC problems' scaling and can bias arbitrary LPs past 1%).
+            iters=2000, eps_abs=2e-3, eps_rel=2e-3, reg=1e-6,
         )
         assert bool(sol.solved[0])
         obj = float(np.asarray(sol.x)[0] @ q)
