@@ -142,6 +142,16 @@ _FALLBACK_DATA: dict = {}
 _FALLBACK_MU = threading.Lock()
 
 
+def _check_text(v) -> str:
+    """Keys/values cross the C-string ABI; embedded NULs would silently
+    truncate there, so both backends reject them up front (the store's
+    payloads are numeric strings — full binary safety is out of scope)."""
+    s = str(v)
+    if "\x00" in s:
+        raise ValueError("StateBus keys/values must not contain NUL bytes")
+    return s
+
+
 class StateBus:
     """Redis-verb store. Native-backed when the library builds; otherwise a
     threadsafe in-process dict with identical semantics.  Both backends are
@@ -174,10 +184,10 @@ class StateBus:
 
     def set(self, key: str, val) -> None:
         if self._lib:
-            self._lib.sb_set(key.encode(), str(val).encode())
+            self._lib.sb_set(_check_text(key).encode(), _check_text(val).encode())
         else:
             with self._mu:
-                self._data[key] = str(val)
+                self._data[_check_text(key)] = _check_text(val)
 
     def get(self, key: str) -> str | None:
         if self._lib:
@@ -189,13 +199,13 @@ class StateBus:
 
     def hset(self, key: str, field: str, val) -> None:
         if self._lib:
-            self._lib.sb_hset(key.encode(), field.encode(), str(val).encode())
+            self._lib.sb_hset(_check_text(key).encode(), _check_text(field).encode(), _check_text(val).encode())
         else:
             with self._mu:
                 d = self._data.setdefault(key, {})
                 if not isinstance(d, dict):
                     d = self._data[key] = {}
-                d[field] = str(val)
+                d[_check_text(field)] = _check_text(val)
 
     def hget(self, key: str, field: str) -> str | None:
         if self._lib:
@@ -216,13 +226,13 @@ class StateBus:
     def rpush(self, key: str, *vals) -> None:
         if self._lib:
             for v in vals:
-                self._lib.sb_rpush(key.encode(), str(v).encode())
+                self._lib.sb_rpush(key.encode(), _check_text(v).encode())
         else:
             with self._mu:
                 lst = self._data.setdefault(key, [])
                 if not isinstance(lst, list):
                     lst = self._data[key] = []
-                lst.extend(str(v) for v in vals)
+                lst.extend(_check_text(v) for v in vals)
 
     def llen(self, key: str) -> int:
         if self._lib:

@@ -43,8 +43,11 @@ std::mutex g_mu;
 std::map<int64_t, Collector *> g_cols;
 int64_t g_next = 1;
 
-Collector *get(int64_t h) {
-    std::lock_guard<std::mutex> lock(g_mu);
+// Callers must hold g_mu for the duration of any use of the returned
+// pointer: every exported col_* function takes the coarse lock for its whole
+// body, which makes col_free racing another col_* call safe (the collector
+// workload is one writer; fine-grained locking would buy nothing).
+Collector *get_locked(int64_t h) {
     auto it = g_cols.find(h);
     return it == g_cols.end() ? nullptr : it->second;
 }
@@ -91,7 +94,8 @@ void col_free(int64_t h) {
 // Append a (n_steps, n_homes) row-major chunk to series `key`.
 int col_add_chunk(int64_t h, const char *key, const double *data,
                   int64_t n_steps, int64_t n_homes) {
-    Collector *c = get(h);
+    std::lock_guard<std::mutex> lock(g_mu);
+    Collector *c = get_locked(h);
     if (c == nullptr || n_homes != c->n_homes) return -1;
     auto &cols = c->series[key];
     if (cols.empty()) cols.resize(static_cast<size_t>(n_homes));
@@ -109,7 +113,8 @@ int col_add_chunk(int64_t h, const char *key, const double *data,
 // Replace series[key][home_idx] wholesale (checkpoint import).
 int col_import_series(int64_t h, const char *key, int64_t home_idx,
                       const double *data, int64_t n) {
-    Collector *c = get(h);
+    std::lock_guard<std::mutex> lock(g_mu);
+    Collector *c = get_locked(h);
     if (c == nullptr || home_idx < 0 || home_idx >= c->n_homes) return -1;
     auto &cols = c->series[key];
     if (cols.empty()) cols.resize(static_cast<size_t>(c->n_homes));
@@ -119,7 +124,8 @@ int col_import_series(int64_t h, const char *key, int64_t home_idx,
 }
 
 int64_t col_series_len(int64_t h, const char *key, int64_t home_idx) {
-    Collector *c = get(h);
+    std::lock_guard<std::mutex> lock(g_mu);
+    Collector *c = get_locked(h);
     if (c == nullptr) return -1;
     auto it = c->series.find(key);
     if (it == c->series.end() || home_idx < 0 ||
@@ -132,7 +138,8 @@ int64_t col_series_len(int64_t h, const char *key, int64_t home_idx) {
 // Copy series[key][home_idx] into out (caller-allocated, cap doubles).
 int64_t col_get_series(int64_t h, const char *key, int64_t home_idx,
                        double *out, int64_t cap) {
-    Collector *c = get(h);
+    std::lock_guard<std::mutex> lock(g_mu);
+    Collector *c = get_locked(h);
     if (c == nullptr) return -1;
     auto it = c->series.find(key);
     if (it == c->series.end() || home_idx < 0 ||
@@ -149,7 +156,8 @@ int64_t col_get_series(int64_t h, const char *key, int64_t home_idx,
 // Execute a write plan (see header comment).  Returns 0 on success.
 int col_write_json(int64_t h, const char *path, const char *plan,
                    int64_t plan_len) {
-    Collector *c = get(h);
+    std::lock_guard<std::mutex> lock(g_mu);
+    Collector *c = get_locked(h);
     if (c == nullptr) return -1;
     std::string tmp_path = std::string(path) + ".tmp";
     std::FILE *f = std::fopen(tmp_path.c_str(), "wb");

@@ -195,3 +195,14 @@ def test_collector_nonfinite_roundtrip(tmp_path):
     got = json.load(open(path))["s"]
     assert np.isnan(got[0]) and got[1] == np.inf and got[2] == -np.inf and got[3] == 1.5
     col.close()
+
+
+def test_statebus_rejects_nul_bytes(bus):
+    """Embedded NULs would truncate across the C-string ABI; both backends
+    reject them identically instead of silently diverging."""
+    with pytest.raises(ValueError, match="NUL"):
+        bus.set("k", "a\x00b")
+    with pytest.raises(ValueError, match="NUL"):
+        bus.hset("h", "f", "x\x00")
+    with pytest.raises(ValueError, match="NUL"):
+        bus.rpush("l", "ok", "bad\x00")
