@@ -282,6 +282,8 @@ class Aggregator:
         the previous complete checkpoint or the new complete one — never a
         torn mix.  results.json stays a user-facing output; resume never
         reads it."""
+        import shutil
+
         from dragg_tpu.checkpoint import save_progress, save_pytree
 
         root = self._checkpoint_root()
@@ -289,8 +291,6 @@ class Aggregator:
         name = f"ckpt_t{self.timestep:08d}"
         tmp = os.path.join(root, name + ".tmp")
         if os.path.isdir(tmp):
-            import shutil
-
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         save_pytree(os.path.join(tmp, "state.npz"), state)
@@ -299,6 +299,7 @@ class Aggregator:
         for fname, obj in (extra_json or {}).items():
             save_progress(os.path.join(tmp, fname), obj)
         save_progress(os.path.join(tmp, "progress.json"), {
+            "run_shape": self._run_shape(),
             "timestep": self.timestep,
             "elapsed": time.time() - self.start_time,
             "baseline_agg_load_list": self.baseline_agg_load_list,
@@ -310,14 +311,18 @@ class Aggregator:
             "min_load": getattr(self, "min_load", None),
         })
         final = os.path.join(root, name)
+        # A previous run killed between this rename and the LATEST replace
+        # leaves a complete ckpt dir at `final` while LATEST still points at
+        # the older checkpoint; the resumed run reaches this timestep again
+        # and os.rename onto a non-empty dir raises.  Clear it first — the
+        # staged tmp dir is the authoritative new checkpoint.
+        shutil.rmtree(final, ignore_errors=True)
         os.rename(tmp, final)
         latest_tmp = os.path.join(root, "LATEST.tmp")
         with open(latest_tmp, "w") as f:
             f.write(name)
         os.replace(latest_tmp, os.path.join(root, "LATEST"))
         # Prune superseded checkpoints.
-        import shutil
-
         for entry in os.listdir(root):
             if entry.startswith("ckpt_") and entry != name:
                 shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
@@ -340,6 +345,18 @@ class Aggregator:
         d = os.path.join(root, name)
         return d if os.path.isdir(d) else None
 
+    def _run_shape(self) -> dict:
+        """Dimensions a checkpoint is only valid for: restored bookkeeping
+        arrays (all_rps/all_sps) and the scan carry are sized by these, so a
+        config change between runs must invalidate the checkpoint instead of
+        surfacing later as an obscure broadcast/index error."""
+        return {
+            "num_timesteps": self.num_timesteps,
+            "n_homes": len(self.all_homes) if self.all_homes else
+                       self.config["community"]["total_number_homes"],
+            "horizon": int(self.config["home"]["hems"]["prediction_horizon"]),
+        }
+
     def try_resume(self, template_state):
         """Restore (state, t) from the latest complete checkpoint if one
         exists and ``simulation.resume`` is enabled; else (template_state, 0).
@@ -354,6 +371,14 @@ class Aggregator:
         if d is None:
             return template_state, 0
         prog = load_progress(os.path.join(d, "progress.json"))
+        want = self._run_shape()
+        got = prog.get("run_shape")
+        if got != want:
+            self.log.logger.warning(
+                f"Checkpoint {d} was written for run shape {got}, current "
+                f"config is {want}; ignoring it and starting fresh."
+            )
+            return template_state, 0
         state = load_pytree(os.path.join(d, "state.npz"), template_state)
         collected = load_progress(os.path.join(d, "collected.json"))
         for i, home in enumerate(self.all_homes):

@@ -37,7 +37,9 @@ def load_pytree(path: str, template):
     structure.  Shapes must match the template's leaves."""
     data = np.load(path)
     leaves, treedef = jax.tree_util.tree_flatten(template)
-    keys = sorted(data.files)
+    # Sort numerically: lexicographic sort would interleave leaf_10000
+    # between leaf_1000 and leaf_1001, silently permuting same-shaped leaves.
+    keys = sorted(data.files, key=lambda k: int(k.rsplit("_", 1)[1]))
     if len(keys) != len(leaves):
         raise ValueError(
             f"Checkpoint {path} has {len(keys)} leaves; template has {len(leaves)}"

@@ -88,6 +88,7 @@ def load_library():
         lib.sb_hgetall.restype = ctypes.c_void_p
         lib.sb_hgetall.argtypes = [c]
         lib.sb_rpush.argtypes = [c, c]
+        lib.sb_rpush_n.argtypes = [c, ctypes.POINTER(c), i64]
         lib.sb_llen.restype = i64
         lib.sb_llen.argtypes = [c]
         lib.sb_lrange.restype = ctypes.c_void_p
@@ -225,8 +226,12 @@ class StateBus:
 
     def rpush(self, key: str, *vals) -> None:
         if self._lib:
-            for v in vals:
-                self._lib.sb_rpush(key.encode(), _check_text(v).encode())
+            # One batched native call → one lock acquisition, so a
+            # multi-value push is atomic like Redis RPUSH (a concurrent
+            # lrange/llen can't observe it half-applied).
+            enc = [_check_text(v).encode() for v in vals]
+            arr = (ctypes.c_char_p * len(enc))(*enc)
+            self._lib.sb_rpush_n(key.encode(), arr, len(enc))
         else:
             with self._mu:
                 lst = self._data.setdefault(key, [])

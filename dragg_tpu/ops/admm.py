@@ -377,7 +377,12 @@ def dense_pattern(m: int, n: int) -> SparsePattern:
 
 def admm_solve(A_eq, b_eq, l_box, u_box, q, **kwargs) -> ADMMSolution:
     """Dense-matrix API: wraps :func:`admm_solve_qp` with a dense pattern.
-    Prefer the sparse API for the MPC path."""
+    Prefer the sparse API for the MPC path.
+
+    The proximal regularization defaults to a near-zero 1e-8 here: arbitrary
+    LP callers should not inherit the MPC-tuned 1e-3 (which Tikhonov-biases
+    their objectives); the engine passes its tuned reg explicitly."""
+    kwargs.setdefault("reg", 1e-8)
     B, m_eq, n = A_eq.shape
     pat = dense_pattern(m_eq, n)
     return admm_solve_qp(pat, A_eq.reshape(B, m_eq * n), b_eq, l_box, u_box, q, **kwargs)

@@ -161,6 +161,21 @@ void sb_rpush(const char *key, const char *val) {
     v.list.emplace_back(val);
 }
 
+// Batched push: all values land under ONE lock acquisition, so a concurrent
+// lrange/llen never observes a partially-applied multi-value RPUSH — Redis's
+// atomicity contract for variadic RPUSH.
+void sb_rpush_n(const char *key, const char *const *vals, int64_t n) {
+    std::unique_lock lock(store().mu);
+    Value &v = store().data[key];
+    if (v.tag != 1) {
+        v = Value{};
+        v.tag = 1;
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        v.list.emplace_back(vals[i]);
+    }
+}
+
 int64_t sb_llen(const char *key) {
     std::shared_lock lock(store().mu);
     auto it = store().data.find(key);

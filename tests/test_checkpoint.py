@@ -131,6 +131,63 @@ def test_completed_run_clears_checkpoint_and_rerun_is_clean(tmp_path):
     )
 
 
+def test_resume_rejects_mismatched_config(tmp_path):
+    """A checkpoint written under one run shape (num_timesteps / n_homes /
+    horizon) must be ignored — not half-loaded into wrong-length
+    bookkeeping arrays — when the config changes between runs."""
+    from dragg_tpu.aggregator import Aggregator
+
+    out = str(tmp_path / "outputs")
+    part = Aggregator(_cfg(), data_dir=None, outputs_dir=out)
+    part.stop_after_chunks = 1
+    part.run()
+    assert part.timestep < part.num_timesteps  # checkpoint exists mid-run
+
+    # Same run dir, longer simulation → different num_timesteps.
+    res = Aggregator(_cfg(resume=True, end_datetime="2015-01-04 00"),
+                     data_dir=None, outputs_dir=out)
+    res.run()
+    assert res.resumed_from is None  # started fresh, no broadcast errors
+    got = json.load(open(os.path.join(res.run_dir, "baseline", "results.json")))
+    for name, d in got.items():
+        if name == "Summary":
+            continue
+        assert len(d["p_grid_opt"]) == res.num_timesteps
+
+
+def test_checkpoint_survives_preexisting_final_dir(tmp_path):
+    """Kill-window regression (ADVICE r1): a complete ckpt dir left behind
+    with LATEST still pointing at the previous checkpoint must not make the
+    next save_checkpoint at that timestep fail."""
+    from dragg_tpu.aggregator import Aggregator
+
+    out = str(tmp_path / "outputs")
+    # 3 days → checkpoints after chunk 1 and chunk 2.
+    cfg = _cfg(end_datetime="2015-01-04 00")
+    part = Aggregator(cfg, data_dir=None, outputs_dir=out)
+    part.stop_after_chunks = 1
+    part.run()
+    ckpt_root = os.path.join(part.run_dir, "baseline", "checkpoint")
+    latest = open(os.path.join(ckpt_root, "LATEST")).read().strip()
+    # Simulate the kill window: the NEXT checkpoint dir (second daily
+    # boundary = 2× the first) exists complete, but LATEST was never
+    # advanced past the current one.
+    stale = os.path.join(ckpt_root, "ckpt_t%08d" % (2 * part.timestep))
+    os.makedirs(stale)
+    with open(os.path.join(stale, "junk.txt"), "w") as f:
+        f.write("leftover")
+
+    res = Aggregator(_cfg(resume=True, end_datetime="2015-01-04 00"),
+                     data_dir=None, outputs_dir=out)
+    res.run()  # must re-reach that timestep and overwrite the stale dir
+    assert res.resumed_from is not None and res.resumed_from.endswith(latest)
+    got = json.load(open(os.path.join(res.run_dir, "baseline", "results.json")))
+    for name, d in got.items():
+        if name == "Summary":
+            continue
+        assert len(d["p_grid_opt"]) == res.num_timesteps
+
+
 def test_rl_agg_resume_bit_exact(tmp_path):
     from dragg_tpu.aggregator import Aggregator
 

@@ -80,6 +80,31 @@ def test_statebus_concurrent_disjoint_writers(bus):
         assert h["field_49"] == str(i * 1000 + 49)
 
 
+def test_statebus_multivalue_rpush_atomic(bus):
+    """Variadic RPUSH is atomic in Redis: a concurrent llen must only ever
+    observe multiples of the batch size (ADVICE r1 — the native path used
+    one sb_rpush per value, each taking the lock independently)."""
+    BATCH, ROUNDS = 7, 200
+    stop = threading.Event()
+    violations = []
+
+    def reader():
+        while not stop.is_set():
+            n = bus.llen("atomic_l")
+            if n % BATCH != 0:
+                violations.append(n)
+                return
+
+    th = threading.Thread(target=reader)
+    th.start()
+    for r in range(ROUNDS):
+        bus.rpush("atomic_l", *[f"{r}_{j}" for j in range(BATCH)])
+    stop.set()
+    th.join()
+    assert not violations
+    assert bus.llen("atomic_l") == BATCH * ROUNDS
+
+
 def test_redis_client_singleton():
     from dragg_tpu.redis_client import RedisClient
 
