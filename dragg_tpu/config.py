@@ -168,6 +168,7 @@ _DEFAULT: dict[str, Any] = {
     # dragg_tpu-specific knobs (no reference analog).
     "tpu": {
         "admm_iters": 1500,
+        "admm_refactor_every": 8,
         "admm_rho": 0.1,
         "admm_sigma": 1e-6,
         "admm_reg": 1e-3,

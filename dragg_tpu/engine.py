@@ -40,13 +40,14 @@ import numpy as np
 from jax import lax
 
 from dragg_tpu.models.fallback import fallback_control
-from dragg_tpu.ops.admm import admm_solve_qp
+from dragg_tpu.ops.admm import FactorCarry, admm_solve_qp_cached, init_factor_carry
 from dragg_tpu.ops.qp import (
     QPLayout,
     TAP_TEMP,
     assemble_qp_step,
     build_qp_static,
     recover_solution,
+    shift_warm_start,
 )
 
 WINTER_MAX_OAT = 30.0  # season switch threshold, degC (dragg/mpc_calc.py:303)
@@ -109,6 +110,20 @@ class StepOutputs(NamedTuple):
     admm_iters: jnp.ndarray       # () iterations the solver ran this step
 
 
+class StepAux(NamedTuple):
+    """Intermediates produced by the assemble phase and consumed by the
+    merge/collect phase (kept explicit so the phases can be timed and jitted
+    separately by the benchmark harness)."""
+
+    draw0: jnp.ndarray        # (n,) liters drawn this step
+    temp_wh_init: jnp.ndarray # (n,) draw-mixed initial WH temp
+    oat1: jnp.ndarray         # () OAT at t+1 (fallback simulation forcing)
+    ghi_w: jnp.ndarray        # (H+1,)
+    price_total: jnp.ndarray  # (n, H)
+    cool_cap: jnp.ndarray     # (n,)
+    heat_cap: jnp.ndarray     # (n,)
+
+
 class EngineParams(NamedTuple):
     """Static (Python-side) engine configuration."""
 
@@ -123,6 +138,7 @@ class EngineParams(NamedTuple):
     admm_sigma: float
     admm_alpha: float
     admm_reg: float
+    admm_refactor_every: int  # exact refactorization cadence (sim steps)
     seed: int
 
 
@@ -178,10 +194,18 @@ class Engine:
             key=jax.random.PRNGKey(self.params.seed),
         )
 
+    def init_factor(self) -> FactorCarry:
+        """Zero factor cache.  The cache lives only in chunk-local scan
+        carries — NOT in CommunityState — so checkpoints never pay for the
+        (n, m, m) Schur inverse (237 MB at 10k homes, ~9 GB at the
+        100k-home/H=48 target); every chunk's first step refreshes it."""
+        return init_factor_carry(self.n_homes, self.static.pattern)
+
     # ----------------------------------------------------------------- step
-    def _step(self, state: CommunityState, t, rp):
-        """One community timestep.  ``t`` is the sim timestep (traced),
-        ``rp`` the reward-price vector (H,) for this step."""
+    def _prepare(self, state: CommunityState, t, rp):
+        """Assemble phase: environment windows, water draws, seasonal gate,
+        and the batched QP for one timestep.  ``t`` is the sim timestep
+        (traced), ``rp`` the reward-price vector (H,) for this step."""
         p = self.params
         lay = self.layout
         b = self.batch
@@ -236,8 +260,22 @@ class Engine:
             cool_cap=cool_cap, heat_cap=heat_cap, wh_cap=s,
             discount=p.discount,
         )
-        sol = admm_solve_qp(
+        aux = StepAux(
+            draw0=draw_size[:, 0], temp_wh_init=temp_wh_init, oat1=oat_w[1],
+            ghi_w=ghi_w, price_total=price_total,
+            cool_cap=cool_cap, heat_cap=heat_cap,
+        )
+        return qp, aux
+
+    def _solve(self, state: CommunityState, qp, factor: FactorCarry, refresh):
+        """Solve phase: the batched ADMM QP solve, warm-started from state.
+        ``refresh`` (traced bool) forces an exact re-equilibration +
+        refactorization; between refreshes the carried Schur factor is
+        reused with iterative refinement (SURVEY.md §7 step 3)."""
+        p = self.params
+        return admm_solve_qp_cached(
             self.static.pattern, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+            factor, refresh,
             rho=p.admm_rho, sigma=p.admm_sigma, alpha=p.admm_alpha,
             eps_abs=p.admm_eps, eps_rel=p.admm_eps,
             reg=p.admm_reg,
@@ -245,7 +283,21 @@ class Engine:
             x0=state.warm_x, y_box0=state.warm_y_box,
             rho0=state.warm_rho,
         )
-        mpc = recover_solution(sol.x, lay, b, ghi_w, price_total, s)
+
+    def _finish(self, state: CommunityState, t, sol, aux: StepAux):
+        """Merge/collect phase: recover physical series, route unsolved homes
+        through the fallback controller, emit observables, advance state."""
+        p = self.params
+        lay = self.layout
+        b = self.batch
+        H, dt, s = p.horizon, p.dt, p.s
+        n = self.n_homes
+        f32 = jnp.float32
+        temp_wh_init = aux.temp_wh_init
+        price_total = aux.price_total
+        cool_cap, heat_cap = aux.cool_cap, aux.heat_cap
+
+        mpc = recover_solution(sol.x, lay, b, aux.ghi_w, price_total, s)
         solved = sol.solved
 
         # --- Fallback for unsolved homes (dragg/mpc_calc.py:527-596).
@@ -256,7 +308,7 @@ class Engine:
             jnp.take_along_axis(state.plan_cool, ridx, axis=1)[:, 0],
             jnp.take_along_axis(state.plan_heat, ridx, axis=1)[:, 0],
             jnp.take_along_axis(state.plan_wh, ridx, axis=1)[:, 0],
-            state.temp_in, temp_wh_init, oat_w[1],
+            state.temp_in, temp_wh_init, aux.oat1,
             jnp.asarray(b.hvac_r, f32), jnp.asarray(b.hvac_c, f32),
             jnp.asarray(b.hvac_p_c, f32), jnp.asarray(b.hvac_p_h, f32),
             jnp.asarray(b.wh_r, f32), jnp.asarray(b.wh_c, f32), jnp.asarray(b.wh_p, f32),
@@ -305,8 +357,8 @@ class Engine:
             plan_cool=jnp.where(sel2, mpc.cool, state.plan_cool),
             plan_heat=jnp.where(sel2, mpc.heat, state.plan_heat),
             plan_wh=jnp.where(sel2, mpc.wh, state.plan_wh),
-            warm_x=sol.x,
-            warm_y_box=sol.y_box,
+            warm_x=shift_warm_start(sol.x, lay),
+            warm_y_box=shift_warm_start(sol.y_box, lay),
             warm_rho=sol.rho,
             key=state.key,
         )
@@ -320,7 +372,7 @@ class Engine:
             hvac_heat_on=heat0 / s,
             wh_heat_on=wh0 / s,
             cost=cost0,
-            waterdraws=draw_size[:, 0],
+            waterdraws=aux.draw0,
             correct_solve=solved.astype(f32),
             p_pv=p_pv0,
             u_pv_curt=u_curt0,
@@ -334,27 +386,63 @@ class Engine:
         )
         return new_state, out
 
+    def _step(self, state: CommunityState, t, rp, refresh, factor: FactorCarry):
+        """One community timestep: assemble → solve → merge/collect.
+        Returns (new_state, new_factor, outputs) — the factor cache is
+        threaded separately from CommunityState so it never reaches
+        checkpoints (see :meth:`init_factor`)."""
+        qp, aux = self._prepare(state, t, rp)
+        sol, fcarry = self._solve(state, qp, factor, refresh)
+        new_state, out = self._finish(state, t, sol, aux)
+        return new_state, fcarry, out
+
     def _chunk(self, state: CommunityState, t0, rps):
         """Scan ``rps.shape[0]`` timesteps on device (the sim hot loop —
-        replaces dragg/aggregator.py:771-778's per-step pool fan-out)."""
+        replaces dragg/aggregator.py:771-778's per-step pool fan-out).
+
+        The solver's factor cache is chunk-local: it refreshes on the
+        chunk's first step (so chunks never depend on a stale carried
+        factor — resume stays bit-exact), then every
+        ``admm_refactor_every`` sim steps, and is dropped at chunk end."""
+        K = max(1, self.params.admm_refactor_every)
 
         def body(carry, inp):
+            cstate, factor = carry
             i, rp = inp
-            return self._step(carry, t0 + i, rp)
+            t = t0 + i
+            refresh = (i == 0) | ((t % K) == 0)
+            new_state, new_factor, out = self._step(cstate, t, rp, refresh, factor)
+            return (new_state, new_factor), out
 
         n_steps = rps.shape[0]
-        return lax.scan(body, state, (jnp.arange(n_steps), rps))
+        (state, _), outs = lax.scan(
+            body, (state, self.init_factor()), (jnp.arange(n_steps), rps)
+        )
+        return state, outs
 
     # ------------------------------------------------------------------ api
     def step(self, state: CommunityState, t: int, rp) -> tuple[CommunityState, StepOutputs]:
-        """Run a single timestep (jitted)."""
-        return self._step_fn(state, jnp.asarray(t), jnp.asarray(rp, dtype=jnp.float32))
+        """Run a single timestep (jitted).  Single-step calls always refresh
+        the factor cache — exact scalings + factorization every call."""
+        state, _, out = self._step_fn(
+            state, jnp.asarray(t), jnp.asarray(rp, dtype=jnp.float32),
+            jnp.asarray(True), self.init_factor(),
+        )
+        return state, out
 
     def run_chunk(self, state: CommunityState, t0: int, rps) -> tuple[CommunityState, StepOutputs]:
         """Run a chunk of timesteps with a device-side scan.  ``rps`` is
         (n_steps, H) reward prices (zeros for the baseline case).  Returns
         (final_state, outputs stacked along time)."""
         return self._chunk_fn(state, jnp.asarray(t0), jnp.asarray(rps, dtype=jnp.float32))
+
+    # ----------------------------------------------------------- profiling
+    def phase_fns(self):
+        """Separately-jitted (prepare, solve, finish) phase functions for
+        the benchmark's per-phase timers.  Splitting loses cross-phase XLA
+        fusion, so the phase-time sum slightly over-estimates the fused
+        step — use for attribution, not as the headline rate."""
+        return jax.jit(self._prepare), jax.jit(self._solve), jax.jit(self._finish)
 
 
 def engine_params(config, start_index: int) -> EngineParams:
@@ -374,6 +462,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         admm_sigma=float(tpu_cfg.get("admm_sigma", 1e-6)),
         admm_alpha=float(tpu_cfg.get("admm_alpha", 1.6)),
         admm_reg=float(tpu_cfg.get("admm_reg", 1e-3)),
+        admm_refactor_every=int(tpu_cfg.get("admm_refactor_every", 8)),
         seed=int(config["simulation"]["random_seed"]),
     )
 

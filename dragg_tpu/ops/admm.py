@@ -56,7 +56,7 @@ analog of the reference's try/except around prob.solve
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -64,9 +64,45 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dragg_tpu.ops.qp import SparsePattern
+from dragg_tpu.ops.qp import (
+    SparsePattern,
+    build_schur_structure,
+    form_schur_sparse,
+)
 
 RHO_MIN, RHO_MAX = 1e-6, 1e6
+
+
+class FactorCarry(NamedTuple):
+    """Cross-timestep solver cache (MPC mode): the Ruiz/cost scalings and
+    the explicit Schur inverse, carried through the simulation scan so
+    consecutive timesteps — whose matrices differ only in the water-mix
+    band (dragg_tpu/ops/qp.py:19-22) — skip the equilibration and the
+    O(Bm³) refactorization.  The solve's iterative-refinement step absorbs
+    the small stale-factor drift; a periodic ``refresh`` re-equilibrates
+    and refactors exactly."""
+
+    d: jnp.ndarray      # (B, n) column scaling
+    e_eq: jnp.ndarray   # (B, m) equality-row scaling
+    e_box: jnp.ndarray  # (B, n) box-row scaling
+    c: jnp.ndarray      # (B, 1) cost scaling
+    Sinv: jnp.ndarray   # (B, m, m) explicit Schur inverse
+
+
+@lru_cache(maxsize=32)
+def _schur_structure_for(pat: SparsePattern):
+    """Schur triple lists for a pattern, or None when the dense einsum
+    formation is cheaper (e.g. the fully-dense test pattern, where the
+    triple list would be m²·n entries).  The triple count Σ_k c_k² is
+    checked from the column counts BEFORE building anything, so a dense
+    pattern never pays the Python enumeration."""
+    col_counts = np.bincount(np.asarray(pat.cols), minlength=pat.n)
+    if int(np.sum(col_counts.astype(np.int64) ** 2)) > pat.m * pat.n:
+        return None
+    ss = build_schur_structure(pat)
+    if ss.n_s * ss.P > pat.m * pat.n:
+        return None
+    return ss
 
 
 class ADMMSolution(NamedTuple):
@@ -129,9 +165,7 @@ def ruiz_equilibrate_sparse(pat: SparsePattern, vals, q, iters: int = 10):
     return d, e_eq, e_box, c
 
 
-@partial(jax.jit, static_argnames=("pat", "iters", "check_every", "ruiz_iters",
-                                   "adaptive_rho", "patience"))
-def admm_solve_qp(
+def _admm_impl(
     pat: SparsePattern,      # static sparsity (hashable NamedTuple of numpy)
     vals: jnp.ndarray,       # (B, nnz) A_eq values
     b_eq: jnp.ndarray,       # (B, m_eq)
@@ -153,12 +187,15 @@ def admm_solve_qp(
     x0: jnp.ndarray | None = None,
     y_box0: jnp.ndarray | None = None,
     rho0: jnp.ndarray | None = None,
-) -> ADMMSolution:
+    carry_in: FactorCarry | None = None,
+    refresh=None,            # traced bool — recompute scalings + factor
+) -> tuple[ADMMSolution, FactorCarry]:
     """Solve B problems  min 1/2 x'(reg I)x + q'x  s.t. A_eq x = b_eq,
     l <= x <= u  simultaneously, with A_eq given sparsely.  Warm-startable
     via x0/y_box0/rho0 in UNSCALED units (the internal Ruiz/cost scaling is
-    recomputed per call and applied at the boundary, so warm starts transfer
-    across calls whose matrices differ — e.g. consecutive MPC timesteps)."""
+    applied at the boundary, so warm starts transfer across calls whose
+    matrices differ — e.g. consecutive MPC timesteps).  With ``carry_in``
+    the scalings and Schur factor are reused unless ``refresh`` fires."""
     B = vals.shape[0]
     m_eq, n = pat.m, pat.n
     dtype = vals.dtype
@@ -169,8 +206,16 @@ def admm_solve_qp(
     row_src = jnp.asarray(pat.row_src)
     col_rows = jnp.asarray(pat.col_rows)
     col_src = jnp.asarray(pat.col_src)
+    schur = _schur_structure_for(pat)
 
-    d, e_eq, e_box, c = ruiz_equilibrate_sparse(pat, vals, q, iters=ruiz_iters)
+    if carry_in is None:
+        d, e_eq, e_box, c = ruiz_equilibrate_sparse(pat, vals, q, iters=ruiz_iters)
+    else:
+        d, e_eq, e_box, c = lax.cond(
+            refresh,
+            lambda: ruiz_equilibrate_sparse(pat, vals, q, iters=ruiz_iters),
+            lambda: (carry_in.d, carry_in.e_eq, carry_in.e_box, carry_in.c),
+        )
     vals_s = e_eq[:, rows] * vals * d[:, cols]     # scaled A values (B, nnz)
     vp_r = _pad_gather(vals_s, row_src)            # (B, m, K) row-padded
     vp_c = _pad_gather(vals_s, col_src)            # (B, n, Kc) col-padded
@@ -194,22 +239,32 @@ def admm_solve_qp(
         """A_eqᵀ y with UNSCALED values (infeasibility certificate)."""
         return jnp.sum(vp_c_raw * y[:, col_rows], axis=2)
 
-    # Dense scaled A, materialized once per call — used only to form the
-    # Schur complement at (rare) refactorizations.
-    As_dense = jnp.zeros((B, m_eq, n), dtype=dtype).at[:, rows, cols].add(vals_s)
     eye_m = jnp.eye(m_eq, dtype=dtype)
+
+    def diag_inv(rho_b):
+        """D⁻¹, D = diag(P̂ + σ + ρŵ²) — exact for the CURRENT rho."""
+        return 1.0 / (p_diag + sigma + rho_b[:, None] * w * w)
+
+    def form_S(Dinv):
+        """S = Â D⁻¹ Âᵀ.  Banded patterns use the precomputed triple lists
+        (no dense A anywhere — the round-1 (B, m, n) materialization was
+        the 100k-home memory blocker); dense patterns fall back to the
+        einsum formation."""
+        if schur is not None:
+            return form_schur_sparse(schur, m_eq, vals_s, Dinv)
+        As_dense = jnp.zeros((B, m_eq, n), dtype=dtype).at[:, rows, cols].add(vals_s)
+        ADi = As_dense * Dinv[:, None, :]
+        return jnp.einsum("bmn,bkn->bmk", ADi, As_dense, precision=lax.Precision.HIGHEST)
 
     def factor(rho_b):
         """Schur-complement factor of the equality-constrained x-update.
 
-        Returns (Dinv, Sinv, S): D = diag(P̂+σ+ρŵ²);  S = Â D⁻¹ Âᵀ (SPD,
-        m_eq×m_eq); S⁻¹ formed explicitly via Cholesky + two batched
-        matrix-matrix triangular solves so the per-iteration solve is pure
-        batched matmul; S kept for one refinement step.
+        Returns (Dinv, Sinv, S): S is SPD m_eq×m_eq; S⁻¹ formed explicitly
+        via Cholesky + two batched matrix-matrix triangular solves so the
+        per-iteration solve is pure batched matmul; S kept for refinement.
         """
-        Dinv = 1.0 / (p_diag + sigma + rho_b[:, None] * w * w)
-        ADi = As_dense * Dinv[:, None, :]
-        S = jnp.einsum("bmn,bkn->bmk", ADi, As_dense, precision=lax.Precision.HIGHEST)
+        Dinv = diag_inv(rho_b)
+        S = form_S(Dinv)
         L = jnp.linalg.cholesky(S)
         Linv = lax.linalg.triangular_solve(
             L, jnp.broadcast_to(eye_m, S.shape), left_side=True, lower=True
@@ -217,13 +272,24 @@ def admm_solve_qp(
         Sinv = jnp.einsum("bkm,bkn->bmn", Linv, Linv, precision=lax.Precision.HIGHEST)
         return Dinv, Sinv, S
 
-    def s_solve(F, r):
-        """S⁻¹ r with one iterative-refinement step (recovers f32 accuracy
-        of the explicit inverse; three batched matmuls)."""
+    def stale_factor(rho_b):
+        """Reuse the carried Schur inverse as a preconditioner: Dinv and S
+        are exact for the current problem; only Sinv is stale (the wh-mix
+        band drifted since it was factored), which iterative refinement in
+        ``s_solve`` corrects."""
+        Dinv = diag_inv(rho_b)
+        return Dinv, carry_in.Sinv, form_S(Dinv)
+
+    def s_solve(F, r, refine: int = 1):
+        """S⁻¹ r with ``refine`` iterative-refinement steps (recovers f32
+        accuracy of the explicit inverse and absorbs stale-factor drift;
+        1 + 2·refine batched matmuls)."""
         _, Sinv, S = F
         v = jnp.einsum("bmn,bn->bm", Sinv, r, precision=lax.Precision.HIGHEST)
-        resid = r - jnp.einsum("bmn,bn->bm", S, v, precision=lax.Precision.HIGHEST)
-        return v + jnp.einsum("bmn,bn->bm", Sinv, resid, precision=lax.Precision.HIGHEST)
+        for _ in range(refine):
+            resid = r - jnp.einsum("bmn,bn->bm", S, v, precision=lax.Precision.HIGHEST)
+            v = v + jnp.einsum("bmn,bn->bm", Sinv, resid, precision=lax.Precision.HIGHEST)
+        return v
 
     def kkt_solve(F, rhs):
         """x-update KKT solve: x = D⁻¹(rhs − Âᵀν), ν = S⁻¹(Â D⁻¹ rhs − b̂).
@@ -334,7 +400,10 @@ def admm_solve_qp(
             keep = keep & (it - last_improve < patience * check_every)
         return keep
 
-    F = factor(rho_b)
+    if carry_in is None:
+        F = factor(rho_b)
+    else:
+        F = lax.cond(refresh, factor, stale_factor, rho_b)
     state = (x, z_box, nu, y_box)
     pinf0 = jnp.zeros((B,), dtype=bool)
     state, rho_b, F, it, _, pinf, _, _, _ = lax.while_loop(
@@ -348,20 +417,53 @@ def admm_solve_qp(
     # Final polish: D-weighted projection of the iterate onto the equality
     # manifold (one extra Schur solve) — drives the dynamics-row violation to
     # solve accuracy so downstream physics sees consistent trajectories.
+    # Two refinement passes: with a stale carried factor the extra pass
+    # squares the drift term, keeping the projection at solve accuracy.
     Dinv = F[0]
-    x = x - Dinv * mvt(s_solve(F, mv(x) - bs))
+    x = x - Dinv * mvt(s_solve(F, mv(x) - bs, refine=2))
 
     # Unscale and box-project the primal so downstream physics sees in-bound
     # values even at loose tolerance.
     x_out = jnp.clip(d * x, l_box, u_box)
-    return ADMMSolution(
+    sol = ADMMSolution(
         x=x_out, y_eq=e_eq * nu / c, y_box=e_box * y_box / c,
         r_prim=r_prim, r_dual=r_dual, solved=ok & ~pinf, infeasible=pinf,
         iters=it, rho=rho_b,
     )
+    return sol, FactorCarry(d=d, e_eq=e_eq, e_box=e_box, c=c, Sinv=F[1])
 
 
-from functools import lru_cache
+_STATIC = ("pat", "iters", "check_every", "ruiz_iters", "adaptive_rho", "patience")
+
+
+@partial(jax.jit, static_argnames=_STATIC)
+def admm_solve_qp(pat, vals, b_eq, l_box, u_box, q, **kwargs) -> ADMMSolution:
+    """One-shot solve (scalings + factor computed in-call).  See
+    :func:`_admm_impl` for parameters."""
+    sol, _ = _admm_impl(pat, vals, b_eq, l_box, u_box, q, **kwargs)
+    return sol
+
+
+@partial(jax.jit, static_argnames=_STATIC)
+def admm_solve_qp_cached(pat, vals, b_eq, l_box, u_box, q, carry_in, refresh,
+                         **kwargs) -> tuple[ADMMSolution, FactorCarry]:
+    """MPC-mode solve with the cross-timestep factor cache: reuses
+    ``carry_in``'s Ruiz scalings and Schur inverse unless the traced
+    ``refresh`` flag fires (periodic exact refactorization).  Returns the
+    solution plus the carry for the next timestep."""
+    return _admm_impl(pat, vals, b_eq, l_box, u_box, q, carry_in=carry_in,
+                      refresh=refresh, **kwargs)
+
+
+def init_factor_carry(B: int, pat: SparsePattern, dtype=jnp.float32) -> FactorCarry:
+    """Zero-filled carry for t=0 (the first step must pass refresh=True)."""
+    return FactorCarry(
+        d=jnp.ones((B, pat.n), dtype=dtype),
+        e_eq=jnp.ones((B, pat.m), dtype=dtype),
+        e_box=jnp.ones((B, pat.n), dtype=dtype),
+        c=jnp.ones((B, 1), dtype=dtype),
+        Sinv=jnp.zeros((B, pat.m, pat.m), dtype=dtype),
+    )
 
 
 @lru_cache(maxsize=32)

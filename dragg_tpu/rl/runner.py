@@ -50,7 +50,7 @@ def _rl_settings(config: dict):
 # RL aggregator driving the MPC community (case "rl_agg")
 # --------------------------------------------------------------------------
 
-def _fused_step(engine, aparams, dt, norm, max_rp, rp_len, carry, t):
+def _fused_step(engine, aparams, dt, norm, max_rp, rp_len, carry, t, t0):
     """One fused RL + community-MPC timestep.
 
     Ordering parity with the reference's per-step flow: the agent trains on
@@ -67,7 +67,7 @@ def _fused_step(engine, aparams, dt, norm, max_rp, rp_len, carry, t):
     windows price only the first ``rp_len`` horizon steps (zero beyond) — a
     well-defined generalization of a case the reference mis-shapes on.
     """
-    cstate, acarry, env = carry
+    (cstate, acarry, env), factor = carry
     obs = observe(env, t, dt, norm)
     acarry, rec = train_step(acarry, obs, aparams)
     action = jnp.clip(acarry.next_action, aparams.action_low, aparams.action_high)
@@ -77,7 +77,12 @@ def _fused_step(engine, aparams, dt, norm, max_rp, rp_len, carry, t):
         rp_vec = jnp.full((H,), rp_scalar, dtype=jnp.float32)
     else:
         rp_vec = jnp.where(jnp.arange(H) < rp_len, rp_scalar, 0.0).astype(jnp.float32)
-    cstate, outs = engine._step(cstate, t, rp_vec)
+    # Factor-cache refresh on the chunk's first step and on the periodic
+    # cadence — same policy as Engine._chunk.  The cache is chunk-local
+    # (outside the checkpointed carry), like Engine._chunk's.
+    K = max(1, engine.params.admm_refactor_every)
+    refresh = (t == t0) | ((t % K) == 0)
+    cstate, factor, outs = engine._step(cstate, t, rp_vec, refresh, factor)
     tracker, sp = tracker_step(env.tracker, outs.agg_load, t + 1)
     new_env = EnvCarry(
         agg_load=outs.agg_load,
@@ -88,7 +93,7 @@ def _fused_step(engine, aparams, dt, norm, max_rp, rp_len, carry, t):
         action=rp_scalar,
         tracker=tracker,
     )
-    return (cstate, acarry, new_env), (outs, rec, rp_scalar, env.setpoint)
+    return ((cstate, acarry, new_env), factor), (outs, rec, rp_scalar, env.setpoint)
 
 
 def run_rl_agg(agg) -> None:
@@ -117,7 +122,12 @@ def run_rl_agg(agg) -> None:
 
     @jax.jit
     def chunk(carry, ts):
-        return lax.scan(lambda c, t: step(c, t), carry, ts)
+        # The factor cache enters/leaves here so the checkpointed carry
+        # (and try_resume's template) never includes it.
+        (carry, _), stacked = lax.scan(
+            lambda c, t: step(c, t, ts[0]), (carry, agg.engine.init_factor()), ts
+        )
+        return carry, stacked
 
     agg.checkpoint_interval = agg._checkpoint_steps()
     if agg.run_dir is None:
