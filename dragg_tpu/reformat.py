@@ -293,6 +293,20 @@ class Reformat:
         _legend(ax, 8)
         return fig
 
+    def _file_daily_series(self, file):
+        """Per-run (x, loads, daily stats, setpoint) shared by the parametric
+        and max/12hr-avg figures.  Daily stats cover whole days only, so the
+        returned ``xd`` is the x prefix they align to."""
+        data = self._load(file["results"])
+        spd = 24 * file["parent"]["agg_dt"]
+        loads = np.asarray(data["Summary"]["p_grid_aggregate"], dtype=float)
+        st = daily_stats(loads, spd)
+        x = file["parent"]["x_lims"][: len(loads)]
+        sp = np.asarray(data["Summary"].get("p_grid_setpoint", []), dtype=float)
+        xd = x[: len(st["daily_max"]) * spd] if st else []
+        per_step = lambda a: np.repeat(a, spd)[: len(xd)]
+        return x, st, sp, xd, per_step
+
     def plot_parametric(self, ax=None):
         """Setpoint + daily max/min/range/avg/std traces per run, and the
         daily stats table printed to the log (dragg/reformat.py:429-473)."""
@@ -301,23 +315,13 @@ class Reformat:
             fig, ax = self._new_fig()
         table_rows = []
         for file in self.files:
-            data = self._load(file["results"])
-            agg_dt = file["parent"]["agg_dt"]
-            spd = 24 * agg_dt
-            loads = np.asarray(data["Summary"]["p_grid_aggregate"], dtype=float)
-            st = daily_stats(loads, spd)
+            x, st, sp, xd, per_step = self._file_daily_series(file)
             table_rows.append((file["name"], st))
             if not st:
                 continue
-            x = file["parent"]["x_lims"][: len(loads)]
-            sp = np.asarray(data["Summary"].get("p_grid_setpoint", []), dtype=float)
             if sp.size:
                 ax.plot(x[: sp.size], sp[: len(x)], alpha=0.5,
                         label=f"{file['name']} - setpoint")
-            # Daily stats cover whole days only; align x to that prefix.
-            n_whole = len(st["daily_max"]) * spd
-            xd = x[:n_whole]
-            per_step = lambda a: np.repeat(a, spd)[: len(xd)]
             ax.step(xd, per_step(st["daily_max"]), where="post", alpha=0.5,
                     linestyle=":", label=f"{file['name']} - daily max")
             ax.step(xd, per_step(st["daily_min"]), where="post", alpha=0.5,
@@ -341,9 +345,44 @@ class Reformat:
         ax.set_title("RL Baseline Comparison")
         return fig
 
-    def plot_single_home(self, name: str | None = None, ax=None):
-        """Per-home temperature traces with thermal bounds; PV/battery series
-        when the home has them (dragg/reformat.py:257-296)."""
+    def plot_environmental_values(self, ax, file, name: str | None = None):
+        """OAT/GHI traces plus TOU price on a secondary axis, and the comfort
+        bands for ``name`` (dragg/reformat.py:206-211).
+
+        Returns the secondary (price) axis so callers can stack more price
+        traces on it.
+        """
+        data = self._load(file["results"])
+        summary = data["Summary"]
+        x = file["parent"]["x_lims"]
+        oat = np.asarray(summary.get("OAT", []), dtype=float)
+        ghi = np.asarray(summary.get("GHI", []), dtype=float)
+        tou = np.asarray(summary.get("TOU", []), dtype=float)
+        if oat.size:
+            n = min(len(x), oat.size)
+            ax.plot(x[:n], oat[:n], color="gray", alpha=0.6, label="OAT (C)")
+        if ghi.size:
+            n = min(len(x), ghi.size)
+            # GHI is hundreds of W/m^2; scale onto the temperature axis the
+            # way the reference relies on legend-toggling instead.
+            ax.plot(x[:n], ghi[:n] / 100.0, color="goldenrod", alpha=0.5,
+                    label="GHI (x100 W/m2)")
+        pax = ax.twinx()
+        pax.set_ylabel("Price ($/kWh)")
+        if tou.size:
+            n = min(len(x), tou.size)
+            pax.step(x[:n], tou[:n], where="post", color="green", alpha=0.6,
+                     label="TOU Price ($/kWh)")
+        if name is not None:
+            self._thermal_bounds(ax, x, name)
+        return pax
+
+    def plot_single_home(self, name: str | None = None, ax=None,
+                         plot_price: bool = True):
+        """Per-home temperature traces with thermal bounds, environmental
+        overlay, and the price signal; PV/battery series when the home has
+        them (dragg/reformat.py:257-296; price + env overlay
+        dragg/reformat.py:206-211,229-244)."""
         fig = None
         if ax is None:
             fig, ax = self._new_fig()
@@ -358,7 +397,7 @@ class Reformat:
             self.log.logger.info(f'Proceeding with home: "{name}"')
         self.sample_home = name
 
-        bounds_drawn = False
+        pax = None
         for file in self.files:
             comm = self._load(file["results"])
             if name not in comm:
@@ -369,9 +408,14 @@ class Reformat:
             nts = min(len(x), len(data["temp_in_opt"]))
             ax.plot(x[:nts], data["temp_in_opt"][:nts], label=f"Tin - {file['name']}")
             ax.plot(x[:nts], data["temp_wh_opt"][:nts], label=f"Twh - {file['name']}")
-            if not bounds_drawn:
-                self._thermal_bounds(ax, x, name)
-                bounds_drawn = True
+            if pax is None:
+                pax = self.plot_environmental_values(ax, file, name)
+            if plot_price:
+                rp = np.asarray(comm["Summary"].get("RP", []), dtype=float)
+                if rp.size:
+                    n = min(len(x), rp.size)
+                    pax.step(x[:n], rp[:n], where="post", alpha=0.5,
+                             linestyle="--", label=f"RP - {file['name']}")
             if "pv" in data["type"]:
                 ax.step(x[:nts], data["p_pv_opt"][:nts], where="post", alpha=0.5,
                         label=f"Ppv (kW) - {file['name']}")
@@ -383,6 +427,55 @@ class Reformat:
         ax.set_xlabel("Time of Day (hour)")
         ax.set_ylabel("Temperature (deg C)")
         _legend(ax, 7)
+        if pax is not None:
+            _legend(pax, 7)
+        return fig
+
+    def plot_all_homes(self, names=None, save: bool = False):
+        """One single-home figure per home — the reference iterates a home
+        list and rebuilds the single-home figure for each
+        (dragg/reformat.py:298-309).  Defaults to every home present in all
+        runs; returns the list of (home-name, figure) pairs.
+        """
+        if names is None:
+            names = sorted(set().union(
+                *(self.get_type_list(t) for t in
+                  ("base", "pv_only", "battery_only", "pv_battery"))
+            ))
+        figs = []
+        for home in names:
+            self.sample_home = home
+            fig = self.plot_single_home(home)
+            figs.append((home, fig))
+        if save:
+            import matplotlib.pyplot as plt
+
+            self.save_images(figs)
+            # One figure per home can be the whole community — release them
+            # from pyplot's registry once they are on disk.
+            for _, fig in figs:
+                if fig is not None:
+                    plt.close(fig)
+        return figs
+
+    def plot_max_and_12hravg(self, ax=None):
+        """Daily-max load plus the utility's trailing-average setpoint ("12 Hr
+        Avg") per run (dragg/reformat.py:378-427)."""
+        fig = None
+        if ax is None:
+            fig, ax = self._new_fig()
+        for file in self.files:
+            x, st, sp, xd, per_step = self._file_daily_series(file)
+            if sp.size:
+                ax.plot(x[: sp.size], sp[: len(x)], alpha=0.5,
+                        label=f"{file['name']} - 12 Hr Avg")
+            if not st:
+                continue
+            ax.step(xd, per_step(st["daily_max"]), where="post",
+                    label=f"{file['name']} - Daily Max")
+        ax.set_title("12 Hour Avg and Daily Max")
+        ax.set_ylabel("Agg. Demand (kW)")
+        _legend(ax, 8)
         return fig
 
     def _thermal_bounds(self, ax, x, name) -> None:
@@ -433,6 +526,7 @@ class Reformat:
         figs = [("rl2baseline", self.rl2baseline()),
                 ("single_home", self.plot_single_home()),
                 ("typical_day", self.plot_typ_day()),
+                ("max_and_12hravg", self.plot_max_and_12hravg()),
                 ("all_rps", self.all_rps())]
         self.images = [f for _, f in figs if f is not None]
         if save:

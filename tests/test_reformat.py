@@ -89,6 +89,47 @@ def test_figures_and_save(finished_run):
     assert hasattr(r, "table") and "baseline" in r.table
 
 
+def test_plot_all_homes(finished_run):
+    """Every home in the run gets its own figure (dragg/reformat.py:298-309)."""
+    cfg, out, agg = finished_run
+    r = Reformat(config=cfg, outputs_dir=out)
+    figs = r.plot_all_homes()
+    assert len(figs) == cfg["community"]["total_number_homes"]
+    names = {n for n, _ in figs}
+    assert len(names) == len(figs)
+    for _, fig in figs:
+        assert fig is not None and fig.axes
+
+
+def test_plot_max_and_12hravg(finished_run):
+    cfg, out, agg = finished_run
+    r = Reformat(config=cfg, outputs_dir=out)
+    fig = r.plot_max_and_12hravg()
+    assert fig is not None
+    ax = fig.axes[0]
+    assert ax.get_title() == "12 Hour Avg and Daily Max"
+    labels = [t.get_label() for t in ax.get_lines()]
+    assert any("Daily Max" in l for l in labels)
+    assert any("12 Hr Avg" in l for l in labels)
+
+
+def test_single_home_env_overlay_and_price(finished_run):
+    """Environmental overlay (OAT/GHI + secondary TOU axis) and the price
+    trace appear on single-home figures (dragg/reformat.py:206-211,229-244)."""
+    cfg, out, agg = finished_run
+    r = Reformat(config=cfg, outputs_dir=out)
+    fig = r.plot_single_home()
+    assert fig is not None
+    assert len(fig.axes) == 2  # primary + twinx price axis
+    prim, pax = fig.axes
+    prim_labels = [t.get_label() for t in prim.get_lines()]
+    assert any("OAT" in l for l in prim_labels)
+    assert any("GHI" in l for l in prim_labels)
+    pax_labels = [t.get_label() for t in pax.get_lines()]
+    assert any("TOU" in l for l in pax_labels)
+    assert pax.get_ylabel() == "Price ($/kWh)"
+
+
 def test_missing_outputs_dir_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         Reformat(config=default_config(), outputs_dir=str(tmp_path / "nope"))
