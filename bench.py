@@ -102,7 +102,24 @@ def run_measured(args) -> dict:
     engine, np = build(args.homes, args.horizon_hours, args.admm_iters)
     H = engine.params.horizon
     state = engine.init_state()
-    rps = np.zeros((args.steps, H), dtype=np.float32)
+
+    # Size the scan chunk so one device execution stays under ~25 s: the
+    # axon-tunneled runtime faults on single executions in the ~60 s range
+    # (round-2 finding — the r1/r2 10k-home failures were exactly this), and
+    # a smaller chunk costs only scan-overhead amortization.  The estimate
+    # uses the single-step path (its own jit; compiles first).
+    steps = args.steps
+    if platform != "cpu" and args.steps > 2:
+        _log("estimating per-step time (single-step compile)...")
+        st2, out2 = engine.step(state, 0, np.zeros(H, dtype=np.float32))
+        jax.block_until_ready(out2.agg_load)
+        t0 = time.perf_counter()
+        st2, out2 = engine.step(state, 0, np.zeros(H, dtype=np.float32))
+        jax.block_until_ready(out2.agg_load)
+        t_step = time.perf_counter() - t0
+        steps = int(max(2, min(args.steps, 25.0 / max(t_step, 1e-3))))
+        _log(f"~{t_step:.2f}s/step (refresh path) → {steps} steps/chunk")
+    rps = np.zeros((steps, H), dtype=np.float32)
 
     # Warmup with the SAME chunk shape as the timed run — the scan length is
     # baked into the compiled program, so a different shape would put a full
@@ -113,18 +130,18 @@ def run_measured(args) -> dict:
     jax.block_until_ready(outs.agg_load)
     compile_s = time.perf_counter() - t0
     _log(f"warmup done in {compile_s:.1f}s; timing {args.chunks} chunks "
-         f"of {args.steps} steps")
+         f"of {steps} steps")
 
     chunk_rates = []
     iters_per_step = []
-    t_cursor = args.steps
+    t_cursor = steps
     for c in range(args.chunks):
         t0 = time.perf_counter()
         state, outs = engine.run_chunk(state, t_cursor, rps)
         jax.block_until_ready(outs.agg_load)
         elapsed = time.perf_counter() - t0
-        t_cursor += args.steps
-        chunk_rates.append(args.steps / elapsed)
+        t_cursor += steps
+        chunk_rates.append(steps / elapsed)
         iters_per_step.append(float(np.mean(np.asarray(outs.admm_iters))))
         _log(f"chunk {c}: {chunk_rates[-1]:.3f} ts/s, "
              f"mean ADMM iters {iters_per_step[-1]:.0f}")
@@ -302,7 +319,10 @@ def main() -> None:
     ladder = []
     if args.platform in ("auto", "tpu"):
         ladder.append(("tpu", args.homes, args.steps, args.chunks, t_tpu))
-        ladder.append(("tpu", args.homes, args.steps, args.chunks, t_tpu / 2))
+        # Retry with shorter chunks: long single executions are the known
+        # axon-runtime failure mode.
+        ladder.append(("tpu", args.homes, max(2, args.steps // 4),
+                       args.chunks * 2, t_tpu / 2))
     if args.platform == "cpu":
         # Explicit CPU request: honor the user's config exactly.
         ladder.append(("cpu", args.homes, args.steps, args.chunks, t_cpu))

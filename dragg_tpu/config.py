@@ -158,6 +158,7 @@ _DEFAULT: dict[str, Any] = {
     "rl": {
         "utility": {"action_space": [-0.02, 0.02]},
         "parameters": {
+            "agent": "linear",  # "linear" (reference parity) | "ddpg" (Flax neural)
             "alpha": 0.0625,
             "beta": 1.0,
             "epsilon": 0.05,
@@ -169,6 +170,11 @@ _DEFAULT: dict[str, Any] = {
     "tpu": {
         "admm_iters": 1500,
         "admm_refactor_every": 8,
+        "admm_patience": 4,   # stagnation-exit patience in check windows (0 disables)
+        "admm_rho_update_every": 4,  # in-loop rho-update cadence (check windows)
+        "forecast_noise_cap": 3.0,  # max forecast-noise std (degC): the reference's
+                                    # unbounded 1.1^k growth breaks the season gate
+                                    # beyond ~16h horizons (see engine._prepare)
         "admm_rho": 0.1,
         "admm_sigma": 1e-6,
         "admm_reg": 1e-3,
@@ -176,6 +182,12 @@ _DEFAULT: dict[str, Any] = {
         "admm_eps": 1e-4,
         "fix_tou_peak": False,  # reference bug parity: peak price is overwritten by shoulder (dragg/aggregator.py:214-215)
         "mesh_axis": "homes",
+        # Flax DDPG agent knobs (rl.parameters.agent = "ddpg").
+        "ddpg_actor_lr": 1e-3,
+        "ddpg_critic_lr": 1e-3,
+        "ddpg_tau": 0.01,
+        "ddpg_policy_delay": 2,
+        "ddpg_hidden": 64,
     },
 }
 

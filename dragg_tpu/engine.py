@@ -139,6 +139,10 @@ class EngineParams(NamedTuple):
     admm_alpha: float
     admm_reg: float
     admm_refactor_every: int  # exact refactorization cadence (sim steps)
+    admm_patience: int  # solver stagnation-exit patience (0 disables; tests
+                        # pin it with eps=0 to force a fixed iteration count)
+    admm_rho_update_every: int  # in-loop rho-update cadence (check windows)
+    forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
 
 
@@ -241,10 +245,25 @@ class Engine:
         price_total = jnp.broadcast_to(price_total, (n, H))
 
         # --- Seasonal gate on the noisy forecast (dragg/mpc_calc.py:217-223,302-309).
+        # Per-home keys (not one (n, H) draw): each home's noise stream is a
+        # function of (seed, t, home index) alone, so it is invariant to the
+        # batch size — shard-padding a community must not perturb the real
+        # homes' forecasts (sharded-vs-single equivalence).
+        #
+        # Documented deviation: the reference's 1.1^k noise growth is
+        # unbounded — at the H=48 BASELINE horizon step 47 carries ±88 degC
+        # of "forecast error", which flips the 30 degC season gate to
+        # cooling-only in January and makes EVERY home infeasible (verified
+        # vs HiGHS).  The reference never ran horizons >16 h.  We cap the
+        # std at ``forecast_noise_cap`` (default 3 degC ~ 1.1^12, identical
+        # to the reference for the first 12 horizon steps).
         key = jax.random.fold_in(state.key, t)
-        noise = jax.random.normal(key, (n, H), dtype=f32) * jnp.power(
-            jnp.asarray(1.1, f32), jnp.arange(H, dtype=f32)
+        home_keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, jnp.arange(n))
+        noise_std = jnp.minimum(
+            jnp.power(jnp.asarray(1.1, f32), jnp.arange(H, dtype=f32)),
+            jnp.asarray(p.forecast_noise_cap, f32),
         )
+        noise = jax.vmap(lambda k: jax.random.normal(k, (H,), dtype=f32))(home_keys) * noise_std
         oat_ev_max = jnp.maximum(oat_w[0], jnp.max(oat_w[None, 1:] + noise, axis=1))
         winter = (oat_ev_max <= WINTER_MAX_OAT).astype(f32)
         heat_cap = winter * s
@@ -280,6 +299,8 @@ class Engine:
             eps_abs=p.admm_eps, eps_rel=p.admm_eps,
             reg=p.admm_reg,
             iters=p.admm_iters,
+            patience=p.admm_patience,
+            rho_update_every=p.admm_rho_update_every,
             x0=state.warm_x, y_box0=state.warm_y_box,
             rho0=state.warm_rho,
         )
@@ -423,10 +444,14 @@ class Engine:
     # ------------------------------------------------------------------ api
     def step(self, state: CommunityState, t: int, rp) -> tuple[CommunityState, StepOutputs]:
         """Run a single timestep (jitted).  Single-step calls always refresh
-        the factor cache — exact scalings + factorization every call."""
+        the factor cache — exact scalings + factorization every call.  The
+        (never-read) zero carry is cached: at 10k homes its Sinv alone is
+        ~237 MB, too much to allocate per call."""
+        if getattr(self, "_factor0", None) is None:
+            self._factor0 = self.init_factor()
         state, _, out = self._step_fn(
             state, jnp.asarray(t), jnp.asarray(rp, dtype=jnp.float32),
-            jnp.asarray(True), self.init_factor(),
+            jnp.asarray(True), self._factor0,
         )
         return state, out
 
@@ -463,6 +488,9 @@ def engine_params(config, start_index: int) -> EngineParams:
         admm_alpha=float(tpu_cfg.get("admm_alpha", 1.6)),
         admm_reg=float(tpu_cfg.get("admm_reg", 1e-3)),
         admm_refactor_every=int(tpu_cfg.get("admm_refactor_every", 8)),
+        admm_patience=int(tpu_cfg.get("admm_patience", 4)),
+        admm_rho_update_every=int(tpu_cfg.get("admm_rho_update_every", 4)),
+        forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),
     )
 

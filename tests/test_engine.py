@@ -165,3 +165,32 @@ def test_homes_config_cache(tmp_path):
     agg2 = Aggregator(config=cfg2, outputs_dir=str(tmp_path))
     agg2.get_homes()
     assert [h["name"] for h in agg2.all_homes] == names1
+
+
+@pytest.mark.slow
+def test_long_horizon_season_gate(tmp_path):
+    """H=48 regression: the reference's unbounded 1.1^k forecast-noise
+    growth flipped the 30 degC season gate to cooling-only in January at
+    long horizons, certifying every home primal-infeasible (verified vs
+    HiGHS).  With the capped noise std (tpu.forecast_noise_cap) the fleet
+    must solve at H=48."""
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = 8
+    cfg["community"]["homes_pv"] = 2
+    cfg["community"]["homes_battery"] = 1
+    cfg["community"]["homes_pv_battery"] = 1
+    cfg["home"]["hems"]["prediction_horizon"] = 48
+    env = load_environment(cfg, data_dir=None)
+    wd = load_waterdraw_profiles(None, seed=12)
+    homes = create_homes(cfg, 24, 1, wd)
+    batch = build_home_batch(homes, 48, 1, 6)
+    eng = make_engine(batch, env, cfg, 0)
+    state, out = eng.step(eng.init_state(), 0, np.zeros(48, dtype=np.float32))
+    solved = np.asarray(out.correct_solve)
+    assert solved.mean() >= 0.8, f"H=48 solve rate {solved.mean():.2f}"
+    # January: heating, never cooling.
+    assert float(np.asarray(out.hvac_cool_on).max()) == 0.0

@@ -1,0 +1,142 @@
+"""Component-level timing of the ADMM solve on the current backend.
+
+Times, at a given (B, H): the factor (Cholesky+inverse), sparse S formation,
+one 25-iteration window without rho refactors, the residual check, and the
+full solve — to attribute the per-step solve time seen in bench.py.
+
+Usage: python tools/profile_solver.py [B] [H]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    H = int(sys.argv[2]) if len(sys.argv) > 2 else 24
+
+    from dragg_tpu.config import default_config
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+    from dragg_tpu.ops import admm as A
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = B
+    cfg["community"]["homes_pv"] = int(0.4 * B)
+    cfg["community"]["homes_battery"] = int(0.1 * B)
+    cfg["community"]["homes_pv_battery"] = int(0.1 * B)
+    cfg["home"]["hems"]["prediction_horizon"] = H
+    env = load_environment(cfg, data_dir=None)
+    wd = load_waterdraw_profiles(None, seed=12)
+    homes = create_homes(cfg, 24 * 7, 1, wd)
+    hems = cfg["home"]["hems"]
+    batch = build_home_batch(homes, H, 1, int(hems["sub_subhourly_steps"]))
+    eng = make_engine(batch, env, cfg, 0)
+    state = eng.init_state()
+    qp, aux = jax.jit(eng._prepare)(state, jnp.asarray(0), jnp.zeros((H,), jnp.float32))
+    jax.block_until_ready(qp.vals)
+    pat = eng.static.pattern
+    m, n = pat.m, pat.n
+    print(f"B={B} H={H} m_eq={m} n={n} nnz={pat.nnz}", flush=True)
+
+    dev = jax.devices()[0]
+    print("device:", dev.device_kind, flush=True)
+
+    rows = jnp.asarray(pat.rows); cols = jnp.asarray(pat.cols)
+    d, e_eq, e_box, c = jax.jit(
+        lambda v, q: A.ruiz_equilibrate_sparse(pat, v, q, iters=10),
+        static_argnames=()
+    )(qp.vals, qp.q)
+    jax.block_until_ready(d)
+    vals_s = e_eq[:, rows] * qp.vals * d[:, cols]
+    schur = A._schur_structure_for(pat)
+    print("schur: n_s =", schur.n_s, "P =", schur.P, flush=True)
+
+    Dinv = jnp.ones((B, n), jnp.float32) * 0.5
+
+    form_S = jax.jit(lambda v, Di: A.form_schur_sparse(schur, m, v, Di))
+    S = form_S(vals_s, Dinv)
+    t_formS = timeit(form_S, vals_s, Dinv)
+
+    def chol_inv(S):
+        L = jnp.linalg.cholesky(S)
+        eye = jnp.broadcast_to(jnp.eye(m, dtype=S.dtype), S.shape)
+        Linv = lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
+        return jnp.einsum("bkm,bkn->bmn", Linv, Linv,
+                          precision=lax.Precision.HIGHEST)
+    chol_inv_j = jax.jit(chol_inv)
+    Sinv = chol_inv_j(S)
+    t_factor = timeit(chol_inv_j, S)
+
+    def chol_only(S):
+        return jnp.linalg.cholesky(S)
+    t_chol = timeit(jax.jit(chol_only), S)
+
+    r = jnp.ones((B, m), jnp.float32)
+
+    def matvec(Sinv, r):
+        return jnp.einsum("bmn,bn->bm", Sinv, r, precision=lax.Precision.HIGHEST)
+    t_mv = timeit(jax.jit(matvec), Sinv, r)
+
+    def s_solve_refine(Sinv, S, r):
+        v = matvec(Sinv, r)
+        resid = r - matvec(S, v)
+        return v + matvec(Sinv, resid)
+    t_refine = timeit(jax.jit(s_solve_refine), Sinv, S, r)
+
+    x = jnp.ones((B, n), jnp.float32)
+    row_cols = jnp.asarray(pat.row_cols); row_src = jnp.asarray(pat.row_src)
+    col_rows = jnp.asarray(pat.col_rows); col_src = jnp.asarray(pat.col_src)
+    vp_r = A._pad_gather(vals_s, row_src)
+    vp_c = A._pad_gather(vals_s, col_src)
+
+    def mv(x):
+        return jnp.sum(vp_r * x[:, row_cols], axis=2)
+
+    def mvt(y):
+        return jnp.sum(vp_c * y[:, col_rows], axis=2)
+    t_mv_sparse = timeit(jax.jit(mv), x)
+    t_mvt_sparse = timeit(jax.jit(mvt), r)
+
+    # One full solve (cold) with iteration counter.
+    solve = jax.jit(lambda v, b, l, u, q: A.admm_solve_qp(
+        pat, v, b, l, u, q, iters=1000, reg=1e-3))
+    sol = solve(qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q)
+    jax.block_until_ready(sol.x)
+    t_solve = timeit(solve, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q, reps=2)
+    iters = int(sol.iters)
+
+    print(f"form_S            {t_formS * 1e3:9.2f} ms")
+    print(f"cholesky          {t_chol * 1e3:9.2f} ms")
+    print(f"factor (chol+inv) {t_factor * 1e3:9.2f} ms")
+    print(f"Sinv matvec       {t_mv * 1e3:9.2f} ms")
+    print(f"s_solve refine=1  {t_refine * 1e3:9.2f} ms")
+    print(f"sparse mv         {t_mv_sparse * 1e3:9.2f} ms")
+    print(f"sparse mvt        {t_mvt_sparse * 1e3:9.2f} ms")
+    print(f"full solve        {t_solve * 1e3:9.2f} ms   ({iters} iters, "
+          f"{t_solve / max(iters, 1) * 1e3:.3f} ms/iter)")
+    print(f"solved: {int(jnp.sum(sol.solved))}/{B}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Scale validation: a 10k-home x 48h-horizon multi-day run (round-1 verdict
+item 4 / BASELINE.md row 5 regime on one chip).
+
+Asserts, per chunk: solve rate >= threshold, comfort bands held on solved
+steps (to fp32 band tolerance), all outputs finite.  Prints one JSON line.
+
+Usage: python tools/validate_scale.py [--homes 10000] [--horizon-hours 48]
+                                      [--days 2] [--chunk 8]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--homes", type=int, default=10_000)
+    ap.add_argument("--horizon-hours", type=int, default=48)
+    ap.add_argument("--days", type=int, default=2)
+    ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument("--min-solve-rate", type=float, default=0.97)
+    args = ap.parse_args()
+
+    import jax
+
+    from dragg_tpu.config import default_config
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+
+    cfg = default_config()
+    n = args.homes
+    cfg["community"]["total_number_homes"] = n
+    cfg["community"]["homes_pv"] = int(0.4 * n)
+    cfg["community"]["homes_battery"] = int(0.1 * n)
+    cfg["community"]["homes_pv_battery"] = int(0.1 * n)
+    cfg["home"]["hems"]["prediction_horizon"] = args.horizon_hours
+
+    env = load_environment(cfg, data_dir=None)
+    dt = int(cfg["agg"]["subhourly_steps"])
+    wd = load_waterdraw_profiles(None, seed=12)
+    num_ts = args.days * 24 * dt
+    homes = create_homes(cfg, num_ts, dt, wd)
+    hems = cfg["home"]["hems"]
+    batch = build_home_batch(homes, args.horizon_hours * dt, dt,
+                             int(hems["sub_subhourly_steps"]))
+    eng = make_engine(batch, env, cfg, 0)
+    state = eng.init_state()
+
+    tin_min = np.asarray(batch.temp_in_min)
+    tin_max = np.asarray(batch.temp_in_max)
+    twh_min = np.asarray(batch.temp_wh_min)
+    twh_max = np.asarray(batch.temp_wh_max)
+    band_tol = 0.05  # fp32 dynamics-row tolerance on ~degC scales
+
+    t = 0
+    rates, chunk_times, viol_max = [], [], 0.0
+    t_all = time.perf_counter()
+    while t < num_ts:
+        k = min(args.chunk, num_ts - t)
+        rps = np.zeros((k, eng.params.horizon), dtype=np.float32)
+        t0 = time.perf_counter()
+        state, outs = eng.run_chunk(state, t, rps)
+        jax.block_until_ready(outs.agg_load)
+        chunk_times.append(time.perf_counter() - t0)
+        solved = np.asarray(outs.correct_solve)       # (k, n)
+        rates.append(float(solved.mean()))
+        for leaf, name in zip(outs, outs._fields):
+            a = np.asarray(leaf)
+            assert np.all(np.isfinite(a)), f"non-finite {name} at t={t}"
+        tin = np.asarray(outs.temp_in)
+        twh = np.asarray(outs.temp_wh)
+        # Comfort bands on solved steps (unsolved steps run the bang-bang
+        # fallback, which tolerates excursions by design).
+        vi = np.where(solved > 0,
+                      np.maximum(tin_min[None] - tin, tin - tin_max[None]), -1.0)
+        vw = np.where(solved > 0,
+                      np.maximum(twh_min[None] - twh, twh - twh_max[None]), -1.0)
+        viol_max = max(viol_max, float(vi.max()), float(vw.max()))
+        t += k
+        print(f"[t={t}/{num_ts}] solve_rate={rates[-1]:.4f} "
+              f"chunk_s={chunk_times[-1]:.1f} viol_max={viol_max:.4f}",
+              file=sys.stderr, flush=True)
+
+    solve_rate = float(np.mean(rates))
+    result = {
+        "homes": n, "horizon_h": args.horizon_hours, "days": args.days,
+        "platform": jax.devices()[0].platform,
+        "device_kind": str(getattr(jax.devices()[0], "device_kind", "")),
+        "solve_rate": round(solve_rate, 4),
+        "comfort_violation_max": round(viol_max, 5),
+        "timesteps_per_s": round(num_ts / sum(chunk_times), 3),
+        "total_s": round(time.perf_counter() - t_all, 1),
+        "ok": bool(solve_rate >= args.min_solve_rate and viol_max <= band_tol),
+    }
+    print(json.dumps(result))
+    sys.exit(0 if result["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
