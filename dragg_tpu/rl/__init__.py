@@ -5,7 +5,9 @@ state bases, Gaussian policy, twin-Q critic fit by batch Ridge regression over
 a replay buffer, dragg/agent.py:42-232) becomes a pure-functional JAX core —
 one jittable ``train_step`` whose replay buffer, ridge solve and policy update
 all live on device — so the whole RL loop composes with the community engine
-inside a single ``lax.scan``.
+inside a single ``lax.scan``.  A Flax DDPG twin-Q core with the same step
+contract lives in :mod:`dragg_tpu.rl.neural` (``[rl.parameters] agent =
+"ddpg"``).
 """
 
 from dragg_tpu.rl.agent import RLAgent, UtilityAgent

@@ -51,21 +51,49 @@ class RLAgent:
 
     def __init__(self, config: dict, seed: int | None = None):
         self.config = config
-        self.params: AgentParams = params_from_config(config)
         if seed is None:
             seed = int(config["simulation"]["random_seed"])
-        self.carry: AgentCarry = init_carry(self.params, seed)
-        self._step = jax.jit(lambda c, o: train_step(c, o, self.params))
+        # Core selection: the reference's linear-basis actor-critic (default,
+        # dragg/agent.py:42-232) or the Flax DDPG twin-Q upgrade
+        # (BASELINE.md row 4) — same step contract, swappable per config.
+        self.kind = str(config["rl"]["parameters"].get("agent", "linear"))
+        if self.kind == "ddpg":
+            from dragg_tpu.rl import neural
+
+            self.params = neural.params_from_config(config)
+            self.carry = neural.init_carry(self.params, seed)
+            self.step_core = neural.train_step
+            extra_params = {"agent": "ddpg", "tau": self.params.tau,
+                            "actor_lr": self.params.actor_lr,
+                            "critic_lr": self.params.critic_lr}
+        elif self.kind == "linear":
+            self.params: AgentParams = params_from_config(config)
+            self.carry: AgentCarry = init_carry(self.params, seed)
+            self.step_core = train_step
+            extra_params = {
+                "agent": "linear",
+                "alpha_q": self.params.alpha_q,
+                "alpha_mu": self.params.alpha_mu,
+                "alpha_r": self.params.alpha_r,
+                "twin_q": self.params.n_q == 2,
+            }
+        else:
+            raise ValueError(
+                f"Unknown rl.parameters.agent {self.kind!r} (linear | ddpg)"
+            )
+        self._step = jax.jit(lambda c, o: self.step_core(c, o, self.params))
         self.rl_data: dict = {k: [] for k in RL_DATA_KEYS}
         self.rl_data["parameters"] = {
-            "alpha_q": self.params.alpha_q,
-            "alpha_mu": self.params.alpha_mu,
-            "alpha_r": self.params.alpha_r,
             "beta": self.params.beta,
             "batch_size": self.params.batch_size,
-            "twin_q": self.params.n_q == 2,
             "sigma": self.params.sigma,
+            **extra_params,
         }
+
+    def scan_step(self, carry, obs):
+        """The jittable (carry, obs) → (carry, record) hook the fused device
+        scans trace (dragg_tpu/rl/runner.py)."""
+        return self.step_core(carry, obs, self.params)
 
     # -- abstract surface (dragg/agent.py:67-69,113-123) --------------------
     def calc_state(self, env) -> dict:
@@ -90,16 +118,22 @@ class RLAgent:
         return float(self.carry.next_action)
 
     def get_policy_action(self, state: dict) -> float:
-        """Sample a ~ N(θ_μ·φ(s), σ) without updating (dragg/agent.py:151-165)."""
-        from dragg_tpu.rl.core import _policy_action
-
+        """Sample a ~ N(μ(s), σ) without updating (dragg/agent.py:151-165)."""
         key, sub = jax.random.split(self.carry.key)
         self.carry = self.carry._replace(key=key)
         sv = jnp.asarray(
             [state["fcst_error"], state["forecast_trend"], state["time_of_day"], state["delta_action"]],
             dtype=jnp.float32,
         )
-        a, _ = _policy_action(self.carry.theta_mu, sv, self.params.sigma, sub)
+        if self.kind == "ddpg":
+            from dragg_tpu.rl.neural import _mu
+
+            mu = _mu(self.carry.actor, sv, self.params)
+            a = mu + self.params.sigma * jax.random.normal(sub, (), jnp.float32)
+        else:
+            from dragg_tpu.rl.core import _policy_action
+
+            a, _ = _policy_action(self.carry.theta_mu, sv, self.params.sigma, sub)
         return float(a)
 
     # ------------------------------------------------------------- telemetry
@@ -139,7 +173,14 @@ class RLAgent:
 
     def load_from_previous(self, file: str) -> None:
         """Warm-start θ from a previous agent-results file
-        (dragg/agent.py:275-282)."""
+        (dragg/agent.py:275-282).  Linear core only: the DDPG telemetry
+        stores parameter norms, not weights — neural runs resume through
+        the checkpoint system instead (aggregator.save_checkpoint)."""
+        if self.kind == "ddpg":
+            raise ValueError(
+                "load_from_previous applies to the linear agent; resume a "
+                "DDPG run from its checkpoint directory instead"
+            )
         with open(file) as f:
             data = json.load(f)
         if data.get("theta_mu"):

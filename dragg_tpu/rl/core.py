@@ -225,20 +225,25 @@ def train_step(carry: AgentCarry, obs: RLObservation, params: AgentParams):
     )
     theta_q = _ridge_update(mid, params, k_ridge)
 
-    # Policy update (dragg/agent.py:215-232).  Two documented deviations from
-    # the reference, which as written cannot improve its policy:
+    # Policy update (dragg/agent.py:215-232).  Three documented deviations
+    # from the reference, which as written cannot improve its policy:
     # * TD error: standard target-minus-prediction (q_obs − q_pred); the
     #   reference computes the negation (dragg/agent.py:222), which performs
     #   gradient DESCENT on return;
     # * Gaussian score: ∇_μ log π = (a−μ)/σ²·φ(s); the reference multiplies
     #   by σ² (dragg/agent.py:229), mis-scaling updates by σ⁴ (≈1.6e5× too
-    #   small at the default σ=0.05).
+    #   small at the default σ=0.05);
+    # * the score is STANDARDIZED to (a−μ)/σ·φ(s) — the 1/σ² true-gradient
+    #   scale folded into the step size — so ``alpha`` stays a dimensionless
+    #   learning rate: with the raw score, any σ ≲ 0.05 needs α rescaled by
+    #   σ² or θ_μ diverges (measured: NaN within 3k steps at σ=0.02,
+    #   α=0.0625; stable and learning with the standardized form).
     x_k = _phi_s(state)
     delta = jnp.clip(q_obs - q_pred, -1.0, 1.0)
     avg_reward = carry.avg_reward + params.alpha_r * delta
     cum_reward = carry.cum_reward + r
     mu = jnp.clip(carry.theta_mu @ x_k, params.action_low, params.action_high)
-    grad_pi_mu = (action - mu) / (params.sigma ** 2) * x_k
+    grad_pi_mu = (action - mu) / params.sigma * x_k
     z = params.lam_theta * carry.z_theta_mu + grad_pi_mu
     theta_mu = carry.theta_mu + params.alpha_mu * delta * z
 

@@ -1,0 +1,325 @@
+"""Flax DDPG agent with twin-Q critics — the neural upgrade of the linear
+actor-critic core (BASELINE.md row 4: "DDPG + twin-Q (Flax)").
+
+Capability mapping to the reference agent (dragg/agent.py:42-232): same
+4-scalar observation, same replay-buffer + batch critic fit + policy-step
+structure — but the function approximators are MLPs trained by Adam instead
+of hand-built polynomial/Fourier bases fit by Ridge, and the critic targets
+use TD3-style tricks (twin critics with min-target, target networks with
+Polyak averaging) that the reference's twin-Q flag gestures at
+(dragg/agent.py:189-201).
+
+Everything is fixed-shape and jittable: ``DDPGCarry`` is a pytree threaded
+through ``lax.scan`` exactly like the linear ``AgentCarry``, so the fused
+rl_agg / rl_simplified device scans (dragg_tpu/rl/runner.py) work unchanged
+with either core — select with ``[rl.parameters] agent = "ddpg"``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from dragg_tpu.rl.core import RLObservation, StepRecord
+
+MEMORY_CAP = 2048  # replay capacity — matches the linear core's circular buffer
+
+STATE_DIM = 4
+ACTION_DIM = 1
+
+
+class DDPGParams(NamedTuple):
+    """Static hyperparameters (lr/tau/hidden are tpu-config extras; the rest
+    map to the reference's [rl.parameters], dragg/agent.py:78-86)."""
+
+    sigma: float        # exploration noise std (reference's epsilon)
+    beta: float         # discount
+    batch_size: int
+    actor_lr: float
+    critic_lr: float
+    tau: float          # Polyak target-update rate
+    policy_delay: int   # actor/target update cadence in steps (TD3)
+    action_low: float
+    action_high: float
+    hidden: int         # MLP width
+
+
+class MLP(nn.Module):
+    """Two-hidden-layer MLP; tanh head for the actor, linear for critics."""
+
+    hidden: int
+    out: int
+    tanh_out: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.tanh(nn.Dense(self.hidden)(x))
+        x = nn.tanh(nn.Dense(self.hidden)(x))
+        x = nn.Dense(self.out)(x)
+        return nn.tanh(x) if self.tanh_out else x
+
+
+class AdamState(NamedTuple):
+    """Minimal Adam moments (avoids carrying optax state pytrees whose
+    structure is opaque to checkpoint templates)."""
+
+    mu: dict
+    nu: dict
+    count: jnp.ndarray
+
+
+def _adam_init(params) -> AdamState:
+    z = jax.tree.map(jnp.zeros_like, params)
+    return AdamState(mu=z, nu=jax.tree.map(jnp.zeros_like, params),
+                     count=jnp.zeros((), jnp.int32))
+
+
+def _adam_update(grads, st: AdamState, params, lr: float,
+                 b1=0.9, b2=0.999, eps=1e-8):
+    count = st.count + 1
+    mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, st.mu, grads)
+    nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, st.nu, grads)
+    c = count.astype(jnp.float32)
+    mhat = jax.tree.map(lambda m: m / (1 - b1 ** c), mu)
+    vhat = jax.tree.map(lambda v: v / (1 - b2 ** c), nu)
+    new_params = jax.tree.map(
+        lambda p, m, v: p - lr * m / (jnp.sqrt(v) + eps), params, mhat, vhat
+    )
+    return new_params, AdamState(mu=mu, nu=nu, count=count)
+
+
+class DDPGCarry(NamedTuple):
+    """Agent state threaded through ``lax.scan``."""
+
+    actor: dict
+    critic1: dict
+    critic2: dict
+    t_actor: dict       # target networks
+    t_critic1: dict
+    t_critic2: dict
+    opt_actor: AdamState
+    opt_critic1: AdamState
+    opt_critic2: AdamState
+    state: jnp.ndarray        # (4,)
+    next_action: jnp.ndarray  # ()
+    avg_reward: jnp.ndarray
+    cum_reward: jnp.ndarray
+    t: jnp.ndarray            # () int32
+    mem_s: jnp.ndarray        # (CAP, 4)
+    mem_a: jnp.ndarray        # (CAP,)
+    mem_r: jnp.ndarray        # (CAP,)
+    mem_s1: jnp.ndarray       # (CAP, 4)
+    key: jnp.ndarray
+
+
+_actor_net: MLP | None = None
+_critic_net: MLP | None = None
+
+
+def _nets(hidden: int):
+    global _actor_net, _critic_net
+    if _actor_net is None or _actor_net.hidden != hidden:
+        _actor_net = MLP(hidden=hidden, out=ACTION_DIM, tanh_out=True)
+        _critic_net = MLP(hidden=hidden, out=1)
+    return _actor_net, _critic_net
+
+
+def _scale_action(raw, params: DDPGParams):
+    """tanh output in [-1, 1] → action space."""
+    lo, hi = params.action_low, params.action_high
+    return lo + (raw + 1.0) * 0.5 * (hi - lo)
+
+
+def _mu(actor_params, s, params: DDPGParams):
+    a_net, _ = _nets(params.hidden)
+    return _scale_action(a_net.apply(actor_params, s)[..., 0], params)
+
+
+def _q(critic_params, s, a, params: DDPGParams):
+    _, c_net = _nets(params.hidden)
+    sa = jnp.concatenate([s, a[..., None]], axis=-1)
+    return c_net.apply(critic_params, sa)[..., 0]
+
+
+def init_carry(params: DDPGParams, seed: int) -> DDPGCarry:
+    key = jax.random.PRNGKey(seed ^ 0xDD96)
+    key, ka, k1, k2 = jax.random.split(key, 4)
+    a_net, c_net = _nets(params.hidden)
+    s0 = jnp.zeros((STATE_DIM,), jnp.float32)
+    sa0 = jnp.zeros((STATE_DIM + ACTION_DIM,), jnp.float32)
+    actor = a_net.init(ka, s0)
+    critic1 = c_net.init(k1, sa0)
+    critic2 = c_net.init(k2, sa0)
+    f32 = jnp.float32
+    return DDPGCarry(
+        actor=actor, critic1=critic1, critic2=critic2,
+        t_actor=jax.tree.map(jnp.array, actor),
+        t_critic1=jax.tree.map(jnp.array, critic1),
+        t_critic2=jax.tree.map(jnp.array, critic2),
+        opt_actor=_adam_init(actor),
+        opt_critic1=_adam_init(critic1),
+        opt_critic2=_adam_init(critic2),
+        state=jnp.zeros((STATE_DIM,), f32),
+        next_action=jnp.zeros((), f32),
+        avg_reward=jnp.zeros((), f32),
+        cum_reward=jnp.zeros((), f32),
+        t=jnp.zeros((), jnp.int32),
+        mem_s=jnp.zeros((MEMORY_CAP, STATE_DIM), f32),
+        mem_a=jnp.zeros((MEMORY_CAP,), f32),
+        mem_r=jnp.zeros((MEMORY_CAP,), f32),
+        mem_s1=jnp.zeros((MEMORY_CAP, STATE_DIM), f32),
+        key=key,
+    )
+
+
+def _polyak(target, online, tau):
+    return jax.tree.map(lambda t, o: (1 - tau) * t + tau * o, target, online)
+
+
+def train_step(carry: DDPGCarry, obs: RLObservation, params: DDPGParams):
+    """One DDPG step with the same contract as the linear core's
+    ``train_step``: observe → memorize → (critic, actor, target) updates →
+    sample the next exploratory action.  Returns (carry, StepRecord) — the
+    record's ``theta_q``/``theta_mu`` slots carry network parameter norms
+    (scalars) so the telemetry schema stays write-compatible."""
+    f32 = jnp.float32
+    next_state = jnp.stack([
+        obs.fcst_error.astype(f32),
+        obs.forecast_trend.astype(f32),
+        obs.time_of_day.astype(f32),
+        obs.delta_action.astype(f32),
+    ])
+    first = carry.t == 0
+    state = jnp.where(first, next_state, carry.state)
+    action = carry.next_action
+    r = obs.reward.astype(f32)
+
+    key, k_next, k_idx = jax.random.split(carry.key, 3)
+
+    # Memorize (same slot discipline as the linear core: drop the t=0
+    # degenerate transition; slot k-1 holds step k's experience).
+    slot = jnp.mod(jnp.maximum(carry.t - 1, 0), MEMORY_CAP)
+    keep = lambda old, new: jnp.where(first, old, new)
+    mem_s = carry.mem_s.at[slot].set(keep(carry.mem_s[slot], state))
+    mem_a = carry.mem_a.at[slot].set(keep(carry.mem_a[slot], action))
+    mem_r = carry.mem_r.at[slot].set(keep(carry.mem_r[slot], r))
+    mem_s1 = carry.mem_s1.at[slot].set(keep(carry.mem_s1[slot], next_state))
+    valid = jnp.minimum(carry.t, MEMORY_CAP)
+
+    # --- Batch sample.
+    B = params.batch_size
+    idx = jax.random.randint(k_idx, (B,), 0, jnp.maximum(valid, 1))
+    bs, ba, br, bs1 = mem_s[idx], mem_a[idx], mem_r[idx], mem_s1[idx]
+
+    # --- Critic update: y = r + beta * min_i Q_ti(s', mu_t(s')).
+    a1 = _mu(carry.t_actor, bs1, params)
+    q1t = _q(carry.t_critic1, bs1, a1, params)
+    q2t = _q(carry.t_critic2, bs1, a1, params)
+    y = br + params.beta * jnp.minimum(q1t, q2t)
+
+    def critic_loss(cp):
+        return jnp.mean((_q(cp, bs, ba, params) - y) ** 2)
+
+    def gated(gate, new_pair, old_params, old_opt):
+        """Select (params, opt) updated-vs-unchanged.  Zeroing gradients is
+        NOT enough to freeze Adam — momentum keeps moving the parameters and
+        count skews bias correction — so the whole update is switched."""
+        new_params, new_opt = new_pair
+        pick = lambda a, b: jax.tree.map(
+            lambda x, y: jnp.where(gate > 0, x, y), a, b)
+        return pick(new_params, old_params), AdamState(
+            mu=pick(new_opt.mu, old_opt.mu),
+            nu=pick(new_opt.nu, old_opt.nu),
+            count=jnp.where(gate > 0, new_opt.count, old_opt.count),
+        )
+
+    do_update = (carry.t >= B).astype(f32)  # len(memory) > batch gate
+    g1 = jax.grad(critic_loss)(carry.critic1)
+    g2 = jax.grad(critic_loss)(carry.critic2)
+    critic1, opt_c1 = gated(
+        do_update,
+        _adam_update(g1, carry.opt_critic1, carry.critic1, params.critic_lr),
+        carry.critic1, carry.opt_critic1)
+    critic2, opt_c2 = gated(
+        do_update,
+        _adam_update(g2, carry.opt_critic2, carry.critic2, params.critic_lr),
+        carry.critic2, carry.opt_critic2)
+
+    # --- Delayed actor update: maximize Q1(s, mu(s)).
+    def actor_loss(ap):
+        return -jnp.mean(_q(critic1, bs, _mu(ap, bs, params), params))
+
+    delay = max(1, params.policy_delay)
+    do_actor = do_update * (jnp.mod(carry.t, delay) == 0).astype(f32)
+    ga = jax.grad(actor_loss)(carry.actor)
+    actor, opt_a = gated(
+        do_actor,
+        _adam_update(ga, carry.opt_actor, carry.actor, params.actor_lr),
+        carry.actor, carry.opt_actor)
+
+    # --- Polyak target updates (gated with the actor cadence).
+    tau = params.tau * do_actor
+    t_actor = _polyak(carry.t_actor, actor, tau)
+    t_critic1 = _polyak(carry.t_critic1, critic1, tau)
+    t_critic2 = _polyak(carry.t_critic2, critic2, tau)
+
+    # --- Next exploratory action.
+    mu_next = _mu(actor, next_state, params)
+    noise = params.sigma * jax.random.normal(k_next, (), f32)
+    next_action = jnp.clip(mu_next + noise, params.action_low, params.action_high)
+
+    q_pred = _q(carry.critic1, state[None, :], action[None], params)[0]
+    q_obs = r + params.beta * q_pred  # 1-step TD pair for telemetry
+    cum_reward = carry.cum_reward + r
+    avg_reward = carry.avg_reward + (r - carry.avg_reward) / (
+        carry.t.astype(f32) + 1.0
+    )
+
+    new_carry = DDPGCarry(
+        actor=actor, critic1=critic1, critic2=critic2,
+        t_actor=t_actor, t_critic1=t_critic1, t_critic2=t_critic2,
+        opt_actor=opt_a, opt_critic1=opt_c1, opt_critic2=opt_c2,
+        state=next_state, next_action=next_action,
+        avg_reward=avg_reward, cum_reward=cum_reward,
+        t=carry.t + 1,
+        mem_s=mem_s, mem_a=mem_a, mem_r=mem_r, mem_s1=mem_s1,
+        key=key,
+    )
+    pnorm = lambda p: jnp.sqrt(sum(
+        jnp.sum(x * x) for x in jax.tree.leaves(p)
+    ))
+    record = StepRecord(
+        theta_q=pnorm(critic1),
+        theta_mu=pnorm(actor),
+        q_obs=q_obs,
+        q_pred=q_pred,
+        action=action,
+        average_reward=avg_reward,
+        cumulative_reward=cum_reward,
+        reward=r,
+        mu=mu_next,
+    )
+    return new_carry, record
+
+
+def params_from_config(config: dict) -> DDPGParams:
+    """[rl.parameters] (+ optional [tpu] neural knobs) → DDPGParams."""
+    p = config["rl"]["parameters"]
+    space = config["rl"]["utility"]["action_space"]
+    tpu = config.get("tpu", {})
+    return DDPGParams(
+        sigma=float(p["epsilon"]),
+        beta=float(p["beta"]),
+        batch_size=int(p["batch_size"]),
+        actor_lr=float(tpu.get("ddpg_actor_lr", 1e-3)),
+        critic_lr=float(tpu.get("ddpg_critic_lr", 1e-3)),
+        tau=float(tpu.get("ddpg_tau", 0.01)),
+        policy_delay=int(tpu.get("ddpg_policy_delay", 2)),
+        action_low=float(space[0]),
+        action_high=float(space[1]),
+        hidden=int(tpu.get("ddpg_hidden", 64)),
+    )

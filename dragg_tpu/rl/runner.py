@@ -26,7 +26,6 @@ import numpy as np
 from jax import lax
 
 from dragg_tpu.rl.agent import UtilityAgent
-from dragg_tpu.rl.core import init_carry, params_from_config, train_step
 from dragg_tpu.rl.env import (
     EnvCarry,
     init_env_carry,
@@ -50,7 +49,7 @@ def _rl_settings(config: dict):
 # RL aggregator driving the MPC community (case "rl_agg")
 # --------------------------------------------------------------------------
 
-def _fused_step(engine, aparams, dt, norm, max_rp, rp_len, carry, t, t0):
+def _fused_step(engine, agent, dt, norm, max_rp, rp_len, carry, t, t0):
     """One fused RL + community-MPC timestep.
 
     Ordering parity with the reference's per-step flow: the agent trains on
@@ -69,7 +68,8 @@ def _fused_step(engine, aparams, dt, norm, max_rp, rp_len, carry, t, t0):
     """
     (cstate, acarry, env), factor = carry
     obs = observe(env, t, dt, norm)
-    acarry, rec = train_step(acarry, obs, aparams)
+    acarry, rec = agent.scan_step(acarry, obs)
+    aparams = agent.params
     action = jnp.clip(acarry.next_action, aparams.action_low, aparams.action_high)
     rp_scalar = jnp.clip(action, -max_rp, max_rp)
     H = engine.params.horizon
@@ -116,7 +116,7 @@ def run_rl_agg(agg) -> None:
     cstate = agg.engine.init_state()
 
     step = partial(
-        _fused_step, agg.engine, agent.params, agg.engine.params.dt, norm,
+        _fused_step, agg.engine, agent, agg.engine.params.dt, norm,
         settings["max_rp"], settings["action_horizon"] * agg.engine.params.dt,
     )
 
@@ -211,7 +211,7 @@ def run_rl_simplified(agg) -> None:
     def step(carry, t):
         acarry, env = carry
         obs = observe(env, t, dt, norm)
-        acarry, rec = train_step(acarry, obs, aparams)
+        acarry, rec = agent.scan_step(acarry, obs)
         action = jnp.clip(acarry.next_action, aparams.action_low, aparams.action_high)
         rp = jnp.clip(action, -max_rp, max_rp)
         load, cost = simplified_response(env.agg_load, rp, env.setpoint, c_rate)
