@@ -1,0 +1,35 @@
+# dragg_tpu container — replaces the reference's python:3 + redis + mongo
+# stack (dragg/Dockerfile:1-12, docker-compose.yml:2-29) with a single
+# self-contained image: the state bus is in-process (native/statebus.cpp),
+# so there are no sidecar services to wait for.
+#
+#   docker build -t dragg-tpu .
+#   docker run --rm -v $PWD/outputs:/app/outputs dragg-tpu \
+#       python -m dragg_tpu run --outputs-dir outputs
+#
+# For TPU VMs, base on a TPU-enabled JAX image instead:
+#   docker build --build-arg BASE=us-docker.pkg.dev/ml-images/public/jax-tpu:latest -t dragg-tpu .
+ARG BASE=python:3.12-slim
+FROM ${BASE}
+
+WORKDIR /app
+
+# Native toolchain for the C++ statebus/collector extension.
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+COPY pyproject.toml ./
+COPY dragg_tpu ./dragg_tpu
+COPY native ./native
+COPY bench.py ./
+
+# CPU JAX by default; the TPU base image ships its own jax[tpu].
+RUN python -c "import jax" 2>/dev/null || pip install --no-cache-dir jax flax
+RUN pip install --no-cache-dir numpy pandas matplotlib && \
+    pip install --no-cache-dir -e . --no-deps
+
+# Environment knobs mirror the reference's (DATA_DIR/CONFIG_FILE/OUTPUT_DIR,
+# dragg/aggregator.py:31-37; REDIS_HOST is gone — no Redis).
+ENV OUTPUT_DIR=/app/outputs
+
+CMD ["python", "-m", "dragg_tpu", "run", "--outputs-dir", "/app/outputs"]

@@ -1,0 +1,16 @@
+#!/bin/bash
+# SLURM batch script — parity with the reference's dragg/batch.sh:10-14,
+# minus the redis-server boot (state is in-process).  Submit with:
+#   sbatch deploy/batch.sh
+
+#SBATCH --time=04:00:00
+#SBATCH --nodes=1
+#SBATCH --ntasks=1
+#SBATCH --job-name="dragg-tpu"
+
+module purge
+# Activate whatever environment provides jax (TPU or CPU):
+#   source activate dragg-tpu
+
+cd "${SLURM_SUBMIT_DIR:-$(dirname "$0")/..}"
+python -u -m dragg_tpu run --outputs-dir "${OUTPUT_DIR:-outputs}"

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# TPU pod-slice launch for dragg_tpu — the TPU-native replacement for the
+# reference's HPC story (dragg/batch.sh:10-14 boots redis-server + main.py on
+# one SLURM node; here there is no Redis and the "cluster" is a TPU slice).
+#
+# Creates a TPU VM slice, installs the framework on every host, and runs the
+# simulation as one multi-host JAX program: jax.distributed.initialize()
+# enumerates all hosts' chips into a single mesh, and the home axis shards
+# over ICI/DCN automatically (dragg_tpu/parallel/mesh.py).
+#
+# Usage:
+#   ./deploy/launch_tpu_pod.sh <tpu-name> [accelerator-type] [zone] [-- run args]
+# Example:
+#   ./deploy/launch_tpu_pod.sh dragg-v4-8 v4-8 us-central2-b -- \
+#       --config config.toml --outputs-dir outputs
+set -euo pipefail
+
+TPU_NAME="${1:?usage: launch_tpu_pod.sh <tpu-name> [accel-type] [zone] [-- run args]}"
+ACCEL="${2:-v4-8}"
+ZONE="${3:-us-central2-b}"
+shift $(( $# >= 3 ? 3 : $# ))
+[ "${1:-}" = "--" ] && shift
+RUN_ARGS=("$@")
+
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+VERSION="tpu-ubuntu2204-base"
+
+echo ">> creating TPU slice ${TPU_NAME} (${ACCEL}) in ${ZONE}"
+gcloud compute tpus tpu-vm create "${TPU_NAME}" \
+    --zone="${ZONE}" --accelerator-type="${ACCEL}" --version="${VERSION}"
+
+echo ">> installing dragg_tpu on all hosts"
+gcloud compute tpus tpu-vm scp --recurse --worker=all --zone="${ZONE}" \
+    "${REPO_DIR}" "${TPU_NAME}:~/dragg_tpu_repo"
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --worker=all --zone="${ZONE}" \
+    --command='pip install "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+               && pip install -e ~/dragg_tpu_repo --no-deps && pip install flax pandas matplotlib'
+
+echo ">> launching the run on every host (one multi-host JAX program)"
+# jax.distributed.initialize() is a no-op on a single host and wires DCN on
+# pods; the same command runs on every worker.
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --worker=all --zone="${ZONE}" \
+    --command="cd ~/dragg_tpu_repo && python -c 'import jax; jax.distributed.initialize()' \
+               && python -m dragg_tpu run ${RUN_ARGS[*]:-}"
+
+echo ">> done.  Delete the slice with:"
+echo "   gcloud compute tpus tpu-vm delete ${TPU_NAME} --zone=${ZONE}"

@@ -185,6 +185,9 @@ class Aggregator:
         self.timestep = 0
         self.baseline_agg_load_list = []
         self._solve_iters = []
+        # Per-case Summary additions must not leak across cases (e.g. a
+        # baseline shape error surfacing in a clean rl_agg Summary).
+        self.extra_summary = {}
         if getattr(self, "collector", None) is not None:
             self.collector.close()
         n = len(self.all_homes)
@@ -224,6 +227,17 @@ class Aggregator:
         agg_loads = host["agg_load"]
         self.baseline_agg_load_list.extend(float(v) for v in agg_loads)
         self._solve_iters.extend(int(v) for v in host["admm_iters"])
+        # VERBOSE solver telemetry — the reference's per-solve CVXPY
+        # verbosity toggle (dragg/mpc_calc.py:81-86), batched per chunk.
+        if os.environ.get("VERBOSE"):
+            rate = float(host["correct_solve"].mean())
+            self.log.logger.progress(
+                f"chunk t={self.timestep}..{self.timestep + n_steps}: "
+                f"solve_rate={rate:.4f}, "
+                f"mean ADMM iters={host['admm_iters'].mean():.0f}, "
+                f"agg_load range=[{agg_loads.min():.1f}, {agg_loads.max():.1f}] kW"
+            )
+        self._log_home_failures(host["correct_solve"])
         # Per-step setpoint tracking.  Ordering parity: the reference
         # increments the timestep in run_iteration BEFORE collect_data calls
         # gen_setpoint (dragg/aggregator.py:726,755), and the setpoint
@@ -238,6 +252,36 @@ class Aggregator:
                 self.agg_setpoint = self.gen_setpoint()
                 if self.timestep < self.num_timesteps:
                     self.all_sps[self.timestep] = self.agg_setpoint
+
+    def _log_home_failures(self, correct_solve: np.ndarray) -> None:
+        """Per-home failure logs — the analog of the reference's per-home
+        WARN-level worker log files (home_logs/<name>.log,
+        dragg/mpc_calc.py:655-658).  There is no per-home process here, so
+        the batched ``correct_solve`` mask drives the same artifact: one log
+        file per home that ever fell back, appended lazily (a healthy
+        100k-home run creates zero files)."""
+        failed = np.argwhere(np.asarray(correct_solve) == 0.0)
+        if failed.size == 0 or self.run_dir is None:
+            return
+        log_dir = os.path.join(self.run_dir, "home_logs")
+        os.makedirs(log_dir, exist_ok=True)
+        base_t = self.timestep
+        by_home: dict[int, list[int]] = {}
+        for k, i in failed:
+            by_home.setdefault(int(i), []).append(base_t + int(k))
+        for i, steps in by_home.items():
+            name = self.all_homes[i]["name"]
+            with open(os.path.join(log_dir, f"{name}.log"), "a") as f:
+                for t in steps:
+                    f.write(
+                        f"WARNING - {name} - timestep {t}: MPC solve failed "
+                        f"tolerance; fallback controller engaged\n"
+                    )
+
+    def reset_seed(self, new_seed: int) -> None:
+        """Reset the population seed (dragg/aggregator.py:255-261); takes
+        effect on the next ``get_homes()``/``create_homes()``."""
+        self.config["simulation"]["random_seed"] = int(new_seed)
 
     # ----------------------------------------------------------- RL setpoint
     def gen_setpoint(self) -> float:
