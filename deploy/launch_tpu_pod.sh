@@ -16,10 +16,14 @@
 set -euo pipefail
 
 TPU_NAME="${1:?usage: launch_tpu_pod.sh <tpu-name> [accel-type] [zone] [-- run args]}"
-ACCEL="${2:-v4-8}"
-ZONE="${3:-us-central2-b}"
-shift $(( $# >= 3 ? 3 : $# ))
+shift
+# Optional positionals up to the "--" separator; everything after it is
+# passed to `python -m dragg_tpu run` verbatim.
+POS=()
+while [ $# -gt 0 ] && [ "$1" != "--" ]; do POS+=("$1"); shift; done
 [ "${1:-}" = "--" ] && shift
+ACCEL="${POS[0]:-v4-8}"
+ZONE="${POS[1]:-us-central2-b}"
 RUN_ARGS=("$@")
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
@@ -37,11 +41,11 @@ gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --worker=all --zone="${ZONE}" \
                && pip install -e ~/dragg_tpu_repo --no-deps && pip install flax pandas matplotlib'
 
 echo ">> launching the run on every host (one multi-host JAX program)"
-# jax.distributed.initialize() is a no-op on a single host and wires DCN on
-# pods; the same command runs on every worker.
+# DRAGG_DISTRIBUTED=1 makes the run entry call jax.distributed.initialize()
+# IN-PROCESS before building the mesh (dragg_tpu/__main__.py), so every
+# worker's command joins a single JAX program spanning all hosts' chips.
 gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --worker=all --zone="${ZONE}" \
-    --command="cd ~/dragg_tpu_repo && python -c 'import jax; jax.distributed.initialize()' \
-               && python -m dragg_tpu run ${RUN_ARGS[*]:-}"
+    --command="cd ~/dragg_tpu_repo && DRAGG_DISTRIBUTED=1 python -m dragg_tpu run ${RUN_ARGS[*]:-}"
 
 echo ">> done.  Delete the slice with:"
 echo "   gcloud compute tpus tpu-vm delete ${TPU_NAME} --zone=${ZONE}"

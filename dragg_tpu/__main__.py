@@ -36,6 +36,15 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "run":
+        # Multi-host pod slices: every worker runs this same command and the
+        # coordinator handshake merges them into ONE JAX program whose
+        # jax.devices() spans all hosts (deploy/launch_tpu_pod.sh sets the
+        # env var).  No-op on a single host.
+        if os.environ.get("DRAGG_DISTRIBUTED") == "1":
+            import jax
+
+            jax.distributed.initialize()
+
         from dragg_tpu.aggregator import Aggregator
 
         Aggregator(config=args.config, data_dir=args.data_dir,

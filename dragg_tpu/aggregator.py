@@ -188,6 +188,9 @@ class Aggregator:
         # Per-case Summary additions must not leak across cases (e.g. a
         # baseline shape error surfacing in a clean rl_agg Summary).
         self.extra_summary = {}
+        # Wall-clock phase attribution (device scan vs host collect),
+        # surfaced as Summary.phase_times.
+        self._phase_times = {"device_chunks": 0.0, "collect": 0.0}
         if getattr(self, "collector", None) is not None:
             self.collector.close()
         n = len(self.all_homes)
@@ -460,8 +463,16 @@ class Aggregator:
         while t < self.num_timesteps:
             n_steps = min(self.checkpoint_interval, self.num_timesteps - t)
             rps = np.zeros((n_steps, H), dtype=np.float32)
-            state, outs = self.engine.run_chunk(state, t, rps)
+            t0 = time.perf_counter()
+            with self._maybe_profile(chunks):
+                state, outs = self.engine.run_chunk(state, t, rps)
+                import jax
+
+                jax.block_until_ready(outs.agg_load)
+            self._phase_times["device_chunks"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
             self._collect_chunk(outs)
+            self._phase_times["collect"] += time.perf_counter() - t0
             t += n_steps
             chunks += 1
             if t < self.num_timesteps:
@@ -473,6 +484,24 @@ class Aggregator:
                     self._state = state
                     return
         self._state = state
+
+    def _maybe_profile(self, chunk_idx: int):
+        """Profiler trace around one device chunk (SURVEY §5.1: the
+        reference's only tracing is wall-clock solve_time;
+        dragg/aggregator.py:765,788-799).  When ``tpu.profile_dir`` (or
+        ``JAX_PROFILE_DIR``) is set, the SECOND chunk — the first is the
+        compile — is traced for TensorBoard/xprof."""
+        import contextlib
+
+        profile_dir = os.environ.get(
+            "JAX_PROFILE_DIR", self.config.get("tpu", {}).get("profile_dir", "")
+        )
+        if not profile_dir or chunk_idx != 1:
+            return contextlib.nullcontext()
+        import jax
+
+        self.log.logger.info(f"Writing profiler trace to {profile_dir}")
+        return jax.profiler.trace(profile_dir)
 
     def check_baseline_vals(self) -> list[str]:
         """Result-shape check over the check_type-selected homes
@@ -540,6 +569,8 @@ class Aggregator:
             "p_grid_setpoint": self.all_sps.tolist(),
             # dragg_tpu extras (additive; Reformat ignores unknown keys).
             "solver_iterations": list(self._solve_iters),
+            "phase_times": {k: round(v, 3) for k, v in
+                            getattr(self, "_phase_times", {}).items()},
         }
         # The reference wraps the price series in a 1-tuple — a trailing-comma
         # bug (dragg/aggregator.py:814-816) we do NOT reproduce.

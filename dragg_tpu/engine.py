@@ -142,6 +142,9 @@ class EngineParams(NamedTuple):
     admm_patience: int  # solver stagnation-exit patience (0 disables; tests
                         # pin it with eps=0 to force a fixed iteration count)
     admm_rho_update_every: int  # in-loop rho-update cadence (check windows)
+    admm_matvec_dtype: str  # "f32" | "bf16" Sinv storage for the hot matvec
+    admm_refine: int    # refinement passes per in-loop KKT solve
+    admm_anderson: int  # Anderson-acceleration history depth (0 = off)
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
 
@@ -203,7 +206,8 @@ class Engine:
         carries — NOT in CommunityState — so checkpoints never pay for the
         (n, m, m) Schur inverse (237 MB at 10k homes, ~9 GB at the
         100k-home/H=48 target); every chunk's first step refreshes it."""
-        return init_factor_carry(self.n_homes, self.static.pattern)
+        return init_factor_carry(self.n_homes, self.static.pattern,
+                                 matvec_dtype=self.params.admm_matvec_dtype)
 
     # ----------------------------------------------------------------- step
     def _prepare(self, state: CommunityState, t, rp):
@@ -301,6 +305,9 @@ class Engine:
             iters=p.admm_iters,
             patience=p.admm_patience,
             rho_update_every=p.admm_rho_update_every,
+            matvec_dtype=p.admm_matvec_dtype,
+            refine=p.admm_refine,
+            anderson=p.admm_anderson,
             x0=state.warm_x, y_box0=state.warm_y_box,
             rho0=state.warm_rho,
         )
@@ -490,6 +497,9 @@ def engine_params(config, start_index: int) -> EngineParams:
         admm_refactor_every=int(tpu_cfg.get("admm_refactor_every", 8)),
         admm_patience=int(tpu_cfg.get("admm_patience", 4)),
         admm_rho_update_every=int(tpu_cfg.get("admm_rho_update_every", 4)),
+        admm_matvec_dtype=str(tpu_cfg.get("admm_matvec_dtype", "f32")),
+        admm_refine=int(tpu_cfg.get("admm_refine", 0)),
+        admm_anderson=int(tpu_cfg.get("admm_anderson", 0)),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),
     )

@@ -189,6 +189,19 @@ def _admm_impl(
                                 # the whole solve), so updates are considered
                                 # every Nth residual check, not every one
     patience: int = 4,       # stagnation exit in check windows; 0 disables
+    matvec_dtype: str = "f32",  # "bf16": store Sinv in bfloat16 — halves the
+                                # HBM traffic of the dominant per-iteration
+                                # matvec; refinement against the f32 S
+                                # recovers accuracy (opt-in: effective only
+                                # when cond(Ŝ) stays modest)
+    refine: int = 1,         # iterative-refinement passes per in-loop solve
+    anderson: int = 0,       # Anderson-acceleration history depth (0 = off).
+                             # Type-II AA applied once per check window on
+                             # the (z, y) pair — the window map T^check_every
+                             # is a fixed-point map on (z, y) since sigma~0 —
+                             # with a per-home residual safeguard that
+                             # reverts to the plain iterate and clears the
+                             # home's history when acceleration regresses
     x0: jnp.ndarray | None = None,
     y_box0: jnp.ndarray | None = None,
     rho0: jnp.ndarray | None = None,
@@ -204,6 +217,7 @@ def _admm_impl(
     B = vals.shape[0]
     m_eq, n = pat.m, pat.n
     dtype = vals.dtype
+    store_dtype = jnp.bfloat16 if matvec_dtype == "bf16" else dtype
 
     rows = jnp.asarray(pat.rows)
     cols = jnp.asarray(pat.cols)
@@ -275,7 +289,7 @@ def _admm_impl(
             L, jnp.broadcast_to(eye_m, S.shape), left_side=True, lower=True
         )
         Sinv = jnp.einsum("bkm,bkn->bmn", Linv, Linv, precision=lax.Precision.HIGHEST)
-        return Dinv, Sinv, S
+        return Dinv, Sinv.astype(store_dtype), S
 
     def stale_factor(rho_b):
         """Reuse the carried Schur inverse as a preconditioner: Dinv and S
@@ -287,20 +301,25 @@ def _admm_impl(
 
     def s_solve(F, r, refine: int = 1):
         """S⁻¹ r with ``refine`` iterative-refinement steps (recovers f32
-        accuracy of the explicit inverse and absorbs stale-factor drift;
-        1 + 2·refine batched matmuls)."""
+        accuracy of the explicit inverse — which may be stored bf16 — and
+        absorbs stale-factor drift; 1 + 2·refine batched matmuls)."""
         _, Sinv, S = F
-        v = jnp.einsum("bmn,bn->bm", Sinv, r, precision=lax.Precision.HIGHEST)
+        pinv = lambda rr: jnp.einsum(
+            "bmn,bn->bm", Sinv, rr.astype(Sinv.dtype),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=dtype,
+        )
+        v = pinv(r)
         for _ in range(refine):
             resid = r - jnp.einsum("bmn,bn->bm", S, v, precision=lax.Precision.HIGHEST)
-            v = v + jnp.einsum("bmn,bn->bm", Sinv, resid, precision=lax.Precision.HIGHEST)
+            v = v + pinv(resid)
         return v
 
     def kkt_solve(F, rhs):
         """x-update KKT solve: x = D⁻¹(rhs − Âᵀν), ν = S⁻¹(Â D⁻¹ rhs − b̂).
         Equalities hold to solver accuracy at EVERY iterate."""
         Dinv = F[0]
-        nu = s_solve(F, mv(Dinv * rhs) - bs)
+        nu = s_solve(F, mv(Dinv * rhs) - bs, refine=refine)
         return Dinv * (rhs - mvt(nu)), nu
 
     rho_b = jnp.full((B,), rho, dtype=dtype) if rho0 is None else rho0.astype(dtype)
@@ -366,13 +385,83 @@ def _admm_impl(
         cond2 = sup <= -eps_inf
         return cond1 & cond2 & (norm_dy > 1e-10)
 
+    # ---- Anderson acceleration state (see the ``anderson`` parameter).
+    K_aa = int(anderson)
+    D_aa = 2 * n
+
+    def aa_init():
+        return (
+            jnp.zeros((K_aa, B, D_aa), dtype=dtype),   # hist_s: window entries
+            jnp.zeros((K_aa, B, D_aa), dtype=dtype),   # hist_t: their T-images
+            jnp.zeros((B,), jnp.int32),                # cnt: valid history len
+            jnp.full((B,), jnp.inf, dtype=dtype),      # prev_r: safeguard ref
+            jnp.zeros((B,), bool),                     # applied last window
+            jnp.zeros((B, D_aa), dtype=dtype),         # plain fallback iterate
+        )
+
+    def aa_step(aa, widx, s_entry, s_plain, r_tot, done, rho_changed):
+        """One AA update at a window boundary.  Returns (aa', s_next,
+        use_mask); ``s_next`` seeds the next window where ``use_mask``."""
+        hist_s, hist_t, cnt, prev_r, applied, s_plain_prev = aa
+        # Safeguard: a window that started from an accelerated point and
+        # regressed reverts to the last plain iterate and restarts history.
+        revert = applied & (r_tot > 2.0 * prev_r) & ~done
+        base = jnp.where(revert[:, None], s_plain_prev, s_plain)
+        cnt = jnp.where(revert | rho_changed, 0, cnt)
+        slot = jnp.mod(widx, K_aa)
+        # The stored pair is ALWAYS the true map application (s_entry →
+        # s_plain) — even on a revert, where the continuation state differs
+        # from the observed image (storing ``base`` would corrupt the first
+        # post-restart extrapolation).
+        hist_s = lax.dynamic_update_index_in_dim(hist_s, s_entry, slot, 0)
+        hist_t = lax.dynamic_update_index_in_dim(hist_t, s_plain, slot, 0)
+        cnt = jnp.minimum(cnt + 1, K_aa)
+        # Per-home slot validity: the c most recent circular slots.
+        ages = jnp.mod(widx - jnp.arange(K_aa), K_aa)        # (K,)
+        valid = ages[None, :] < cnt[:, None]                 # (B, K)
+        G = jnp.transpose(hist_s - hist_t, (1, 0, 2)) * valid[..., None]  # (B, K, D)
+        M = jnp.einsum("bkd,bjd->bkj", G, G, precision=lax.Precision.HIGHEST)
+        gnorm = jnp.maximum(jnp.einsum("bkk->b", M), 1e-12)
+        M = M + (1e-8 * gnorm)[:, None, None] * jnp.eye(K_aa, dtype=dtype)
+        # Invalid slots: unit diagonal, excluded from the sum-to-one row.
+        inv = ~valid
+        M = jnp.where((inv[:, :, None] | inv[:, None, :]),
+                      jnp.eye(K_aa, dtype=dtype)[None], M)
+        o = valid.astype(dtype)                              # (B, K)
+        kkt = jnp.concatenate([
+            jnp.concatenate([M, o[:, :, None]], axis=2),
+            jnp.concatenate([o[:, None, :], jnp.zeros((B, 1, 1), dtype)], axis=2),
+        ], axis=1)                                           # (B, K+1, K+1)
+        rhs = jnp.zeros((B, K_aa + 1), dtype).at[:, -1].set(1.0)
+        gamma = jnp.linalg.solve(kkt, rhs[..., None])[..., 0][:, :K_aa]  # (B, K)
+        gamma = gamma * o
+        s_acc = jnp.einsum("bk,kbd->bd", gamma, hist_t)
+        finite = jnp.all(jnp.isfinite(s_acc), axis=1)
+        use = (cnt >= 2) & ~done & ~revert & finite
+        s_next = jnp.where(use[:, None], s_acc, base)
+        # ``applied`` marks every synthetic jump — AA extrapolations AND
+        # safeguard reverts — so the next window suppresses both its
+        # infeasibility certificate and a cascading re-revert.
+        aa = (hist_s, hist_t, cnt, r_tot, use | revert, base)
+        return aa, s_next, use | revert
+
     def chunk(carry):
-        state, rho_b, F, it, _, pinf, best_done, best_r, last_improve = carry
+        if K_aa > 0:
+            state, rho_b, F, it, _, pinf, best_done, best_r, last_improve, aa = carry
+        else:
+            state, rho_b, F, it, _, pinf, best_done, best_r, last_improve = carry
         x0_, z0_, nu_prev, y_box_prev = state
+        aa_entry = jnp.concatenate([state[1], state[3]], axis=1) if K_aa > 0 else None
+        applied_entry = aa[4] if K_aa > 0 else None
         state = lax.fori_loop(0, check_every, lambda _, cc: one_iter(F, rho_b, cc), state)
         x, z_box, nu, y_box = state
         r_prim, r_dual, p_sc, d_sc, ok = residuals(x, z_box, nu, y_box)
-        pinf = pinf | primal_infeasible(nu - nu_prev, y_box - y_box_prev)
+        new_pinf = primal_infeasible(nu - nu_prev, y_box - y_box_prev)
+        if K_aa > 0:
+            # A window seeded by an AA jump has a synthetic dual direction —
+            # don't let it mint an infeasibility certificate.
+            new_pinf = new_pinf & ~applied_entry
+        pinf = pinf | new_pinf
         done = ok | pinf
         it = it + check_every
         # Progress = another home finished OR ANY unfinished home's residual
@@ -387,6 +476,7 @@ def _admm_impl(
         best_done = jnp.maximum(best_done, n_done)
         best_r = jnp.minimum(best_r, r_tot)
         last_improve = jnp.where(improved, it, last_improve)
+        rho_changed = jnp.zeros((B,), bool)
         if adaptive_rho:
             ratio = jnp.sqrt(
                 (r_prim / jnp.maximum(p_sc, 1e-10)) / jnp.maximum(r_dual / jnp.maximum(d_sc, 1e-10), 1e-10)
@@ -396,11 +486,20 @@ def _admm_impl(
             update = ((ratio > 5.0) | (ratio < 0.2)) & win_due
             rho_next = jnp.where(update & ~done, rho_new, rho_b)
             F = lax.cond(jnp.any(rho_next != rho_b), factor, lambda _: F, rho_next)
+            rho_changed = rho_next != rho_b
             rho_b = rho_next
+        if K_aa > 0:
+            widx = it // check_every - 1
+            s_plain = jnp.concatenate([z_box, y_box], axis=1)
+            aa, s_next, _ = aa_step(aa, widx, aa_entry, s_plain,
+                                    r_tot, done, rho_changed)
+            state = (x, s_next[:, :n], nu, s_next[:, n:])
+            return (state, rho_b, F, it, jnp.all(done), pinf, best_done,
+                    best_r, last_improve, aa)
         return state, rho_b, F, it, jnp.all(done), pinf, best_done, best_r, last_improve
 
     def cond(carry):
-        _, _, _, it, all_done, _, _, _, last_improve = carry
+        it, all_done, last_improve = carry[3], carry[4], carry[8]
         keep = (it < iters) & (~all_done)
         if patience > 0:
             keep = keep & (it - last_improve < patience * check_every)
@@ -412,11 +511,12 @@ def _admm_impl(
         F = lax.cond(refresh, factor, stale_factor, rho_b)
     state = (x, z_box, nu, y_box)
     pinf0 = jnp.zeros((B,), dtype=bool)
-    state, rho_b, F, it, _, pinf, _, _, _ = lax.while_loop(
-        cond, chunk,
-        (state, rho_b, F, jnp.asarray(0), jnp.asarray(False), pinf0,
-         jnp.asarray(-1), jnp.full((B,), jnp.inf, dtype=dtype), jnp.asarray(0)),
-    )
+    carry0 = (state, rho_b, F, jnp.asarray(0), jnp.asarray(False), pinf0,
+              jnp.asarray(-1), jnp.full((B,), jnp.inf, dtype=dtype), jnp.asarray(0))
+    if K_aa > 0:
+        carry0 = (*carry0, aa_init())
+    out = lax.while_loop(cond, chunk, carry0)
+    state, rho_b, F, it, _, pinf = out[0], out[1], out[2], out[3], out[4], out[5]
     x, z_box, nu, y_box = state
     r_prim, r_dual, _, _, ok = residuals(x, z_box, nu, y_box)
 
@@ -440,7 +540,7 @@ def _admm_impl(
 
 
 _STATIC = ("pat", "iters", "check_every", "ruiz_iters", "adaptive_rho",
-           "rho_update_every", "patience")
+           "rho_update_every", "patience", "matvec_dtype", "refine", "anderson")
 
 
 @partial(jax.jit, static_argnames=_STATIC)
@@ -462,14 +562,16 @@ def admm_solve_qp_cached(pat, vals, b_eq, l_box, u_box, q, carry_in, refresh,
                       refresh=refresh, **kwargs)
 
 
-def init_factor_carry(B: int, pat: SparsePattern, dtype=jnp.float32) -> FactorCarry:
+def init_factor_carry(B: int, pat: SparsePattern, dtype=jnp.float32,
+                      matvec_dtype: str = "f32") -> FactorCarry:
     """Zero-filled carry for t=0 (the first step must pass refresh=True)."""
+    sinv_dtype = jnp.bfloat16 if matvec_dtype == "bf16" else dtype
     return FactorCarry(
         d=jnp.ones((B, pat.n), dtype=dtype),
         e_eq=jnp.ones((B, pat.m), dtype=dtype),
         e_box=jnp.ones((B, pat.n), dtype=dtype),
         c=jnp.ones((B, 1), dtype=dtype),
-        Sinv=jnp.zeros((B, pat.m, pat.m), dtype=dtype),
+        Sinv=jnp.zeros((B, pat.m, pat.m), dtype=sinv_dtype),
     )
 
 

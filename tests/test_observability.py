@@ -90,3 +90,25 @@ def test_reset_seed_changes_population(tmp_path):
     agg.get_homes()
     names2 = [h["name"] for h in agg.all_homes]
     assert names1 != names2
+
+
+def test_profiler_trace_and_phase_times(tmp_path):
+    """tpu.profile_dir wraps the second device chunk in a jax.profiler trace
+    and Summary carries the wall-clock phase attribution (SURVEY §5.1)."""
+    cfg = _tiny_cfg()
+    cfg["simulation"]["end_datetime"] = "2015-01-01 08"
+    cfg["simulation"]["checkpoint_interval"] = "hourly"  # several chunks
+    prof = str(tmp_path / "trace")
+    cfg["tpu"]["profile_dir"] = prof
+    agg = Aggregator(cfg, data_dir=None, outputs_dir=str(tmp_path / "out"))
+    agg.run()
+    assert os.path.isdir(prof) and os.listdir(prof), "no profiler trace written"
+    import glob as _glob
+    import json as _json
+
+    res = _glob.glob(os.path.join(str(tmp_path / "out"), "**", "results.json"),
+                     recursive=True)
+    summary = _json.load(open(res[0]))["Summary"]
+    pt = summary["phase_times"]
+    assert pt["device_chunks"] > 0.0
+    assert pt["collect"] >= 0.0
