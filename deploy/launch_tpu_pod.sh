@@ -22,6 +22,10 @@ shift
 POS=()
 while [ $# -gt 0 ] && [ "$1" != "--" ]; do POS+=("$1"); shift; done
 [ "${1:-}" = "--" ] && shift
+if [ "${#POS[@]}" -gt 2 ]; then
+    echo "error: unexpected positional args '${POS[*]:2}' — put run args after '--'" >&2
+    exit 2
+fi
 ACCEL="${POS[0]:-v4-8}"
 ZONE="${POS[1]:-us-central2-b}"
 RUN_ARGS=("$@")

@@ -180,6 +180,8 @@ _DEFAULT: dict[str, Any] = {
                            # HBM traffic net (final polish still refines)
         "admm_anderson": 0,  # Anderson-acceleration depth (opt-in: measured
                              # -16% warm iterations, slight solve-rate dip)
+        "admm_banded_factor": True,  # RCM + banded-Cholesky Schur factor
+                                     # (O(Bm·bw²) vs dense O(Bm³); bw=4)
         "forecast_noise_cap": 3.0,  # max forecast-noise std (degC): the reference's
                                     # unbounded 1.1^k growth breaks the season gate
                                     # beyond ~16h horizons (see engine._prepare)

@@ -145,6 +145,7 @@ class EngineParams(NamedTuple):
     admm_matvec_dtype: str  # "f32" | "bf16" Sinv storage for the hot matvec
     admm_refine: int    # refinement passes per in-loop KKT solve
     admm_anderson: int  # Anderson-acceleration history depth (0 = off)
+    admm_banded_factor: bool  # banded-Cholesky Schur factorization
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
 
@@ -308,6 +309,7 @@ class Engine:
             matvec_dtype=p.admm_matvec_dtype,
             refine=p.admm_refine,
             anderson=p.admm_anderson,
+            banded_factor=p.admm_banded_factor,
             x0=state.warm_x, y_box0=state.warm_y_box,
             rho0=state.warm_rho,
         )
@@ -500,6 +502,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         admm_matvec_dtype=str(tpu_cfg.get("admm_matvec_dtype", "f32")),
         admm_refine=int(tpu_cfg.get("admm_refine", 0)),
         admm_anderson=int(tpu_cfg.get("admm_anderson", 0)),
+        admm_banded_factor=bool(tpu_cfg.get("admm_banded_factor", True)),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),
     )

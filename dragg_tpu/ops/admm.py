@@ -195,6 +195,11 @@ def _admm_impl(
                                 # recovers accuracy (opt-in: effective only
                                 # when cond(Ŝ) stays modest)
     refine: int = 1,         # iterative-refinement passes per in-loop solve
+    banded_factor: bool = True,  # factor S via RCM + banded Cholesky scans
+                                 # (O(Bm·bw²)) instead of batched dense
+                                 # Cholesky + triangular solves (O(Bm³));
+                                 # automatic dense fallback when the pattern
+                                 # is not banded (plan_for returns None)
     anderson: int = 0,       # Anderson-acceleration history depth (0 = off).
                              # Type-II AA applied once per check window on
                              # the (z, y) pair — the window map T^check_every
@@ -275,20 +280,39 @@ def _admm_impl(
         ADi = As_dense * Dinv[:, None, :]
         return jnp.einsum("bmn,bkn->bmk", ADi, As_dense, precision=lax.Precision.HIGHEST)
 
+    band_plan = None
+    if banded_factor and schur is not None:
+        from dragg_tpu.ops.banded import plan_for
+
+        band_plan = plan_for(schur, m_eq)
+
     def factor(rho_b):
         """Schur-complement factor of the equality-constrained x-update.
 
         Returns (Dinv, Sinv, S): S is SPD m_eq×m_eq; S⁻¹ formed explicitly
-        via Cholesky + two batched matrix-matrix triangular solves so the
-        per-iteration solve is pure batched matmul; S kept for refinement.
+        so the per-iteration solve is pure batched matmul; S kept for
+        refinement.  With a banded plan, the Cholesky + triangular solves
+        run as O(m·bw²) band scans instead of dense O(m³) batched kernels
+        (the 10k-home factor hotspot, docs/perf_notes.md).
         """
         Dinv = diag_inv(rho_b)
-        S = form_S(Dinv)
-        L = jnp.linalg.cholesky(S)
-        Linv = lax.linalg.triangular_solve(
-            L, jnp.broadcast_to(eye_m, S.shape), left_side=True, lower=True
-        )
-        Sinv = jnp.einsum("bkm,bkn->bmn", Linv, Linv, precision=lax.Precision.HIGHEST)
+        if band_plan is not None:
+            # One contrib computation feeds both the dense S (kept for
+            # refinement / stale reuse) and the banded inverse.
+            from dragg_tpu.ops.banded import banded_explicit_inverse
+            from dragg_tpu.ops.qp import scatter_schur, schur_contrib
+
+            contrib = schur_contrib(schur, vals_s, Dinv)
+            S = scatter_schur(schur, m_eq, contrib)
+            Sinv = banded_explicit_inverse(band_plan, contrib)
+        else:
+            S = form_S(Dinv)
+            L = jnp.linalg.cholesky(S)
+            Linv = lax.linalg.triangular_solve(
+                L, jnp.broadcast_to(eye_m, S.shape), left_side=True, lower=True
+            )
+            Sinv = jnp.einsum("bkm,bkn->bmn", Linv, Linv,
+                              precision=lax.Precision.HIGHEST)
         return Dinv, Sinv.astype(store_dtype), S
 
     def stale_factor(rho_b):
@@ -540,7 +564,8 @@ def _admm_impl(
 
 
 _STATIC = ("pat", "iters", "check_every", "ruiz_iters", "adaptive_rho",
-           "rho_update_every", "patience", "matvec_dtype", "refine", "anderson")
+           "rho_update_every", "patience", "matvec_dtype", "refine", "anderson",
+           "banded_factor")
 
 
 @partial(jax.jit, static_argnames=_STATIC)

@@ -1,0 +1,179 @@
+"""Banded Cholesky factorization of the ADMM Schur complement.
+
+The Schur complement S = Â D⁻¹ Âᵀ of the MPC equality block is, after a
+bandwidth-reducing permutation, a banded SPD matrix with bandwidth ~5
+independent of the horizon (the dynamics are first-order RC recurrences:
+each temperature row couples only to its timestep neighbors —
+dragg/mpc_calc.py:311-342).  The dense batched ``jnp.linalg.cholesky`` +
+triangular solves used to factor S cost O(B·m³) and dominated the 10k-home
+step on chip (docs/perf_notes.md); the banded factorization here is
+O(B·m·bw²) — a ``lax.scan`` over the m band rows with tiny per-row work —
+and the explicit inverse needed by the hot loop comes from one banded
+multi-RHS forward solve plus the same GEMM as before.
+
+The permutation is computed generically with reverse Cuthill–McKee over
+S's sparsity (no layout knowledge), so any future problem template gets the
+same treatment; patterns whose RCM bandwidth is large simply keep the dense
+path (see ``plan_for``).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+MAX_BAND = 12  # fall back to the dense factorization beyond this bandwidth
+
+
+def rcm_order(rows: np.ndarray, cols: np.ndarray, m: int) -> np.ndarray:
+    """Reverse Cuthill–McKee ordering of a symmetric sparsity pattern.
+    Returns ``perm`` with ``perm[p] = original index placed at position p``."""
+    adj: list[set] = [set() for _ in range(m)]
+    for i, j in zip(rows, cols):
+        if i != j:
+            adj[int(i)].add(int(j))
+            adj[int(j)].add(int(i))
+    deg = np.asarray([len(a) for a in adj])
+    nbrs = [sorted(a, key=lambda v: deg[v]) for a in adj]
+    visited = np.zeros(m, dtype=bool)
+    order: list[int] = []
+    for start in np.argsort(deg, kind="stable"):
+        if visited[start]:
+            continue
+        visited[start] = True
+        queue = [int(start)]
+        while queue:
+            v = queue.pop(0)
+            order.append(v)
+            for u in nbrs[v]:
+                if not visited[u]:
+                    visited[u] = True
+                    queue.append(u)
+    return np.asarray(order[::-1], dtype=np.int32)
+
+
+class BandPlan(NamedTuple):
+    """Static plan: permutation + scatter of Schur entries into lower-band
+    storage ``Sb[:, i, k] = S_perm[i, i-k]``.  All numpy; hashable via id —
+    built once per (pattern) by :func:`plan_for`."""
+
+    m: int
+    bw: int
+    perm: np.ndarray      # (m,) original index at permuted position
+    inv: np.ndarray       # (m,) permuted position of original index
+    ent_row: np.ndarray   # (n_low,) band row of each kept S entry
+    ent_off: np.ndarray   # (n_low,) band offset (0 = diagonal)
+    ent_src: np.ndarray   # (n_low,) index into the contrib vector
+
+
+@lru_cache(maxsize=32)
+def _plan_cached(s_rows: tuple, s_cols: tuple, m: int) -> BandPlan | None:
+    rows = np.asarray(s_rows, dtype=np.int64)
+    cols = np.asarray(s_cols, dtype=np.int64)
+    perm = rcm_order(rows, cols, m)
+    inv = np.empty(m, dtype=np.int32)
+    inv[perm] = np.arange(m, dtype=np.int32)
+    bw = int(np.max(np.abs(inv[rows] - inv[cols]))) if len(rows) else 0
+    if bw > MAX_BAND:
+        return None
+    if bw == 0:
+        # A diagonal Schur complement needs no banded machinery (and the
+        # scan carries below would be zero-length) — use the dense path.
+        return None
+    pi = inv[rows]
+    pj = inv[cols]
+    keep = pi >= pj  # lower triangle (S symmetric; each pair stored once)
+    return BandPlan(
+        m=m, bw=bw, perm=perm, inv=inv,
+        ent_row=pi[keep].astype(np.int32),
+        ent_off=(pi[keep] - pj[keep]).astype(np.int32),
+        ent_src=np.nonzero(keep)[0].astype(np.int32),
+    )
+
+
+def plan_for(ss, m: int) -> BandPlan | None:
+    """Band plan for a SchurStructure over m rows, or None when the RCM
+    bandwidth is too large for the banded path to pay off."""
+    if ss is None or ss.n_s == 0:
+        return None
+    return _plan_cached(ss.s_rows, ss.s_cols, m)
+
+
+def band_scatter(plan: BandPlan, contrib: jnp.ndarray) -> jnp.ndarray:
+    """Schur entry values (B, n_s) → lower-band storage (B, m, bw+1)."""
+    B = contrib.shape[0]
+    Sb = jnp.zeros((B, plan.m, plan.bw + 1), dtype=contrib.dtype)
+    return Sb.at[:, plan.ent_row, plan.ent_off].set(contrib[:, plan.ent_src])
+
+
+def banded_cholesky(Sb: jnp.ndarray, bw: int) -> jnp.ndarray:
+    """Batched Cholesky of band-stored SPD matrices: (B, m, bw+1) lower-band
+    S → same-layout L with S = L Lᵀ.  One scan over rows; per-row work is a
+    static bw² unrolled loop."""
+    B = Sb.shape[0]
+    dtype = Sb.dtype
+
+    def step(prev, srow):
+        # prev[d-1] = L row (i-d); srow (B, bw+1).
+        row = [None] * (bw + 1)
+        for k in range(bw, 0, -1):
+            s = srow[:, k]
+            for j in range(1, bw - k + 1):
+                s = s - row[k + j] * prev[k - 1][:, j]
+            row[k] = s / prev[k - 1][:, 0]
+        diag = srow[:, 0]
+        for j in range(1, bw + 1):
+            diag = diag - row[j] * row[j]
+        row[0] = jnp.sqrt(jnp.maximum(diag, 1e-20))
+        row_arr = jnp.stack(row, axis=1)
+        new_prev = jnp.concatenate([row_arr[None], prev[:-1]], axis=0)
+        return new_prev, row_arr
+
+    # Virtual rows above the top: unit diagonal, zero off-diagonals — the
+    # zero-padded Sb entries for i<k then produce L[i,k]=0 as required.
+    prev0 = jnp.zeros((bw, B, bw + 1), dtype=dtype).at[:, :, 0].set(1.0)
+    _, Lrows = lax.scan(step, prev0, jnp.swapaxes(Sb, 0, 1))
+    return jnp.swapaxes(Lrows, 0, 1)  # (B, m, bw+1)
+
+
+def banded_forward_solve(Lb: jnp.ndarray, R: jnp.ndarray, bw: int) -> jnp.ndarray:
+    """Solve L Y = R for band-stored lower-triangular L.
+    R is (B, m, r); returns Y of the same shape."""
+    B, m, r = R.shape
+
+    def step(prev, inp):
+        lrow, rrow = inp           # (B, bw+1), (B, r)
+        acc = rrow
+        for k in range(1, bw + 1):
+            acc = acc - lrow[:, k, None] * prev[k - 1]
+        y = acc / lrow[:, 0, None]
+        new_prev = jnp.concatenate([y[None], prev[:-1]], axis=0)
+        return new_prev, y
+
+    prev0 = jnp.zeros((bw, B, r), dtype=R.dtype)
+    _, Y = lax.scan(step, prev0, (jnp.swapaxes(Lb, 0, 1), jnp.swapaxes(R, 0, 1)))
+    return jnp.swapaxes(Y, 0, 1)
+
+
+def banded_explicit_inverse(plan: BandPlan, contrib: jnp.ndarray) -> jnp.ndarray:
+    """S⁻¹ (original row order, dense (B, m, m)) from Schur entry values.
+
+    S = L Lᵀ in the permuted space; L⁻¹ comes from one banded multi-RHS
+    forward solve against I, and S⁻¹ = L⁻ᵀ L⁻¹ is one batched GEMM — the
+    only O(m³) step left (MXU-friendly), replacing the batched dense
+    Cholesky + two triangular solves.
+    """
+    m, bw = plan.m, plan.bw
+    B = contrib.shape[0]
+    Sb = band_scatter(plan, contrib)
+    Lb = banded_cholesky(Sb, bw)
+    eye = jnp.broadcast_to(jnp.eye(m, dtype=contrib.dtype), (B, m, m))
+    Linv = banded_forward_solve(Lb, eye, bw)           # (B, m, m), permuted
+    Sinv_p = jnp.einsum("bkm,bkn->bmn", Linv, Linv,
+                        precision=lax.Precision.HIGHEST)
+    inv = plan.inv
+    return Sinv_p[:, inv][:, :, inv]                   # back to original order

@@ -185,20 +185,30 @@ def build_schur_structure(pat: SparsePattern) -> SchurStructure:
                           e1=_tt(e1), e2=_tt(e2), kcol=_tt(kcol), mask=_tt(mask))
 
 
-def form_schur_sparse(ss: SchurStructure, m: int, vals_s, Dinv) -> jnp.ndarray:
-    """Form the dense (B, m, m) S = Â D⁻¹ Âᵀ from sparse values via the
-    precomputed triple lists — no dense A anywhere."""
+def schur_contrib(ss: SchurStructure, vals_s, Dinv) -> jnp.ndarray:
+    """Per-entry values of S = Â D⁻¹ Âᵀ ((B, n_s), aligned with
+    ss.s_rows/s_cols) from the precomputed triple lists."""
     e1 = jnp.asarray(ss.e1)
     e2 = jnp.asarray(ss.e2)
     kcol = jnp.asarray(ss.kcol)
     mask = jnp.asarray(ss.mask, dtype=vals_s.dtype)
-    contrib = jnp.sum(
+    return jnp.sum(
         vals_s[:, e1] * vals_s[:, e2] * Dinv[:, kcol] * mask[None], axis=2
-    )  # (B, n_s)
+    )
+
+
+def scatter_schur(ss: SchurStructure, m: int, contrib) -> jnp.ndarray:
+    """Schur entry values (B, n_s) → dense (B, m, m)."""
     s_rows = np.asarray(ss.s_rows)
     s_cols = np.asarray(ss.s_cols)
-    B = vals_s.shape[0]
-    return jnp.zeros((B, m, m), dtype=vals_s.dtype).at[:, s_rows, s_cols].set(contrib)
+    B = contrib.shape[0]
+    return jnp.zeros((B, m, m), dtype=contrib.dtype).at[:, s_rows, s_cols].set(contrib)
+
+
+def form_schur_sparse(ss: SchurStructure, m: int, vals_s, Dinv) -> jnp.ndarray:
+    """Form the dense (B, m, m) S = Â D⁻¹ Âᵀ from sparse values via the
+    precomputed triple lists — no dense A anywhere."""
+    return scatter_schur(ss, m, schur_contrib(ss, vals_s, Dinv))
 
 
 def densify_A(pat: SparsePattern, vals) -> jnp.ndarray:
