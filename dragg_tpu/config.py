@@ -182,6 +182,9 @@ _DEFAULT: dict[str, Any] = {
                              # -16% warm iterations, slight solve-rate dip)
         "admm_banded_factor": True,  # RCM + banded-Cholesky Schur factor
                                      # (O(Bm·bw²) vs dense O(Bm³); bw=4)
+        "admm_solve_backend": "auto",  # in-loop KKT solve: "dense_inv" |
+                                       # "band" (no (B,m,m) array — the
+                                       # 100k-home memory regime) | "auto"
         "forecast_noise_cap": 3.0,  # max forecast-noise std (degC): the reference's
                                     # unbounded 1.1^k growth breaks the season gate
                                     # beyond ~16h horizons (see engine._prepare)

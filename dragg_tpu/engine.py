@@ -146,6 +146,7 @@ class EngineParams(NamedTuple):
     admm_refine: int    # refinement passes per in-loop KKT solve
     admm_anderson: int  # Anderson-acceleration history depth (0 = off)
     admm_banded_factor: bool  # banded-Cholesky Schur factorization
+    admm_solve_backend: str  # "auto" | "dense_inv" | "band" in-loop solve
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
 
@@ -178,6 +179,20 @@ class Engine:
         if check_mask is None:
             check_mask = np.ones(batch.n_homes)
         self._check_mask = jnp.asarray(np.asarray(check_mask), dtype=jnp.float32)
+        # Resolve the "auto" solve backend HERE, where the mesh is known:
+        # the 1 GB Sinv budget is per device shard (ShardedEngine sets
+        # _mesh_shards before this runs), and bf16 storage halves the bytes.
+        from dragg_tpu.ops.admm import _schur_structure_for, resolve_backend
+        from dragg_tpu.ops.banded import plan_for
+
+        plan = (plan_for(_schur_structure_for(self.static.pattern), lay.m_eq)
+                if params.admm_banded_factor else None)
+        self._solve_backend = resolve_backend(
+            params.admm_solve_backend, batch.n_homes, lay.m_eq,
+            plan is not None,
+            elem_bytes=2 if params.admm_matvec_dtype == "bf16" else 4,
+            n_shards=getattr(self, "_mesh_shards", 1),
+        )
         self._step_fn = jax.jit(self._step)
         self._chunk_fn = jax.jit(self._chunk)
 
@@ -208,7 +223,9 @@ class Engine:
         (n, m, m) Schur inverse (237 MB at 10k homes, ~9 GB at the
         100k-home/H=48 target); every chunk's first step refreshes it."""
         return init_factor_carry(self.n_homes, self.static.pattern,
-                                 matvec_dtype=self.params.admm_matvec_dtype)
+                                 matvec_dtype=self.params.admm_matvec_dtype,
+                                 solve_backend=self._solve_backend,
+                                 banded_factor=self.params.admm_banded_factor)
 
     # ----------------------------------------------------------------- step
     def _prepare(self, state: CommunityState, t, rp):
@@ -310,6 +327,7 @@ class Engine:
             refine=p.admm_refine,
             anderson=p.admm_anderson,
             banded_factor=p.admm_banded_factor,
+            solve_backend=self._solve_backend,
             x0=state.warm_x, y_box0=state.warm_y_box,
             rho0=state.warm_rho,
         )
@@ -503,6 +521,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         admm_refine=int(tpu_cfg.get("admm_refine", 0)),
         admm_anderson=int(tpu_cfg.get("admm_anderson", 0)),
         admm_banded_factor=bool(tpu_cfg.get("admm_banded_factor", True)),
+        admm_solve_backend=str(tpu_cfg.get("admm_solve_backend", "auto")),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),
     )

@@ -64,10 +64,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dragg_tpu.ops.banded import (
+    band_matvec,
+    band_scatter,
+    banded_cholesky,
+    banded_explicit_inverse,
+    banded_solve,
+    plan_for,
+)
 from dragg_tpu.ops.qp import (
     SparsePattern,
     build_schur_structure,
     form_schur_sparse,
+    scatter_schur,
+    schur_contrib,
 )
 
 RHO_MIN, RHO_MAX = 1e-6, 1e6
@@ -86,7 +96,33 @@ class FactorCarry(NamedTuple):
     e_eq: jnp.ndarray   # (B, m) equality-row scaling
     e_box: jnp.ndarray  # (B, n) box-row scaling
     c: jnp.ndarray      # (B, 1) cost scaling
-    Sinv: jnp.ndarray   # (B, m, m) explicit Schur inverse
+    Sinv: jnp.ndarray   # the Schur factor: explicit inverse (B, m, m) in
+                        # dense_inv mode, band Cholesky (B, m, bw+1) in
+                        # band mode (see resolve_backend)
+
+
+BAND_AUTO_BYTES = 1 << 30  # "auto": go banded when the PER-SHARD Sinv
+                           # would exceed this
+
+
+def resolve_backend(solve_backend: str, B: int, m: int, has_plan: bool,
+                    elem_bytes: int = 4, n_shards: int = 1) -> str:
+    """Resolve the in-loop solve backend (see ``_admm_impl``'s
+    ``solve_backend`` parameter).  The budget is per device shard — the
+    engine layer resolves "auto" with its mesh size and element width and
+    passes an explicit backend down, so the factor carry is sized
+    consistently; direct solver callers default to one shard."""
+    if solve_backend == "band":
+        if not has_plan:
+            raise ValueError("solve_backend='band' needs a banded Schur pattern")
+        return "band"
+    if solve_backend == "dense_inv":
+        return "dense_inv"
+    if solve_backend != "auto":
+        raise ValueError(f"unknown solve_backend {solve_backend!r}")
+    if has_plan and elem_bytes * B * m * m > BAND_AUTO_BYTES * max(1, n_shards):
+        return "band"
+    return "dense_inv"
 
 
 @lru_cache(maxsize=32)
@@ -200,6 +236,17 @@ def _admm_impl(
                                  # Cholesky + triangular solves (O(Bm³));
                                  # automatic dense fallback when the pattern
                                  # is not banded (plan_for returns None)
+    solve_backend: str = "auto",  # in-loop KKT solve:
+                                  # "dense_inv": explicit (B, m, m) Sinv,
+                                  #   one batched matvec per solve;
+                                  # "band": banded substitution scans —
+                                  #   no (B, m, m) array exists at all
+                                  #   (the 100k-home × H=48 memory regime:
+                                  #   Sinv would be ~2.2 GB per 25k-home
+                                  #   shard, the band factor is ~36 MB);
+                                  # "auto": band when the Sinv would
+                                  #   exceed ~1 GB and the pattern is
+                                  #   banded, else dense_inv
     anderson: int = 0,       # Anderson-acceleration history depth (0 = off).
                              # Type-II AA applied once per check window on
                              # the (z, y) pair — the window map T^check_every
@@ -280,11 +327,12 @@ def _admm_impl(
         ADi = As_dense * Dinv[:, None, :]
         return jnp.einsum("bmn,bkn->bmk", ADi, As_dense, precision=lax.Precision.HIGHEST)
 
-    band_plan = None
-    if banded_factor and schur is not None:
-        from dragg_tpu.ops.banded import plan_for
-
-        band_plan = plan_for(schur, m_eq)
+    band_plan = plan_for(schur, m_eq) if (banded_factor and schur is not None) else None
+    backend = resolve_backend(solve_backend, B, m_eq, band_plan is not None,
+                              elem_bytes=2 if matvec_dtype == "bf16" else 4)
+    if backend == "band":
+        perm_ix = jnp.asarray(band_plan.perm)
+        invp_ix = jnp.asarray(band_plan.inv)
 
     def factor(rho_b):
         """Schur-complement factor of the equality-constrained x-update.
@@ -296,12 +344,16 @@ def _admm_impl(
         (the 10k-home factor hotspot, docs/perf_notes.md).
         """
         Dinv = diag_inv(rho_b)
+        if backend == "band":
+            # No (B, m, m) array exists in this mode: the carry holds the
+            # band Cholesky factor; refinement matvecs run on the band S.
+            contrib = schur_contrib(schur, vals_s, Dinv)
+            Sb = band_scatter(band_plan, contrib)
+            Lb = banded_cholesky(Sb, band_plan.bw)
+            return Dinv, Lb, Sb
         if band_plan is not None:
             # One contrib computation feeds both the dense S (kept for
             # refinement / stale reuse) and the banded inverse.
-            from dragg_tpu.ops.banded import banded_explicit_inverse
-            from dragg_tpu.ops.qp import scatter_schur, schur_contrib
-
             contrib = schur_contrib(schur, vals_s, Dinv)
             S = scatter_schur(schur, m_eq, contrib)
             Sinv = banded_explicit_inverse(band_plan, contrib)
@@ -316,17 +368,28 @@ def _admm_impl(
         return Dinv, Sinv.astype(store_dtype), S
 
     def stale_factor(rho_b):
-        """Reuse the carried Schur inverse as a preconditioner: Dinv and S
-        are exact for the current problem; only Sinv is stale (the wh-mix
-        band drifted since it was factored), which iterative refinement in
-        ``s_solve`` corrects."""
+        """Reuse the carried factor as a preconditioner: Dinv and S are
+        exact for the current problem; only the factor (explicit inverse or
+        band Cholesky) is stale — the wh-mix band drifted since it was
+        computed — which iterative refinement in ``s_solve`` corrects."""
         Dinv = diag_inv(rho_b)
+        if backend == "band":
+            Sb = band_scatter(band_plan, schur_contrib(schur, vals_s, Dinv))
+            return Dinv, carry_in.Sinv, Sb
         return Dinv, carry_in.Sinv, form_S(Dinv)
 
     def s_solve(F, r, refine: int = 1):
-        """S⁻¹ r with ``refine`` iterative-refinement steps (recovers f32
-        accuracy of the explicit inverse — which may be stored bf16 — and
-        absorbs stale-factor drift; 1 + 2·refine batched matmuls)."""
+        """S⁻¹ r with ``refine`` iterative-refinement steps (absorbing
+        bf16-storage rounding and stale-factor drift)."""
+        if backend == "band":
+            _, Lb, Sb = F
+            bw = band_plan.bw
+            rp = r[:, perm_ix]
+            v = banded_solve(Lb, rp, bw)
+            for _ in range(refine):
+                resid = rp - band_matvec(Sb, v, bw)
+                v = v + banded_solve(Lb, resid, bw)
+            return v[:, invp_ix]
         _, Sinv, S = F
         pinv = lambda rr: jnp.einsum(
             "bmn,bn->bm", Sinv, rr.astype(Sinv.dtype),
@@ -565,7 +628,7 @@ def _admm_impl(
 
 _STATIC = ("pat", "iters", "check_every", "ruiz_iters", "adaptive_rho",
            "rho_update_every", "patience", "matvec_dtype", "refine", "anderson",
-           "banded_factor")
+           "banded_factor", "solve_backend")
 
 
 @partial(jax.jit, static_argnames=_STATIC)
@@ -588,15 +651,26 @@ def admm_solve_qp_cached(pat, vals, b_eq, l_box, u_box, q, carry_in, refresh,
 
 
 def init_factor_carry(B: int, pat: SparsePattern, dtype=jnp.float32,
-                      matvec_dtype: str = "f32") -> FactorCarry:
-    """Zero-filled carry for t=0 (the first step must pass refresh=True)."""
-    sinv_dtype = jnp.bfloat16 if matvec_dtype == "bf16" else dtype
+                      matvec_dtype: str = "f32",
+                      solve_backend: str = "auto",
+                      banded_factor: bool = True) -> FactorCarry:
+    """Zero-filled carry for t=0 (the first step must pass refresh=True).
+    In band mode the ``Sinv`` field holds the (B, m, bw+1) band Cholesky
+    factor instead of a dense inverse."""
+    plan = plan_for(_schur_structure_for(pat), pat.m) if banded_factor else None
+    backend = resolve_backend(solve_backend, B, pat.m, plan is not None,
+                              elem_bytes=2 if matvec_dtype == "bf16" else 4)
+    if backend == "band":
+        factor0 = jnp.zeros((B, pat.m, plan.bw + 1), dtype=dtype)
+    else:
+        sinv_dtype = jnp.bfloat16 if matvec_dtype == "bf16" else dtype
+        factor0 = jnp.zeros((B, pat.m, pat.m), dtype=sinv_dtype)
     return FactorCarry(
         d=jnp.ones((B, pat.n), dtype=dtype),
         e_eq=jnp.ones((B, pat.m), dtype=dtype),
         e_box=jnp.ones((B, pat.n), dtype=dtype),
         c=jnp.ones((B, 1), dtype=dtype),
-        Sinv=jnp.zeros((B, pat.m, pat.m), dtype=sinv_dtype),
+        Sinv=factor0,
     )
 
 

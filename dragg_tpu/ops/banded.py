@@ -159,6 +159,48 @@ def banded_forward_solve(Lb: jnp.ndarray, R: jnp.ndarray, bw: int) -> jnp.ndarra
     return jnp.swapaxes(Y, 0, 1)
 
 
+def banded_backward_solve(Lb: jnp.ndarray, Y: jnp.ndarray, bw: int) -> jnp.ndarray:
+    """Solve Lᵀ X = Y for band-stored lower-triangular L.
+    Y is (B, m, r); returns X of the same shape."""
+    B, m, r = Y.shape
+    # Row i of Lᵀ couples x_i to x_{i+k} via L[i+k, k]: a reverse scan
+    # carrying the last bw (x, L-row) pairs below the current row.
+    Lrows = jnp.swapaxes(Lb, 0, 1)          # (m, B, bw+1)
+    Yrows = jnp.swapaxes(Y, 0, 1)
+
+    def rstep(carry, inp):
+        lrow, yrow = inp                     # (B, bw+1), (B, r)
+        xs, lrows_below = carry              # (bw, B, r), (bw, B, bw+1)
+        acc = yrow
+        for k in range(1, bw + 1):
+            acc = acc - lrows_below[k - 1][:, k, None] * xs[k - 1]
+        x = acc / lrow[:, 0, None]
+        xs = jnp.concatenate([x[None], xs[:-1]], axis=0)
+        lrows_below = jnp.concatenate([lrow[None], lrows_below[:-1]], axis=0)
+        return (xs, lrows_below), x
+
+    xs0 = jnp.zeros((bw, B, r), dtype=Y.dtype)
+    l0 = jnp.zeros((bw, B, bw + 1), dtype=Lb.dtype).at[:, :, 0].set(1.0)
+    _, X = lax.scan(rstep, (xs0, l0), (Lrows, Yrows), reverse=True)
+    return jnp.swapaxes(X, 0, 1)
+
+
+def band_matvec(Sb: jnp.ndarray, v: jnp.ndarray, bw: int) -> jnp.ndarray:
+    """S v for lower-band-stored symmetric S: (B, m, bw+1) × (B, m)."""
+    out = Sb[:, :, 0] * v
+    for k in range(1, bw + 1):
+        lo = Sb[:, k:, k]          # S[i, i-k] for i >= k
+        out = out.at[:, k:].add(lo * v[:, :-k])   # lower-triangle term
+        out = out.at[:, :-k].add(lo * v[:, k:])   # symmetric upper term
+    return out
+
+
+def banded_solve(Lb: jnp.ndarray, r: jnp.ndarray, bw: int) -> jnp.ndarray:
+    """S⁻¹ r (band-space) via forward + backward substitution; r is (B, m)."""
+    y = banded_forward_solve(Lb, r[..., None], bw)
+    return banded_backward_solve(Lb, y, bw)[..., 0]
+
+
 def banded_explicit_inverse(plan: BandPlan, contrib: jnp.ndarray) -> jnp.ndarray:
     """S⁻¹ (original row order, dense (B, m, m)) from Schur entry values.
 

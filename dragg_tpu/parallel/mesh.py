@@ -97,6 +97,9 @@ class ShardedEngine(Engine):
         self.axis_name = axis_name
         self.true_n_homes = batch.n_homes
         n_shards = mesh.devices.size
+        # Engine.__init__ resolves the "auto" solve backend against the
+        # PER-SHARD memory budget — tell it the mesh size first.
+        self._mesh_shards = n_shards
         if check_mask is None:
             check_mask = np.ones(batch.n_homes)
         batch, pad_mask = pad_batch(batch, n_shards)

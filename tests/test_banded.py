@@ -111,3 +111,77 @@ def test_plan_bandwidth_on_real_pattern():
         assert plan.bw <= 6, f"H={H}: RCM bandwidth {plan.bw}"
         # Every original index appears exactly once in the permutation.
         assert sorted(plan.perm.tolist()) == list(range(pat.m))
+
+
+def test_band_solve_backend_equivalence():
+    """solve_backend='band' (no dense (B,m,m) inverse anywhere) must walk
+    the same trajectory as 'dense_inv' on the real QP."""
+    import sys
+    sys.path.insert(0, "tests")
+    from test_qp_parity import _assemble_real_step
+
+    from dragg_tpu.ops.admm import admm_solve_qp
+
+    qp, pat = _assemble_real_step(horizon_hours=8, n_homes=6)
+    dense = admm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                          iters=2000, solve_backend="dense_inv")
+    band = admm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                         iters=2000, solve_backend="band")
+    assert int(dense.iters) == int(band.iters)
+    np.testing.assert_array_equal(np.asarray(dense.solved), np.asarray(band.solved))
+    np.testing.assert_allclose(np.asarray(band.x), np.asarray(dense.x),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_band_backend_engine_chunk(tiny_config):
+    """The engine's cached-factor MPC path (stale band factor + refinement)
+    runs and solves with solve_backend='band'."""
+    import copy
+
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+
+    cfg = copy.deepcopy(tiny_config)
+    cfg["tpu"]["admm_solve_backend"] = "band"
+    env = load_environment(cfg, data_dir=None)
+    dt = int(cfg["agg"]["subhourly_steps"])
+    wd = load_waterdraw_profiles(None, seed=int(cfg["simulation"]["random_seed"]))
+    homes = create_homes(cfg, 24 * dt, dt, wd)
+    hems = cfg["home"]["hems"]
+    batch = build_home_batch(homes, int(hems["prediction_horizon"]) * dt, dt,
+                             int(hems["sub_subhourly_steps"]))
+    eng = make_engine(batch, env, cfg, 0)
+    # The factor carry holds the small band factor, not a dense inverse.
+    f0 = eng.init_factor()
+    assert f0.Sinv.shape[-1] <= 13  # bw+1, not m
+    state, outs = eng.run_chunk(eng.init_state(), 0,
+                                np.zeros((6, eng.params.horizon), np.float32))
+    assert float(np.asarray(outs.correct_solve).mean()) > 0.9
+    assert np.isfinite(np.asarray(outs.agg_load)).all()
+
+
+def test_resolve_backend_auto():
+    from dragg_tpu.ops.admm import resolve_backend
+
+    assert resolve_backend("auto", 100, 77, True) == "dense_inv"
+    assert resolve_backend("auto", 200_000, 77, True) == "band"  # >1 GB Sinv
+    assert resolve_backend("auto", 200_000, 77, False) == "dense_inv"
+    assert resolve_backend("dense_inv", 10, 5, False) == "dense_inv"
+    with pytest.raises(ValueError):
+        resolve_backend("band", 10, 5, False)
+    with pytest.raises(ValueError):
+        resolve_backend("nope", 10, 5, True)
+
+
+def test_resolve_backend_shard_and_dtype_aware():
+    from dragg_tpu.ops.admm import resolve_backend
+
+    # 50k homes over 8 shards, m=149: global Sinv ~4.4 GB but per-shard
+    # ~555 MB — stays on the dense path.
+    assert resolve_backend("auto", 50_000, 149, True, n_shards=8) == "dense_inv"
+    assert resolve_backend("auto", 50_000, 149, True, n_shards=1) == "band"
+    # bf16 halves the bytes: 2x the homes fit before the switch
+    # (60k homes x m=77: f32 Sinv ~1.4 GB, bf16 ~0.7 GB).
+    assert resolve_backend("auto", 60_000, 77, True, elem_bytes=2) == "dense_inv"
+    assert resolve_backend("auto", 60_000, 77, True, elem_bytes=4) == "band"

@@ -121,6 +121,23 @@ def test_sharded_engine_all_leaves_fixed_iters(tiny_config):
         )
 
 
+def test_sharded_engine_band_backend(tiny_config):
+    """The BASELINE row-5 configuration is sharded AND banded: the band
+    substitution scans must compile and solve under the SPMD partitioner."""
+    import copy
+
+    cfg = copy.deepcopy(tiny_config)
+    cfg["tpu"]["admm_solve_backend"] = "band"
+    cfg, env, batch = _setup(cfg)
+    sh = make_sharded_engine(batch, env, cfg, 0, mesh=make_mesh(8))
+    assert sh.init_factor().Sinv.shape[-1] <= 13  # band factor, not (m, m)
+    rps = np.zeros((2, sh.params.horizon), dtype=np.float32)
+    state, outs = sh.run_chunk(sh.init_state(), 0, rps)
+    solved = np.asarray(outs.correct_solve)[:, :batch.n_homes]
+    assert solved.mean() > 0.9
+    assert np.isfinite(np.asarray(outs.agg_load)).all()
+
+
 def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
 
