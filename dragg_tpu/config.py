@@ -185,6 +185,7 @@ _DEFAULT: dict[str, Any] = {
         "admm_solve_backend": "auto",  # in-loop KKT solve: "dense_inv" |
                                        # "band" (no (B,m,m) array — the
                                        # 100k-home memory regime) | "auto"
+        "ipm_iters": 25,  # fixed Mehrotra iteration count (hems.solver="ipm")
         "forecast_noise_cap": 3.0,  # max forecast-noise std (degC): the reference's
                                     # unbounded 1.1^k growth breaks the season gate
                                     # beyond ~16h horizons (see engine._prepare)

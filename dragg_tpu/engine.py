@@ -127,6 +127,8 @@ class StepAux(NamedTuple):
 class EngineParams(NamedTuple):
     """Static (Python-side) engine configuration."""
 
+    solver: str         # "admm" | "ipm" (home.hems.solver — the reference's
+                        # solver field, dragg/mpc_calc.py:141-145 analog)
     horizon: int        # H — decision steps (hems horizon * dt)
     dt: int             # steps per hour
     s: float            # sub_subhourly_steps (duty-cycle denominator)
@@ -147,6 +149,7 @@ class EngineParams(NamedTuple):
     admm_anderson: int  # Anderson-acceleration history depth (0 = off)
     admm_banded_factor: bool  # banded-Cholesky Schur factorization
     admm_solve_backend: str  # "auto" | "dense_inv" | "band" in-loop solve
+    ipm_iters: int      # fixed Mehrotra iteration count (solver="ipm")
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
 
@@ -222,6 +225,13 @@ class Engine:
         carries — NOT in CommunityState — so checkpoints never pay for the
         (n, m, m) Schur inverse (237 MB at 10k homes, ~9 GB at the
         100k-home/H=48 target); every chunk's first step refreshes it."""
+        if self.params.solver == "ipm":
+            # The IPM has no cross-step factor cache — thread a token-sized
+            # carry instead of the ADMM's (B, m, m) dead weight.
+            f32 = jnp.float32
+            one = jnp.ones((self.n_homes, 1), f32)
+            return FactorCarry(d=one, e_eq=one, e_box=one, c=one,
+                               Sinv=jnp.zeros((self.n_homes, 1, 1), f32))
         return init_factor_carry(self.n_homes, self.static.pattern,
                                  matvec_dtype=self.params.admm_matvec_dtype,
                                  solve_backend=self._solve_backend,
@@ -309,11 +319,27 @@ class Engine:
         return qp, aux
 
     def _solve(self, state: CommunityState, qp, factor: FactorCarry, refresh):
-        """Solve phase: the batched ADMM QP solve, warm-started from state.
-        ``refresh`` (traced bool) forces an exact re-equilibration +
-        refactorization; between refreshes the carried Schur factor is
-        reused with iterative refinement (SURVEY.md §7 step 3)."""
+        """Solve phase: the batched QP solve.
+
+        ``solver="admm"``: warm-started from state; ``refresh`` (traced
+        bool) forces an exact re-equilibration + refactorization; between
+        refreshes the carried Schur factor is reused with iterative
+        refinement (SURVEY.md §7 step 3).
+
+        ``solver="ipm"``: the Mehrotra interior point (ops/ipm.py) —
+        ~20 iterations cold, no warm starts or cross-step factor cache
+        (both are no-ops for it; the carry passes through untouched).
+        """
         p = self.params
+        if p.solver == "ipm":
+            from dragg_tpu.ops.ipm import ipm_solve_qp
+
+            sol = ipm_solve_qp(
+                self.static.pattern, qp.vals, qp.b_eq, qp.l_box, qp.u_box,
+                qp.q, reg=p.admm_reg, iters=p.ipm_iters,
+                eps_abs=p.admm_eps, eps_rel=p.admm_eps,
+            )
+            return sol, factor
         return admm_solve_qp_cached(
             self.static.pattern, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
             factor, refresh,
@@ -503,6 +529,7 @@ def engine_params(config, start_index: int) -> EngineParams:
     dt = int(config["agg"]["subhourly_steps"])
     tpu_cfg = config.get("tpu", {})
     return EngineParams(
+        solver=str(hems.get("solver", "admm")),
         horizon=max(1, int(hems["prediction_horizon"]) * dt),
         dt=dt,
         s=float(max(1, int(hems["sub_subhourly_steps"]))),
@@ -522,6 +549,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         admm_anderson=int(tpu_cfg.get("admm_anderson", 0)),
         admm_banded_factor=bool(tpu_cfg.get("admm_banded_factor", True)),
         admm_solve_backend=str(tpu_cfg.get("admm_solve_backend", "auto")),
+        ipm_iters=int(tpu_cfg.get("ipm_iters", 25)),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),
     )

@@ -187,12 +187,16 @@ def ruiz_equilibrate_sparse(pat: SparsePattern, vals, q, iters: int = 10):
         a = scaled_abs(d, e_eq)
         r_eq = jnp.max(_pad_gather(a, row_src), axis=2)
         r_box = jnp.abs(e_box * d)
-        e_eq = e_eq / jnp.sqrt(jnp.maximum(r_eq, 1e-8))
-        e_box = e_box / jnp.sqrt(jnp.maximum(r_box, 1e-8))
+        # Degenerate (all-zero) rows keep their scaling: repeatedly dividing
+        # by sqrt(eps) would overflow e to inf within the iteration budget
+        # (zero rows arise from per-home fixed-variable elimination in the
+        # IPM path — a zeroed battery block leaves its dynamics rows empty).
+        e_eq = jnp.where(r_eq > 1e-8, e_eq / jnp.sqrt(jnp.maximum(r_eq, 1e-8)), e_eq)
+        e_box = jnp.where(r_box > 1e-8, e_box / jnp.sqrt(jnp.maximum(r_box, 1e-8)), e_box)
         a = scaled_abs(d, e_eq)
         c_eq = jnp.max(_pad_gather(a, col_src), axis=2)
         cn = jnp.maximum(c_eq, jnp.abs(e_box * d))
-        d = d / jnp.sqrt(jnp.maximum(cn, 1e-8))
+        d = jnp.where(cn > 1e-8, d / jnp.sqrt(jnp.maximum(cn, 1e-8)), d)
         return d, e_eq, e_box
 
     d, e_eq, e_box = lax.fori_loop(0, iters, body, (d, e_eq, e_box))

@@ -1,0 +1,126 @@
+"""Interior-point solver (dragg_tpu/ops/ipm.py): HiGHS parity, infeasible
+handling, and the engine's solver="ipm" path."""
+
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+sys.path.insert(0, "tests")
+from test_qp_parity import _assemble_real_step, _linprog_reference  # noqa: E402
+
+from dragg_tpu.ops.ipm import ipm_solve_qp  # noqa: E402
+from dragg_tpu.ops.qp import QPLayout, densify_A  # noqa: E402
+
+
+def test_ipm_matches_highs():
+    """≤1 % objective gap vs HiGHS on the real community QP in ≤25 Mehrotra
+    iterations (the ADMM path needs ~275 cold — docs/perf_notes.md)."""
+    qp, pat = _assemble_real_step(horizon_hours=24, n_homes=6)
+    sol = ipm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                       iters=25)
+    A = np.asarray(densify_A(pat, qp.vals), np.float64)
+    n_checked = 0
+    for i in range(6):
+        ref = _linprog_reference(
+            A[i], np.asarray(qp.b_eq, np.float64)[i],
+            np.asarray(qp.l_box, np.float64)[i],
+            np.asarray(qp.u_box, np.float64)[i],
+            np.asarray(qp.q, np.float64)[i])
+        if not ref.success:
+            assert not bool(sol.solved[i])
+            continue
+        assert bool(sol.solved[i]), f"home {i} unsolved"
+        gap = (float(np.asarray(qp.q)[i] @ np.asarray(sol.x)[i]) - ref.fun) / max(
+            abs(ref.fun), 1e-3)
+        assert abs(gap) < 0.01, f"home {i}: gap {gap:.4%}"
+        viol = np.max(np.abs(A[i] @ np.asarray(sol.x, np.float64)[i]
+                             - np.asarray(qp.b_eq, np.float64)[i]))
+        assert viol < 1e-2
+        n_checked += 1
+    assert n_checked >= 4
+
+
+def test_ipm_flags_infeasible_home():
+    """A home whose WH comfort box sits above its pinned initial temperature
+    is primal-infeasible; the IPM must not claim success on it."""
+    qp, pat = _assemble_real_step(horizon_hours=8, n_homes=6)
+    l = np.asarray(qp.l_box).copy()
+    H = (pat.n - 5) // 9
+    lay = QPLayout(H)
+    b0 = float(np.asarray(qp.b_eq)[0, lay.r_twh0])
+    l[0, lay.i_twh: lay.i_twh + H + 1] = b0 + 5.0
+    sol = ipm_solve_qp(pat, qp.vals, qp.b_eq, jnp.asarray(l), qp.u_box, qp.q,
+                       iters=25)
+    assert not bool(sol.solved[0])
+    # The other homes still solve despite the lockstep neighbor diverging.
+    assert int(jnp.sum(sol.solved[1:])) >= 4
+
+
+def test_ipm_handles_fixed_variables():
+    """Winter gate: cool bounds are [0, 0] — fixed variables have no strict
+    interior, so the IPM eliminates them; solutions must pin them exactly."""
+    qp, pat = _assemble_real_step(horizon_hours=8, n_homes=6)
+    sol = ipm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                       iters=25)
+    l = np.asarray(qp.l_box)
+    u = np.asarray(qp.u_box)
+    fixed = np.isfinite(l) & np.isfinite(u) & (u - l <= 1e-9 * (1 + np.abs(l)))
+    assert fixed.any()  # the winter gate fixes the cool block
+    x = np.asarray(sol.x)
+    np.testing.assert_array_equal(x[fixed], l[fixed])
+
+
+def test_engine_ipm_solver(tiny_config):
+    """End-to-end: hems.solver='ipm' runs the whole engine chunk."""
+    import copy
+
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+
+    cfg = copy.deepcopy(tiny_config)
+    cfg["home"]["hems"]["solver"] = "ipm"
+    env = load_environment(cfg, data_dir=None)
+    dt = int(cfg["agg"]["subhourly_steps"])
+    wd = load_waterdraw_profiles(None, seed=int(cfg["simulation"]["random_seed"]))
+    homes = create_homes(cfg, 24 * dt, dt, wd)
+    hems = cfg["home"]["hems"]
+    batch = build_home_batch(homes, int(hems["prediction_horizon"]) * dt, dt,
+                             int(hems["sub_subhourly_steps"]))
+    eng = make_engine(batch, env, cfg, 0)
+    assert eng.params.solver == "ipm"
+    state, outs = eng.run_chunk(eng.init_state(), 0,
+                                np.zeros((6, eng.params.horizon), np.float32))
+    assert float(np.asarray(outs.correct_solve).mean()) > 0.9
+    assert np.isfinite(np.asarray(outs.agg_load)).all()
+
+
+def test_engine_ipm_matches_admm_aggregate(tiny_config):
+    """Same community, both solvers: daily aggregate loads agree to ~1%."""
+    import copy
+
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+
+    outs = {}
+    for solver in ("admm", "ipm"):
+        cfg = copy.deepcopy(tiny_config)
+        cfg["home"]["hems"]["solver"] = solver
+        env = load_environment(cfg, data_dir=None)
+        dt = int(cfg["agg"]["subhourly_steps"])
+        wd = load_waterdraw_profiles(None, seed=int(cfg["simulation"]["random_seed"]))
+        homes = create_homes(cfg, 24 * dt, dt, wd)
+        hems = cfg["home"]["hems"]
+        batch = build_home_batch(homes, int(hems["prediction_horizon"]) * dt,
+                                 dt, int(hems["sub_subhourly_steps"]))
+        eng = make_engine(batch, env, cfg, 0)
+        _, o = eng.run_chunk(eng.init_state(), 0,
+                             np.zeros((12, eng.params.horizon), np.float32))
+        outs[solver] = np.asarray(o.agg_load)
+    total_admm = outs["admm"].sum()
+    total_ipm = outs["ipm"].sum()
+    assert abs(total_ipm - total_admm) / max(abs(total_admm), 1e-6) < 0.02
