@@ -51,7 +51,8 @@ def _log(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
-def build(n_homes: int, horizon_hours: int, admm_iters: int):
+def build(n_homes: int, horizon_hours: int, admm_iters: int,
+          solver: str = "admm"):
     import numpy as np
 
     from dragg_tpu.config import default_config
@@ -70,6 +71,7 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int):
     cfg["simulation"]["end_datetime"] = "2015-01-08 00"
     cfg["home"]["hems"]["prediction_horizon"] = horizon_hours
     cfg["tpu"]["admm_iters"] = admm_iters
+    cfg["home"]["hems"]["solver"] = solver
 
     env = load_environment(cfg, data_dir=None)
     dt = int(cfg["agg"]["subhourly_steps"])
@@ -99,7 +101,34 @@ def run_measured(args) -> dict:
         raise RuntimeError("requested TPU but backend resolved to CPU")
 
     _log(f"building engine: {args.homes} homes, {args.horizon_hours}h horizon")
-    engine, np = build(args.homes, args.horizon_hours, args.admm_iters)
+    engine, np = build(args.homes, args.horizon_hours, args.admm_iters,
+                       solver="admm" if args.solver == "auto" else args.solver)
+    solver_used = engine.params.solver
+    if args.solver == "auto":
+        # Race the two solver families on ONE single-step each and keep the
+        # winner (the ADMM/IPM balance flips with batch size and hardware —
+        # docs/perf_notes.md; compile cost is paid once per candidate).
+        try:
+            engine_ipm, _ = build(args.homes, args.horizon_hours,
+                                  args.admm_iters, solver="ipm")
+
+            def step_time(eng):
+                st = eng.init_state()
+                rp0 = np.zeros(eng.params.horizon, dtype=np.float32)
+                st, out = eng.step(st, 0, rp0)       # compile
+                jax.block_until_ready(out.agg_load)
+                t0 = time.perf_counter()
+                st, out = eng.step(st, 1, rp0)
+                jax.block_until_ready(out.agg_load)
+                return time.perf_counter() - t0
+
+            t_admm = step_time(engine)
+            t_ipm = step_time(engine_ipm)
+            _log(f"solver race: admm {t_admm:.2f}s/step vs ipm {t_ipm:.2f}s/step")
+            if t_ipm < t_admm:
+                engine, solver_used = engine_ipm, "ipm"
+        except Exception as e:  # the race must never sink the benchmark
+            _log(f"solver race failed ({e!r}); staying on admm")
     H = engine.params.horizon
     state = engine.init_state()
 
@@ -180,7 +209,9 @@ def run_measured(args) -> dict:
     except Exception as e:  # profiling must never sink the benchmark
         _log(f"phase profiling failed: {e!r}")
 
-    # --- FLOPs + MFU.
+    # --- FLOPs + MFU (analytic model of the ADMM's dominant dense ops; the
+    # IPM's band scans have no dense-matmul FLOPs worth modeling — its MFU
+    # is reported as None).
     # XLA's cost_analysis counts the ADMM while_loop body ONCE, not per
     # iteration, so it can't drive MFU; use an analytic model of the
     # dominant dense ops instead (documented in docs/perf_notes.md):
@@ -202,8 +233,10 @@ def run_measured(args) -> dict:
         if key in str(device_kind).lower():
             peak = val
             break
-    if peak:
+    if peak and solver_used == "admm":
         mfu = (flops_per_step * rate) / peak
+    if solver_used != "admm":
+        flops_per_step = None
 
     # Optional profiler trace for manual inspection (BENCH_TRACE_DIR=...).
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
@@ -224,6 +257,7 @@ def run_measured(args) -> dict:
         "platform": platform,
         "device_kind": str(device_kind),
         "n_homes": args.homes,
+        "solver": solver_used,
         "horizon_steps": H,
         "chunk_rates": [round(r, 3) for r in chunk_rates],
         "compile_s": round(compile_s, 1),
@@ -245,6 +279,7 @@ def run_child(platform: str, homes: int, steps: int, chunks: int,
         "--platform", platform, "--homes", str(homes),
         "--horizon-hours", str(args.horizon_hours), "--steps", str(steps),
         "--chunks", str(chunks), "--admm-iters", str(args.admm_iters),
+        "--solver", args.solver,
         "--out", out_path,
     ]
     diag = {"platform": platform, "homes": homes, "timeout_s": timeout}
@@ -289,6 +324,8 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=16, help="timesteps per timed chunk")
     ap.add_argument("--chunks", type=int, default=3, help="number of timed chunks")
     ap.add_argument("--admm-iters", type=int, default=1000)
+    ap.add_argument("--solver", choices=["auto", "admm", "ipm"], default="auto",
+                    help="auto: race both on one step and keep the winner")
     ap.add_argument("--platform", choices=["auto", "tpu", "cpu"], default="auto")
     ap.add_argument("--cpu-fallback-homes", type=int, default=1_000,
                     help="community size for the CPU fallback attempt")
