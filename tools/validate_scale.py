@@ -25,6 +25,7 @@ def main():
     ap.add_argument("--horizon-hours", type=int, default=48)
     ap.add_argument("--days", type=int, default=2)
     ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument("--solver", choices=["admm", "ipm"], default="admm")
     ap.add_argument("--min-solve-rate", type=float, default=0.97)
     args = ap.parse_args()
 
@@ -42,6 +43,7 @@ def main():
     cfg["community"]["homes_battery"] = int(0.1 * n)
     cfg["community"]["homes_pv_battery"] = int(0.1 * n)
     cfg["home"]["hems"]["prediction_horizon"] = args.horizon_hours
+    cfg["home"]["hems"]["solver"] = args.solver
 
     env = load_environment(cfg, data_dir=None)
     dt = int(cfg["agg"]["subhourly_steps"])
@@ -92,6 +94,7 @@ def main():
     solve_rate = float(np.mean(rates))
     result = {
         "homes": n, "horizon_h": args.horizon_hours, "days": args.days,
+        "solver": args.solver,
         "platform": jax.devices()[0].platform,
         "device_kind": str(getattr(jax.devices()[0], "device_kind", "")),
         "solve_rate": round(solve_rate, 4),
