@@ -185,7 +185,8 @@ _DEFAULT: dict[str, Any] = {
         "admm_solve_backend": "auto",  # in-loop KKT solve: "dense_inv" |
                                        # "band" (no (B,m,m) array — the
                                        # 100k-home memory regime) | "auto"
-        "ipm_iters": 25,  # fixed Mehrotra iteration count (hems.solver="ipm")
+        "ipm_iters": 0,  # Mehrotra iteration count (hems.solver="ipm");
+                         # 0 = horizon-aware default: 16 + (decision steps)/2
         "forecast_noise_cap": 3.0,  # max forecast-noise std (degC): the reference's
                                     # unbounded 1.1^k growth breaks the season gate
                                     # beyond ~16h horizons (see engine._prepare)

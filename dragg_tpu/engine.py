@@ -549,7 +549,11 @@ def engine_params(config, start_index: int) -> EngineParams:
         admm_anderson=int(tpu_cfg.get("admm_anderson", 0)),
         admm_banded_factor=bool(tpu_cfg.get("admm_banded_factor", True)),
         admm_solve_backend=str(tpu_cfg.get("admm_solve_backend", "auto")),
-        ipm_iters=int(tpu_cfg.get("ipm_iters", 25)),
+        # Mehrotra iterations needed grow with the horizon (measured at
+        # H=48: 25 iters → 95.3% solve rate, 35 → 97.9%, 45 → 99.0%);
+        # 0 = horizon-aware default, explicit values override.
+        ipm_iters=int(tpu_cfg.get("ipm_iters", 0))
+        or 16 + max(1, int(hems["prediction_horizon"]) * dt) // 2,
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),
     )
