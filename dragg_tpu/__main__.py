@@ -4,6 +4,7 @@ post-processing step it ships commented out.
     python -m dragg_tpu run        # Aggregator().run() (dragg/main.py:4-9)
     python -m dragg_tpu reformat   # Reformat().main()  (dragg/main.py:11-17)
     python -m dragg_tpu bench      # the repo-root bench harness
+    python -m dragg_tpu dashboard  # results webapp (dragg/plotter.py's TODO)
 """
 
 from __future__ import annotations
@@ -30,6 +31,12 @@ def build_parser() -> argparse.ArgumentParser:
     ref.add_argument("--no-save", action="store_true", help="don't write PNGs")
 
     sub.add_parser("bench", help="run the benchmark harness (prints one JSON line)")
+
+    dash = sub.add_parser("dashboard", help="serve the results dashboard over HTTP")
+    dash.add_argument("--config", default=None)
+    dash.add_argument("--outputs-dir", default=None, help="default: $OUTPUT_DIR or ./outputs")
+    dash.add_argument("--port", type=int, default=8050)
+    dash.add_argument("--host", default="127.0.0.1")
     return p
 
 
@@ -57,6 +64,12 @@ def main(argv=None) -> int:
         if args.home:
             r.sample_home = args.home
         r.main(save=not args.no_save)
+        return 0
+    if args.cmd == "dashboard":
+        from dragg_tpu.dashboard import serve
+
+        serve(config=args.config, outputs_dir=args.outputs_dir,
+              port=args.port, host=args.host)
         return 0
     if args.cmd == "bench":
         import runpy

@@ -1,0 +1,222 @@
+"""Interactive results dashboard over finished runs.
+
+The reference ships ``dragg/plotter.py`` — a Dash/plotly scaffold for the
+README's "make into a Dash/plotly webapp" TODO (reference README.md:109) whose
+body is an unrelated gapminder demo.  This module is the working equivalent:
+a zero-dependency web dashboard (stdlib ``http.server`` + the matplotlib
+figures :class:`dragg_tpu.reformat.Reformat` already builds) that discovers
+runs the same way the analysis layer does and serves every comparison figure
+as on-demand SVG, plus per-home drill-down like the reference's
+``plot_single_home`` (dragg/reformat.py:257-296).
+
+Routes:
+  ``/``                     index: discovered runs, stats table, figure links
+  ``/fig/<name>.svg``       any figure from :data:`FIGURES`
+  ``/fig/single_home.svg?home=<name>``  per-home drill-down
+
+Usage: ``python -m dragg_tpu dashboard [--port 8050]`` (the reference stub's
+default Dash port), or :func:`serve` / :class:`Dashboard` programmatically.
+"""
+
+from __future__ import annotations
+
+import glob
+import html
+import io
+import os
+import threading
+import urllib.parse
+from datetime import datetime, timedelta
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from dragg_tpu.logger import Logger
+from dragg_tpu.reformat import Reformat, daily_stats, stats_table
+
+# name -> Reformat method building the figure (all take ax=None and return a
+# matplotlib Figure or None when the needed series are absent).
+FIGURES = (
+    "baseline", "typ_day", "parametric", "rl2baseline",
+    "max_and_12hravg", "all_rps", "single_home",
+)
+
+
+class Dashboard:
+    """Render-side of the dashboard: HTML index + named SVG figures.
+
+    Split from the HTTP handler so tests (and notebooks) can render without
+    binding a socket.
+    """
+
+    def __init__(self, config=None, outputs_dir: str | None = None):
+        self.log = Logger("dashboard")
+        # pyplot's figure-manager state is process-global and not
+        # thread-safe; ThreadingHTTPServer renders concurrently (a browser
+        # fires one request per <img>), so figure building is serialized.
+        self.render_lock = threading.Lock()
+        self.ref = Reformat(config=config, outputs_dir=outputs_dir)
+        if not self.ref.files:
+            # Reformat's discovery permutes the CONFIG's parameter space into
+            # directory names (reference parity, dragg/reformat.py:101-171) —
+            # right for scripted comparisons, wrong for "show me whatever is
+            # here".  Fall back to globbing the outputs tree.
+            self.ref.files = self._glob_runs()
+
+    def _glob_runs(self) -> list[dict]:
+        files = []
+        pattern = os.path.join(self.ref.outputs_dir, "**", "results.json")
+        for path in sorted(glob.glob(pattern, recursive=True)):
+            vdir = os.path.dirname(os.path.dirname(path))
+            case = os.path.basename(os.path.dirname(path))
+            run = os.path.basename(os.path.dirname(os.path.dirname(vdir)))
+            try:
+                parent = self._parent(path, vdir)
+            except Exception as e:  # in-progress / corrupt run: skip, don't die
+                self.log.logger.warning(f"skipping unreadable run {path}: {e!r}")
+                continue
+            entry = {
+                "results": path,
+                "name": f"{case}, {run}",
+                "case": case,
+                # Figures read path/agg_dt/ts/x_lims off the parent
+                # (set_mpc_folders layout); reconstruct them from Summary.
+                "parent": parent,
+            }
+            agent = os.path.join(os.path.dirname(path), "utility_agent-results.json")
+            if os.path.isfile(agent):
+                entry["q_results"] = agent
+            files.append(entry)
+            self.log.logger.info(f"glob fallback: adding {path}")
+        return files
+
+    def _parent(self, results_path: str, vdir: str) -> dict:
+        s = self.ref._load(results_path)["Summary"]
+        start = datetime.strptime(s["start_datetime"], "%Y-%m-%d %H")
+        end = datetime.strptime(s["end_datetime"], "%Y-%m-%d %H")
+        hours = (end - start).total_seconds() / 3600
+        n = len(s.get("p_grid_aggregate", []))
+        agg_dt = max(1, round(n / hours)) if hours else 1
+        x_lims = [start + timedelta(minutes=(60 // agg_dt) * i) for i in range(n)]
+        return {"path": vdir, "agg_dt": agg_dt, "ts": n, "x_lims": x_lims}
+
+    # ------------------------------------------------------------- figures
+    def render_figure(self, name: str, home: str | None = None) -> bytes | None:
+        """SVG bytes for one named figure, or None for an unknown name /
+        a figure with nothing to draw."""
+        if name not in FIGURES:
+            return None
+        if name == "single_home":
+            fig = self.ref.plot_single_home(name=home)
+        elif name in ("rl2baseline", "all_rps"):
+            fig = getattr(self.ref, name)()
+        else:
+            fig = getattr(self.ref, f"plot_{name}")()
+        if fig is None:
+            return None
+        buf = io.BytesIO()
+        fig.savefig(buf, format="svg", bbox_inches="tight")
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+        return buf.getvalue()
+
+    def render_figure_locked(self, name: str, home: str | None = None) -> bytes | None:
+        with self.render_lock:
+            return self.render_figure(name, home=home)
+
+    # --------------------------------------------------------------- index
+    def _home_names(self) -> list[str]:
+        names: set[str] = set()
+        for file in self.ref.files:
+            data = self.ref._load(file["results"])
+            names |= {n for n, h in data.items()
+                      if n != "Summary" and isinstance(h, dict) and "type" in h}
+        return sorted(names)
+
+    def index_html(self) -> str:
+        rows = []
+        for file in self.ref.files:
+            summary = self.ref._load(file["results"])["Summary"]
+            loads = np.asarray(summary.get("p_grid_aggregate", []), dtype=float)
+            steps_per_day = 24 * file["parent"].get("agg_dt", 1)
+            if loads.size:
+                rows.append((file["name"], daily_stats(loads, steps_per_day)))
+        stats = stats_table(rows) if rows else "(no finished runs found)"
+
+        figs = "\n".join(
+            f'<h3>{name}</h3><img src="/fig/{name}.svg" style="max-width:100%">'
+            for name in FIGURES if name != "single_home"
+        )
+        homes = "\n".join(
+            f'<li><a href="/fig/single_home.svg?home={urllib.parse.quote(n)}">{html.escape(n)}</a></li>'
+            for n in self._home_names()
+        )
+        run_list = "\n".join(
+            f"<li><code>{html.escape(f['results'])}</code></li>" for f in self.ref.files
+        )
+        return f"""<!doctype html><html><head><title>dragg_tpu dashboard</title>
+<style>body{{font-family:sans-serif;margin:2em;max-width:1100px}}
+pre{{background:#f6f6f6;padding:1em;overflow-x:auto}}</style></head><body>
+<h1>dragg_tpu dashboard</h1>
+<h2>Discovered runs</h2><ul>{run_list or "<li>(none)</li>"}</ul>
+<h2>Daily statistics</h2><pre>{html.escape(stats)}</pre>
+<h2>Figures</h2>{figs}
+<h2>Per-home drill-down</h2><ul>{homes or "<li>(no per-home data)</li>"}</ul>
+</body></html>"""
+
+
+def make_handler(dash: Dashboard):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route to the framework logger
+            dash.log.logger.info("http: " + fmt % args)
+
+        def _send(self, code: int, ctype: str, body: bytes) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            parsed = urllib.parse.urlparse(self.path)
+            if parsed.path in ("", "/"):
+                try:
+                    body = dash.index_html().encode()
+                except Exception as e:  # a bad run file must not kill the server
+                    self._send(500, "text/plain", f"index failed: {e!r}".encode())
+                    return
+                self._send(200, "text/html; charset=utf-8", body)
+                return
+            if parsed.path.startswith("/fig/") and parsed.path.endswith(".svg"):
+                name = parsed.path[len("/fig/"):-len(".svg")]
+                home = urllib.parse.parse_qs(parsed.query).get("home", [None])[0]
+                try:
+                    svg = dash.render_figure_locked(name, home=home)
+                except Exception as e:
+                    self._send(500, "text/plain", f"figure failed: {e!r}".encode())
+                    return
+                if svg is None:
+                    self._send(404, "text/plain", b"no such figure")
+                    return
+                self._send(200, "image/svg+xml", svg)
+                return
+            self._send(404, "text/plain", b"not found")
+
+    return Handler
+
+
+def serve(config=None, outputs_dir: str | None = None, port: int = 8050,
+          host: str = "127.0.0.1") -> None:
+    """Blocking server loop (port default = the Dash default the reference
+    stub would have used)."""
+    dash = Dashboard(config=config, outputs_dir=outputs_dir)
+    httpd = ThreadingHTTPServer((host, port), make_handler(dash))
+    dash.log.logger.info(
+        f"dashboard on http://{host}:{httpd.server_address[1]} "
+        f"({len(dash.ref.files)} runs)"
+    )
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()

@@ -528,9 +528,10 @@ def engine_params(config, start_index: int) -> EngineParams:
     hems = config["home"]["hems"]
     dt = int(config["agg"]["subhourly_steps"])
     tpu_cfg = config.get("tpu", {})
+    horizon = max(1, int(hems["prediction_horizon"]) * dt)
     return EngineParams(
         solver=str(hems.get("solver", "admm")),
-        horizon=max(1, int(hems["prediction_horizon"]) * dt),
+        horizon=horizon,
         dt=dt,
         s=float(max(1, int(hems["sub_subhourly_steps"]))),
         discount=float(hems["discount_factor"]),
@@ -552,8 +553,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         # Mehrotra iterations needed grow with the horizon (measured at
         # H=48: 25 iters → 95.3% solve rate, 35 → 97.9%, 45 → 99.0%);
         # 0 = horizon-aware default, explicit values override.
-        ipm_iters=int(tpu_cfg.get("ipm_iters", 0))
-        or 16 + max(1, int(hems["prediction_horizon"]) * dt) // 2,
+        ipm_iters=int(tpu_cfg.get("ipm_iters", 0)) or 16 + horizon // 2,
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),
     )

@@ -217,3 +217,65 @@ def test_cli_parser():
     assert args.cmd == "run" and args.outputs_dir == "x"
     args = p.parse_args(["reformat", "--home", "Bob-ABCDE", "--no-save"])
     assert args.cmd == "reformat" and args.home == "Bob-ABCDE"
+
+
+# ------------------------------------------------------------------ dashboard
+
+def test_dashboard_index_and_figures(finished_run):
+    """The plotter.py-equivalent webapp renders an index over the discovered
+    runs and serves every comparison figure as SVG."""
+    from dragg_tpu.dashboard import FIGURES, Dashboard
+
+    cfg, out, agg = finished_run
+    dash = Dashboard(config=cfg, outputs_dir=out)
+    page = dash.index_html()
+    assert "baseline" in page and "Daily statistics" in page
+    # Every discovered run's results path is listed.
+    for f in dash.ref.files:
+        assert f["results"] in page
+    svg = dash.render_figure("baseline")
+    assert svg is not None and b"<svg" in svg[:500]
+    assert dash.render_figure("nonexistent") is None
+    # Per-home drill-down mirrors plot_single_home.
+    homes = dash._home_names()
+    assert homes
+    svg = dash.render_figure("single_home", home=homes[0])
+    assert svg is not None and b"<svg" in svg[:500]
+
+
+def test_dashboard_http_roundtrip(finished_run):
+    """Real HTTP round-trip on an ephemeral port."""
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from dragg_tpu.dashboard import Dashboard, make_handler
+
+    cfg, out, agg = finished_run
+    dash = Dashboard(config=cfg, outputs_dir=out)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(dash))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as r:
+            assert r.status == 200
+            assert "dragg_tpu dashboard" in r.read().decode()
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/fig/baseline.svg") as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "image/svg+xml"
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/fig/nope.svg")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_cli_parser_dashboard():
+    from dragg_tpu.__main__ import build_parser
+
+    args = build_parser().parse_args(["dashboard", "--port", "9000"])
+    assert args.cmd == "dashboard" and args.port == 9000
