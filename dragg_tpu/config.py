@@ -187,6 +187,9 @@ _DEFAULT: dict[str, Any] = {
                                        # 100k-home memory regime) | "auto"
         "ipm_iters": 0,  # Mehrotra iteration count (hems.solver="ipm");
                          # 0 = horizon-aware default: 16 + (decision steps)/2
+        "band_kernel": "auto",  # band factor/solve impl: "pallas" (fused TPU
+                                # kernels, ops/pallas_band.py) | "xla" (scan
+                                # path) | "auto" = pallas on TPU, xla elsewhere
         "forecast_noise_cap": 3.0,  # max forecast-noise std (degC): the reference's
                                     # unbounded 1.1^k growth breaks the season gate
                                     # beyond ~16h horizons (see engine._prepare)

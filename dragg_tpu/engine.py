@@ -150,6 +150,7 @@ class EngineParams(NamedTuple):
     admm_banded_factor: bool  # banded-Cholesky Schur factorization
     admm_solve_backend: str  # "auto" | "dense_inv" | "band" in-loop solve
     ipm_iters: int      # fixed Mehrotra iteration count (solver="ipm")
+    band_kernel: str    # "auto" | "pallas" | "xla" band factor/solve impl
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
 
@@ -196,6 +197,25 @@ class Engine:
             elem_bytes=2 if params.admm_matvec_dtype == "bf16" else 4,
             n_shards=getattr(self, "_mesh_shards", 1),
         )
+        # Resolve the "auto" band kernel HERE too: Pallas only when it
+        # compiles natively (TPU backend) AND the engine is single-shard —
+        # pallas_call inside a pjit-sharded program would need shard_map
+        # to partition, which the sharded path doesn't do (it stays on the
+        # XLA scans, which partition trivially).
+        from dragg_tpu.ops import pallas_band
+
+        kern = params.band_kernel
+        if kern not in ("auto", "pallas", "xla"):
+            raise ValueError(f"tpu.band_kernel must be auto|pallas|xla, got {kern!r}")
+        if kern == "pallas" and getattr(self, "_mesh_shards", 1) > 1:
+            raise ValueError(
+                "tpu.band_kernel='pallas' is single-shard only (pallas_call "
+                "does not partition under the sharded engine without "
+                "shard_map); use 'auto' or 'xla' on a mesh")
+        if kern == "auto":
+            kern = ("pallas" if pallas_band.available()
+                    and getattr(self, "_mesh_shards", 1) == 1 else "xla")
+        self._band_kernel = kern
         self._step_fn = jax.jit(self._step)
         self._chunk_fn = jax.jit(self._chunk)
 
@@ -235,7 +255,8 @@ class Engine:
         return init_factor_carry(self.n_homes, self.static.pattern,
                                  matvec_dtype=self.params.admm_matvec_dtype,
                                  solve_backend=self._solve_backend,
-                                 banded_factor=self.params.admm_banded_factor)
+                                 banded_factor=self.params.admm_banded_factor,
+                                 band_kernel=self._band_kernel)
 
     # ----------------------------------------------------------------- step
     def _prepare(self, state: CommunityState, t, rp):
@@ -338,6 +359,7 @@ class Engine:
                 self.static.pattern, qp.vals, qp.b_eq, qp.l_box, qp.u_box,
                 qp.q, reg=p.admm_reg, iters=p.ipm_iters,
                 eps_abs=p.admm_eps, eps_rel=p.admm_eps,
+                band_kernel=self._band_kernel,
             )
             return sol, factor
         return admm_solve_qp_cached(
@@ -354,6 +376,7 @@ class Engine:
             anderson=p.admm_anderson,
             banded_factor=p.admm_banded_factor,
             solve_backend=self._solve_backend,
+            band_kernel=self._band_kernel,
             x0=state.warm_x, y_box0=state.warm_y_box,
             rho0=state.warm_rho,
         )
@@ -554,6 +577,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         # H=48: 25 iters → 95.3% solve rate, 35 → 97.9%, 45 → 99.0%);
         # 0 = horizon-aware default, explicit values override.
         ipm_iters=int(tpu_cfg.get("ipm_iters", 0)) or 16 + horizon // 2,
+        band_kernel=str(tpu_cfg.get("band_kernel", "auto")),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),
     )

@@ -64,6 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dragg_tpu.ops import pallas_band
 from dragg_tpu.ops.banded import (
     band_matvec,
     band_scatter,
@@ -251,6 +252,10 @@ def _admm_impl(
                                   # "auto": band when the Sinv would
                                   #   exceed ~1 GB and the pattern is
                                   #   banded, else dense_inv
+    band_kernel: str = "xla",  # "pallas": fused TPU kernels for the band
+                               # factor/solve (ops/pallas_band.py) — the
+                               # factor carry then holds TRANSPOSED
+                               # (m, bw+1, B) band storage; "xla": scan path
     anderson: int = 0,       # Anderson-acceleration history depth (0 = off).
                              # Type-II AA applied once per check window on
                              # the (z, y) pair — the window map T^check_every
@@ -337,6 +342,27 @@ def _admm_impl(
     if backend == "band":
         perm_ix = jnp.asarray(band_plan.perm)
         invp_ix = jnp.asarray(band_plan.inv)
+        # Bind the kernel family once per trace (band_kernel is static):
+        # the pallas functions take/return the TRANSPOSED (m, bw+1, B)
+        # band storage, the XLA scans the (B, m, bw+1) layout.
+        if band_kernel == "pallas":
+            scatter_fn = lambda c: pallas_band.band_scatter_t(band_plan, c)
+            chol_fn = lambda Sb: pallas_band.banded_cholesky_t(Sb, band_plan.bw)
+
+            def band_solve_fn(Lb, Sb, rp, refine):
+                return jnp.swapaxes(pallas_band.refined_banded_solve_t(
+                    Lb, Sb, jnp.swapaxes(rp, 0, 1), band_plan.bw,
+                    refine=refine), 0, 1)
+        else:
+            scatter_fn = lambda c: band_scatter(band_plan, c)
+            chol_fn = lambda Sb: banded_cholesky(Sb, band_plan.bw)
+
+            def band_solve_fn(Lb, Sb, rp, refine):
+                v = banded_solve(Lb, rp, band_plan.bw)
+                for _ in range(refine):
+                    resid = rp - band_matvec(Sb, v, band_plan.bw)
+                    v = v + banded_solve(Lb, resid, band_plan.bw)
+                return v
 
     def factor(rho_b):
         """Schur-complement factor of the equality-constrained x-update.
@@ -352,9 +378,8 @@ def _admm_impl(
             # No (B, m, m) array exists in this mode: the carry holds the
             # band Cholesky factor; refinement matvecs run on the band S.
             contrib = schur_contrib(schur, vals_s, Dinv)
-            Sb = band_scatter(band_plan, contrib)
-            Lb = banded_cholesky(Sb, band_plan.bw)
-            return Dinv, Lb, Sb
+            Sb = scatter_fn(contrib)
+            return Dinv, chol_fn(Sb), Sb
         if band_plan is not None:
             # One contrib computation feeds both the dense S (kept for
             # refinement / stale reuse) and the banded inverse.
@@ -378,7 +403,7 @@ def _admm_impl(
         computed — which iterative refinement in ``s_solve`` corrects."""
         Dinv = diag_inv(rho_b)
         if backend == "band":
-            Sb = band_scatter(band_plan, schur_contrib(schur, vals_s, Dinv))
+            Sb = scatter_fn(schur_contrib(schur, vals_s, Dinv))
             return Dinv, carry_in.Sinv, Sb
         return Dinv, carry_in.Sinv, form_S(Dinv)
 
@@ -387,12 +412,7 @@ def _admm_impl(
         bf16-storage rounding and stale-factor drift)."""
         if backend == "band":
             _, Lb, Sb = F
-            bw = band_plan.bw
-            rp = r[:, perm_ix]
-            v = banded_solve(Lb, rp, bw)
-            for _ in range(refine):
-                resid = rp - band_matvec(Sb, v, bw)
-                v = v + banded_solve(Lb, resid, bw)
+            v = band_solve_fn(Lb, Sb, r[:, perm_ix], refine)
             return v[:, invp_ix]
         _, Sinv, S = F
         pinv = lambda rr: jnp.einsum(
@@ -632,7 +652,7 @@ def _admm_impl(
 
 _STATIC = ("pat", "iters", "check_every", "ruiz_iters", "adaptive_rho",
            "rho_update_every", "patience", "matvec_dtype", "refine", "anderson",
-           "banded_factor", "solve_backend")
+           "banded_factor", "solve_backend", "band_kernel")
 
 
 @partial(jax.jit, static_argnames=_STATIC)
@@ -657,14 +677,18 @@ def admm_solve_qp_cached(pat, vals, b_eq, l_box, u_box, q, carry_in, refresh,
 def init_factor_carry(B: int, pat: SparsePattern, dtype=jnp.float32,
                       matvec_dtype: str = "f32",
                       solve_backend: str = "auto",
-                      banded_factor: bool = True) -> FactorCarry:
+                      banded_factor: bool = True,
+                      band_kernel: str = "xla") -> FactorCarry:
     """Zero-filled carry for t=0 (the first step must pass refresh=True).
     In band mode the ``Sinv`` field holds the (B, m, bw+1) band Cholesky
-    factor instead of a dense inverse."""
+    factor instead of a dense inverse — or its (m, bw+1, B) transpose under
+    the Pallas kernels."""
     plan = plan_for(_schur_structure_for(pat), pat.m) if banded_factor else None
     backend = resolve_backend(solve_backend, B, pat.m, plan is not None,
                               elem_bytes=2 if matvec_dtype == "bf16" else 4)
-    if backend == "band":
+    if backend == "band" and band_kernel == "pallas":
+        factor0 = jnp.zeros((pat.m, plan.bw + 1, B), dtype=dtype)
+    elif backend == "band":
         factor0 = jnp.zeros((B, pat.m, plan.bw + 1), dtype=dtype)
     else:
         sinv_dtype = jnp.bfloat16 if matvec_dtype == "bf16" else dtype

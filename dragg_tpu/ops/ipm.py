@@ -39,6 +39,7 @@ from dragg_tpu.ops.admm import (
     _schur_structure_for,
     ruiz_equilibrate_sparse,
 )
+from dragg_tpu.ops import pallas_band
 from dragg_tpu.ops.banded import (
     band_matvec,
     band_scatter,
@@ -51,7 +52,7 @@ from dragg_tpu.ops.qp import SparsePattern, schur_contrib
 _BIG = 1e20
 
 
-@partial(jax.jit, static_argnames=("pat", "iters", "ruiz_iters"))
+@partial(jax.jit, static_argnames=("pat", "iters", "ruiz_iters", "band_kernel"))
 def ipm_solve_qp(
     pat: SparsePattern,
     vals: jnp.ndarray,      # (B, nnz) A values
@@ -65,6 +66,7 @@ def ipm_solve_qp(
     eps_abs: float = 1e-4,
     eps_rel: float = 1e-4,
     ruiz_iters: int = 10,
+    band_kernel: str = "xla",
 ) -> ADMMSolution:
     """Solve the batch; returns the ADMM-compatible solution record (y_box
     carries z_u − z_l; rho is 1s — kept for interface parity)."""
@@ -145,16 +147,29 @@ def ipm_solve_qp(
 
     n_act = jnp.maximum(jnp.sum(fin_l, axis=1) + jnp.sum(fin_u, axis=1), 1)
 
+    use_pallas = band_kernel == "pallas"
+
     def solve_kkt(Lb, Sb, theta_inv, r1, r2):
         """One reduced-KKT solve: dy from the band factor (with one
         refinement pass against the band S — f32 needs it at barrier
         conditioning), dx by back-substitution.
-        [Θ Âᵀ; Â 0][dx; dy] = [r1; r2]."""
+        [Θ Âᵀ; Â 0][dx; dy] = [r1; r2].
+
+        With the Pallas backend, Lb/Sb are in TRANSPOSED (m, bw+1, B)
+        storage and the whole refined solve is one fused kernel
+        (dragg_tpu/ops/pallas_band.py); the XLA path runs it as 4 scans +
+        a matvec.  Same recurrences, same refinement count."""
         rhs = mv(theta_inv * r1) - r2
         rp = rhs[:, perm_ix]
-        dy = banded_solve(Lb, rp, bw)
-        resid = rp - band_matvec(Sb, dy, bw)
-        dy = (dy + banded_solve(Lb, resid, bw))[:, invp_ix]
+        if use_pallas:
+            dy_t = pallas_band.refined_banded_solve_t(
+                Lb, Sb, jnp.swapaxes(rp, 0, 1), bw, refine=1
+            )
+            dy = jnp.swapaxes(dy_t, 0, 1)[:, invp_ix]
+        else:
+            dy = banded_solve(Lb, rp, bw)
+            resid = rp - band_matvec(Sb, dy, bw)
+            dy = (dy + banded_solve(Lb, resid, bw))[:, invp_ix]
         dx = theta_inv * (r1 - mvt(dy))
         return dx, dy
 
@@ -182,9 +197,14 @@ def ipm_solve_qp(
         theta = jnp.where(frozen[:, None], 1.0, theta)  # benign factor input
         theta_inv = 1.0 / theta
         contrib = schur_contrib(schur, vals_s, theta_inv)
-        Sb = band_scatter(plan, contrib)
-        Sb = Sb.at[:, :, 0].add(1e-6 * jnp.max(Sb[:, :, 0], axis=1, keepdims=True))
-        Lb = banded_cholesky(Sb, bw)
+        if use_pallas:
+            Sb = pallas_band.band_scatter_t(plan, contrib)   # (m, bw+1, B)
+            Sb = Sb.at[:, 0, :].add(1e-6 * jnp.max(Sb[:, 0, :], axis=0, keepdims=True))
+            Lb = pallas_band.banded_cholesky_t(Sb, bw)
+        else:
+            Sb = band_scatter(plan, contrib)                 # (B, m, bw+1)
+            Sb = Sb.at[:, :, 0].add(1e-6 * jnp.max(Sb[:, :, 0], axis=1, keepdims=True))
+            Lb = banded_cholesky(Sb, bw)
 
         # Residuals.
         r_dual = -(reg_s * x + qs + mvt(y) - z_l + z_u)        # stationarity

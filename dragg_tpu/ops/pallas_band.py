@@ -1,0 +1,222 @@
+"""Pallas TPU kernels for the banded Schur machinery.
+
+The XLA implementation (dragg_tpu/ops/banded.py) runs each band operation
+as a ``lax.scan`` over the m matrix rows with only (B, bw+1) elementwise
+work per step — on chip every one of those m sequential steps pays loop
+dispatch overhead, and one IPM iteration runs ~9 such scans (factor + four
+forward/backward solves).  At 10k homes that overhead IS the solve phase
+(docs/perf_notes.md, on-chip phase timers).
+
+These kernels invert the layout — the HOME axis maps onto the TPU lanes,
+the row recurrence runs as a ``fori_loop`` INSIDE one kernel over
+VMEM-resident band storage — so the m-step chain costs VPU latency per
+step instead of an XLA loop iteration, and a whole factor/refined-solve is
+one kernel launch.
+
+Band storage here is "transposed": ``(m, bw+1, B)`` with
+``Sb_t[i, k, b] = S_perm[i, i-k]`` for home b (the XLA path uses
+``(B, m, bw+1)``).  Blocks of ``LANE_BLOCK`` homes are mapped over the
+grid; B is padded to a multiple (identity rows — benign for the factor).
+
+Numerics are identical to banded.py's recurrences (same operation order),
+verified element-wise in tests/test_pallas_band.py via interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+LANE_BLOCK = 512  # homes per kernel program (4 lane tiles)
+
+
+def available() -> bool:
+    """True when the runtime can execute Pallas TPU kernels compiled (not
+    interpreted) — i.e. the default backend is a TPU."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _unit_row(bwp1: int, Bt: int, dtype) -> jnp.ndarray:
+    """(bw+1, Bt) tile of a virtual identity L row: diag 1, off-band 0."""
+    is_diag = lax.broadcasted_iota(jnp.int32, (bwp1, Bt), 0) == 0
+    return jnp.where(is_diag, jnp.ones((), dtype), jnp.zeros((), dtype))
+
+
+# ----------------------------------------------------------------- cholesky
+def _chol_kernel(s_ref, l_ref, *, m: int, bw: int):
+    from jax.experimental import pallas as pl
+
+    bwp1 = bw + 1
+    Bt = s_ref.shape[-1]
+    dtype = s_ref.dtype
+    unit = _unit_row(bwp1, Bt, dtype)
+
+    def row_step(i, _):
+        srow = s_ref[pl.ds(i, 1)][0]                        # (bw+1, Bt)
+        # prevs[d-1] = L row (i-d), virtual unit rows above the top.
+        prevs = []
+        for d in range(1, bw + 1):
+            jj = jnp.maximum(i - d, 0)
+            lrow = l_ref[pl.ds(jj, 1)][0]
+            prevs.append(jnp.where(i - d >= 0, lrow, unit))
+        # Same recurrence/operation order as banded.banded_cholesky.
+        row = [None] * bwp1
+        for k in range(bw, 0, -1):
+            s = srow[k]
+            for j in range(1, bw - k + 1):
+                s = s - row[k + j] * prevs[k - 1][j]
+            row[k] = s / prevs[k - 1][0]
+        diag = srow[0]
+        for j in range(1, bw + 1):
+            diag = diag - row[j] * row[j]
+        row[0] = jnp.sqrt(jnp.maximum(diag, 1e-20))
+        l_ref[pl.ds(i, 1)] = jnp.stack(row)[None]
+        return 0
+
+    lax.fori_loop(0, m, row_step, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("bw",))
+def banded_cholesky_t(Sb_t: jnp.ndarray, bw: int) -> jnp.ndarray:
+    """Batched band Cholesky in transposed storage: (m, bw+1, B) → L same
+    layout, one kernel per LANE_BLOCK homes."""
+    from jax.experimental import pallas as pl
+
+    m, bwp1, B = Sb_t.shape
+    Bp = -(-B // LANE_BLOCK) * LANE_BLOCK
+    if Bp != B:
+        pad = jnp.zeros((m, bwp1, Bp - B), Sb_t.dtype).at[:, 0, :].set(1.0)
+        Sb_t = jnp.concatenate([Sb_t, pad], axis=-1)
+    out = pl.pallas_call(
+        functools.partial(_chol_kernel, m=m, bw=bw),
+        out_shape=jax.ShapeDtypeStruct((m, bwp1, Bp), Sb_t.dtype),
+        grid=(Bp // LANE_BLOCK,),
+        in_specs=[pl.BlockSpec((m, bwp1, LANE_BLOCK), lambda b: (0, 0, b))],
+        out_specs=pl.BlockSpec((m, bwp1, LANE_BLOCK), lambda b: (0, 0, b)),
+        interpret=_interpret(),
+    )(Sb_t)
+    return out[:, :, :B]
+
+
+# ------------------------------------------------------------ refined solve
+def _solve_into(l_ref, rhs_ref, y_ref, x_ref, *, m: int, bw: int):
+    """In-kernel forward+backward substitution: x_ref ← (L Lᵀ)⁻¹ rhs_ref.
+    ``y_ref`` is scratch for the forward pass; ``x_ref`` may alias
+    ``rhs_ref`` (the backward pass never re-reads the rhs)."""
+    from jax.experimental import pallas as pl
+
+    Bt = l_ref.shape[-1]
+    dtype = l_ref.dtype
+    zero = jnp.zeros((1, Bt), dtype)
+
+    def fwd(i, _):
+        lrow = l_ref[pl.ds(i, 1)][0]                        # (bw+1, Bt)
+        acc = rhs_ref[pl.ds(i, 1)]                          # (1, Bt)
+        for k in range(1, bw + 1):
+            jj = jnp.maximum(i - k, 0)
+            yk = y_ref[pl.ds(jj, 1)]
+            acc = acc - jnp.where(i - k >= 0, lrow[k][None] * yk, zero)
+        y_ref[pl.ds(i, 1)] = acc / lrow[0][None]
+        return 0
+
+    lax.fori_loop(0, m, fwd, 0)
+
+    def bwd(t, _):
+        i = m - 1 - t
+        lrow = l_ref[pl.ds(i, 1)][0]
+        acc = y_ref[pl.ds(i, 1)]
+        for k in range(1, bw + 1):
+            jj = jnp.minimum(i + k, m - 1)
+            lbelow = l_ref[pl.ds(jj, 1)][0]
+            xk = x_ref[pl.ds(jj, 1)]
+            acc = acc - jnp.where(i + k < m, lbelow[k][None] * xk, zero)
+        x_ref[pl.ds(i, 1)] = acc / lrow[0][None]
+        return 0
+
+    lax.fori_loop(0, m, bwd, 0)
+
+
+def _band_matvec_body(s_ref, v, *, m: int, bw: int):
+    """(S v) for band-stored symmetric S against an (m, Bt) value."""
+    from jax.experimental import pallas as pl
+
+    S = s_ref[:]                                            # (m, bw+1, Bt)
+    Bt = v.shape[-1]
+    zk = lambda k: jnp.zeros((k, Bt), v.dtype)
+    out = S[:, 0, :] * v
+    for k in range(1, bw + 1):
+        lo = S[k:, k, :]                                    # S[i, i-k], i>=k
+        # row i (i>=k) += lo[i-k]·v[i-k]; row j (j<m-k) += lo[j]·v[j+k].
+        out = out + jnp.concatenate([zk(k), lo * v[:-k]], axis=0)
+        out = out + jnp.concatenate([lo * v[k:], zk(k)], axis=0)
+    return out
+
+
+def _refined_solve_kernel(l_ref, s_ref, r_ref, out_ref, y_ref, t_ref, *,
+                          m: int, bw: int, refine: int):
+    _solve_into(l_ref, r_ref, y_ref, out_ref, m=m, bw=bw)
+    for _ in range(refine):
+        t_ref[:] = r_ref[:] - _band_matvec_body(s_ref, out_ref[:], m=m, bw=bw)
+        _solve_into(l_ref, t_ref, y_ref, t_ref, m=m, bw=bw)
+        out_ref[:] = out_ref[:] + t_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("bw", "refine"))
+def refined_banded_solve_t(Lb_t: jnp.ndarray, Sb_t: jnp.ndarray,
+                           r_t: jnp.ndarray, bw: int,
+                           refine: int = 1) -> jnp.ndarray:
+    """x ≈ S⁻¹ r via band factor + ``refine`` iterative-refinement passes,
+    fused into ONE kernel (the XLA path runs 2(1+refine) scans + a matvec).
+
+    Lb_t/Sb_t: (m, bw+1, B) transposed band storage; r_t: (m, B).
+    """
+    from jax.experimental import pallas as pl
+
+    m, bwp1, B = Lb_t.shape
+    Bp = -(-B // LANE_BLOCK) * LANE_BLOCK
+    if Bp != B:
+        padL = jnp.zeros((m, bwp1, Bp - B), Lb_t.dtype).at[:, 0, :].set(1.0)
+        Lb_t = jnp.concatenate([Lb_t, padL], axis=-1)
+        Sb_t = jnp.concatenate([Sb_t, padL], axis=-1)
+        r_t = jnp.concatenate([r_t, jnp.zeros((m, Bp - B), r_t.dtype)], axis=-1)
+    from jax.experimental.pallas import tpu as pltpu
+
+    out = pl.pallas_call(
+        functools.partial(_refined_solve_kernel, m=m, bw=bw, refine=refine),
+        out_shape=jax.ShapeDtypeStruct((m, Bp), r_t.dtype),
+        grid=(Bp // LANE_BLOCK,),
+        in_specs=[
+            pl.BlockSpec((m, bwp1, LANE_BLOCK), lambda b: (0, 0, b)),
+            pl.BlockSpec((m, bwp1, LANE_BLOCK), lambda b: (0, 0, b)),
+            pl.BlockSpec((m, LANE_BLOCK), lambda b: (0, b)),
+        ],
+        out_specs=pl.BlockSpec((m, LANE_BLOCK), lambda b: (0, b)),
+        scratch_shapes=[
+            pltpu.VMEM((m, LANE_BLOCK), r_t.dtype),
+            pltpu.VMEM((m, LANE_BLOCK), r_t.dtype),
+        ],
+        interpret=_interpret(),
+    )(Lb_t, Sb_t, r_t)
+    return out[:, :B]
+
+
+# ----------------------------------------------------- transposed scatter
+def band_scatter_t(plan, contrib: jnp.ndarray) -> jnp.ndarray:
+    """Schur entry values (B, n_s) → TRANSPOSED band storage (m, bw+1, B)
+    (banded.band_scatter builds the (B, m, bw+1) layout)."""
+    B = contrib.shape[0]
+    Sb_t = jnp.zeros((plan.m, plan.bw + 1, B), dtype=contrib.dtype)
+    return Sb_t.at[plan.ent_row, plan.ent_off, :].set(
+        jnp.swapaxes(contrib[:, plan.ent_src], 0, 1)
+    )

@@ -1,0 +1,142 @@
+"""Pallas band-kernel parity vs the XLA scan implementation.
+
+On the CPU test platform the kernels run in interpret mode — same program,
+emulated memory model — so these tests pin the numerics; the on-chip win is
+measured by bench.py/tools/profile_solver.py (docs/perf_notes.md).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dragg_tpu.ops import banded as bd
+from dragg_tpu.ops import pallas_band as pb
+
+
+def _random_band_spd(B, m, bw, seed=0):
+    rng = np.random.default_rng(seed)
+    Sb = np.zeros((B, m, bw + 1), np.float32)
+    Sb[:, :, 0] = 10.0 + rng.random((B, m))
+    for k in range(1, bw + 1):
+        Sb[:, k:, k] = rng.standard_normal((B, m - k)).astype(np.float32) * 0.5
+    return jnp.asarray(Sb)
+
+
+@pytest.fixture(scope="module")
+def band_problem():
+    B, m, bw = 5, 29, 4
+    Sb = _random_band_spd(B, m, bw)
+    rng = np.random.default_rng(1)
+    r = jnp.asarray(rng.standard_normal((B, m)).astype(np.float32))
+    return B, m, bw, Sb, r
+
+
+def test_cholesky_t_matches_scan_path(band_problem):
+    B, m, bw, Sb, r = band_problem
+    L_ref = bd.banded_cholesky(Sb, bw)
+    L_pal = jnp.transpose(
+        pb.banded_cholesky_t(jnp.transpose(Sb, (1, 2, 0)), bw), (2, 0, 1)
+    )
+    # Identical operation order — bit-equal, not just close.
+    np.testing.assert_array_equal(np.asarray(L_ref), np.asarray(L_pal))
+
+
+def test_refined_solve_t_matches_scan_path(band_problem):
+    B, m, bw, Sb, r = band_problem
+    L = bd.banded_cholesky(Sb, bw)
+    Lt = jnp.transpose(L, (1, 2, 0))
+    St = jnp.transpose(Sb, (1, 2, 0))
+
+    x0_ref = bd.banded_solve(L, r, bw)
+    x0_pal = pb.refined_banded_solve_t(Lt, St, r.T, bw, refine=0).T
+    np.testing.assert_allclose(np.asarray(x0_ref), np.asarray(x0_pal),
+                               rtol=0, atol=1e-6)
+
+    resid = r - bd.band_matvec(Sb, x0_ref, bw)
+    x1_ref = x0_ref + bd.banded_solve(L, resid, bw)
+    x1_pal = pb.refined_banded_solve_t(Lt, St, r.T, bw, refine=1).T
+    np.testing.assert_allclose(np.asarray(x1_ref), np.asarray(x1_pal),
+                               rtol=0, atol=1e-6)
+
+
+def test_lane_padding_is_benign():
+    """B not a multiple of LANE_BLOCK pads with identity rows; results for
+    the real homes are unchanged vs a padded-by-hand batch."""
+    B, m, bw = 3, 17, 2
+    Sb = _random_band_spd(B, m, bw, seed=2)
+    L_ref = bd.banded_cholesky(Sb, bw)
+    L_pal = jnp.transpose(
+        pb.banded_cholesky_t(jnp.transpose(Sb, (1, 2, 0)), bw), (2, 0, 1)
+    )
+    np.testing.assert_array_equal(np.asarray(L_ref), np.asarray(L_pal))
+    assert L_pal.shape == (B, m, bw + 1)
+
+
+def test_band_scatter_t_matches():
+    """Transposed scatter builds the same band content as the (B, m, bw+1)
+    layout on the real MPC Schur pattern."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_qp_parity import _assemble_real_step
+
+    from dragg_tpu.ops.admm import _schur_structure_for
+    from dragg_tpu.ops.qp import schur_contrib
+
+    qp, pat = _assemble_real_step(horizon_hours=4, n_homes=3)
+    ss = _schur_structure_for(pat)
+    plan = bd.plan_for(ss, pat.m)
+    assert plan is not None
+    rng = np.random.default_rng(3)
+    dinv = jnp.asarray(rng.random((3, pat.n)).astype(np.float32) + 0.5)
+    contrib = schur_contrib(ss, qp.vals, dinv)
+    Sb = bd.band_scatter(plan, contrib)
+    Sb_t = pb.band_scatter_t(plan, contrib)
+    np.testing.assert_array_equal(
+        np.asarray(Sb), np.asarray(jnp.transpose(Sb_t, (2, 0, 1)))
+    )
+
+
+def test_ipm_pallas_end_to_end_matches_xla():
+    """Full IPM solve with band_kernel='pallas' (interpret mode on CPU)
+    returns the same solution as the XLA band path on a real QP batch."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_qp_parity import _assemble_real_step
+
+    from dragg_tpu.ops.ipm import ipm_solve_qp
+
+    qp, pat = _assemble_real_step(horizon_hours=4, n_homes=4)
+    sol_x = ipm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                         iters=12, band_kernel="xla")
+    sol_p = ipm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                         iters=12, band_kernel="pallas")
+    np.testing.assert_allclose(np.asarray(sol_x.x), np.asarray(sol_p.x),
+                               rtol=0, atol=5e-4)
+    np.testing.assert_array_equal(np.asarray(sol_x.solved),
+                                  np.asarray(sol_p.solved))
+
+
+def test_admm_band_pallas_matches_xla():
+    """ADMM with solve_backend='band' + Pallas kernels matches the XLA band
+    path on a real QP batch (same iterations, same solution)."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_qp_parity import _assemble_real_step
+
+    from dragg_tpu.ops.admm import admm_solve_qp
+
+    qp, pat = _assemble_real_step(horizon_hours=4, n_homes=4)
+    kw = dict(iters=300, solve_backend="band", banded_factor=True)
+    sol_x = admm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                          band_kernel="xla", **kw)
+    sol_p = admm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                          band_kernel="pallas", **kw)
+    np.testing.assert_array_equal(np.asarray(sol_x.iters),
+                                  np.asarray(sol_p.iters))
+    np.testing.assert_allclose(np.asarray(sol_x.x), np.asarray(sol_p.x),
+                               rtol=0, atol=2e-4)
+    np.testing.assert_array_equal(np.asarray(sol_x.solved),
+                                  np.asarray(sol_p.solved))
