@@ -152,7 +152,14 @@ _DEFAULT: dict[str, Any] = {
             "prediction_horizon": 6,
             "sub_subhourly_steps": 6,
             "discount_factor": 0.92,
-            "solver": "admm",
+            # Default solver family (reference analog: the GLPK_MI/ECOS/
+            # GUROBI table, dragg/mpc_calc.py:141-145).  "ipm" — the batched
+            # Mehrotra predictor-corrector — is the measured winner at every
+            # batch size on both CPU (1.2-3.5x at 16-128 homes, ~4x at
+            # 256-1024, 7.1x at 2048) and TPU (21.7x at 10k homes; all
+            # measurements in docs/perf_notes.md); "admm" (warm-started
+            # splitting) remains available (docs/perf_notes.md).
+            "solver": "ipm",
         },
     },
     "rl": {

@@ -65,14 +65,7 @@ import numpy as np
 from jax import lax
 
 from dragg_tpu.ops import pallas_band
-from dragg_tpu.ops.banded import (
-    band_matvec,
-    band_scatter,
-    banded_cholesky,
-    banded_explicit_inverse,
-    banded_solve,
-    plan_for,
-)
+from dragg_tpu.ops.banded import banded_explicit_inverse, plan_for
 from dragg_tpu.ops.qp import (
     SparsePattern,
     build_schur_structure,
@@ -343,26 +336,10 @@ def _admm_impl(
         perm_ix = jnp.asarray(band_plan.perm)
         invp_ix = jnp.asarray(band_plan.inv)
         # Bind the kernel family once per trace (band_kernel is static):
-        # the pallas functions take/return the TRANSPOSED (m, bw+1, B)
-        # band storage, the XLA scans the (B, m, bw+1) layout.
-        if band_kernel == "pallas":
-            scatter_fn = lambda c: pallas_band.band_scatter_t(band_plan, c)
-            chol_fn = lambda Sb: pallas_band.banded_cholesky_t(Sb, band_plan.bw)
-
-            def band_solve_fn(Lb, Sb, rp, refine):
-                return jnp.swapaxes(pallas_band.refined_banded_solve_t(
-                    Lb, Sb, jnp.swapaxes(rp, 0, 1), band_plan.bw,
-                    refine=refine), 0, 1)
-        else:
-            scatter_fn = lambda c: band_scatter(band_plan, c)
-            chol_fn = lambda Sb: banded_cholesky(Sb, band_plan.bw)
-
-            def band_solve_fn(Lb, Sb, rp, refine):
-                v = banded_solve(Lb, rp, band_plan.bw)
-                for _ in range(refine):
-                    resid = rp - band_matvec(Sb, v, band_plan.bw)
-                    v = v + banded_solve(Lb, resid, band_plan.bw)
-                return v
+        # pallas uses TRANSPOSED (m, bw+1, B) band storage and one fused
+        # kernel per solve, xla the (B, m, bw+1) scan path.
+        scatter_fn, chol_fn, band_solve_fn = pallas_band.make_band_ops(
+            band_plan, band_kernel)
 
     def factor(rho_b):
         """Schur-complement factor of the equality-constrained x-update.

@@ -40,13 +40,7 @@ from dragg_tpu.ops.admm import (
     ruiz_equilibrate_sparse,
 )
 from dragg_tpu.ops import pallas_band
-from dragg_tpu.ops.banded import (
-    band_matvec,
-    band_scatter,
-    banded_cholesky,
-    banded_solve,
-    plan_for,
-)
+from dragg_tpu.ops.banded import plan_for
 from dragg_tpu.ops.qp import SparsePattern, schur_contrib
 
 _BIG = 1e20
@@ -147,29 +141,19 @@ def ipm_solve_qp(
 
     n_act = jnp.maximum(jnp.sum(fin_l, axis=1) + jnp.sum(fin_u, axis=1), 1)
 
-    use_pallas = band_kernel == "pallas"
+    # Shared pallas/xla dispatch (ops/pallas_band.make_band_ops): pallas =
+    # transposed (m, bw+1, B) storage + one fused kernel per refined solve,
+    # xla = (B, m, bw+1) scans.  Same recurrences either way.
+    scatter_fn, chol_fn, band_solve_fn = pallas_band.make_band_ops(
+        plan, band_kernel)
 
     def solve_kkt(Lb, Sb, theta_inv, r1, r2):
         """One reduced-KKT solve: dy from the band factor (with one
         refinement pass against the band S — f32 needs it at barrier
         conditioning), dx by back-substitution.
-        [Θ Âᵀ; Â 0][dx; dy] = [r1; r2].
-
-        With the Pallas backend, Lb/Sb are in TRANSPOSED (m, bw+1, B)
-        storage and the whole refined solve is one fused kernel
-        (dragg_tpu/ops/pallas_band.py); the XLA path runs it as 4 scans +
-        a matvec.  Same recurrences, same refinement count."""
+        [Θ Âᵀ; Â 0][dx; dy] = [r1; r2]."""
         rhs = mv(theta_inv * r1) - r2
-        rp = rhs[:, perm_ix]
-        if use_pallas:
-            dy_t = pallas_band.refined_banded_solve_t(
-                Lb, Sb, jnp.swapaxes(rp, 0, 1), bw, refine=1
-            )
-            dy = jnp.swapaxes(dy_t, 0, 1)[:, invp_ix]
-        else:
-            dy = banded_solve(Lb, rp, bw)
-            resid = rp - band_matvec(Sb, dy, bw)
-            dy = (dy + banded_solve(Lb, resid, bw))[:, invp_ix]
+        dy = band_solve_fn(Lb, Sb, rhs[:, perm_ix], 1)[:, invp_ix]
         dx = theta_inv * (r1 - mvt(dy))
         return dx, dy
 
@@ -197,14 +181,13 @@ def ipm_solve_qp(
         theta = jnp.where(frozen[:, None], 1.0, theta)  # benign factor input
         theta_inv = 1.0 / theta
         contrib = schur_contrib(schur, vals_s, theta_inv)
-        if use_pallas:
-            Sb = pallas_band.band_scatter_t(plan, contrib)   # (m, bw+1, B)
+        Sb = scatter_fn(contrib)
+        # Tikhonov the Schur diagonal (layout differs per kernel family).
+        if band_kernel == "pallas":                          # (m, bw+1, B)
             Sb = Sb.at[:, 0, :].add(1e-6 * jnp.max(Sb[:, 0, :], axis=0, keepdims=True))
-            Lb = pallas_band.banded_cholesky_t(Sb, bw)
-        else:
-            Sb = band_scatter(plan, contrib)                 # (B, m, bw+1)
+        else:                                                # (B, m, bw+1)
             Sb = Sb.at[:, :, 0].add(1e-6 * jnp.max(Sb[:, :, 0], axis=1, keepdims=True))
-            Lb = banded_cholesky(Sb, bw)
+        Lb = chol_fn(Sb)
 
         # Residuals.
         r_dual = -(reg_s * x + qs + mvt(y) - z_l + z_u)        # stationarity

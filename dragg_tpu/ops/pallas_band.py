@@ -211,6 +211,45 @@ def refined_banded_solve_t(Lb_t: jnp.ndarray, Sb_t: jnp.ndarray,
     return out[:, :B]
 
 
+# ------------------------------------------------------- shared dispatch
+def make_band_ops(plan, band_kernel: str):
+    """One source of truth for the pallas/xla band-kernel dispatch, shared
+    by the ADMM and IPM solvers.
+
+    Returns ``(scatter_fn, chol_fn, solve_fn)``:
+      scatter_fn(contrib)            → band storage
+      chol_fn(Sb)                    → band Cholesky factor (same layout)
+      solve_fn(Lb, Sb, rp, refine)   → S⁻¹ rp with ``refine`` iterative-
+                                       refinement passes; rp is (B, m) in
+                                       PERMUTED row order for both kernels
+    Under ``"pallas"`` the storage layout is the transposed (m, bw+1, B)
+    and the whole refined solve is one fused kernel; under ``"xla"`` it is
+    (B, m, bw+1) and the scan path runs 2(1+refine) scans + matvecs.
+    """
+    from dragg_tpu.ops import banded as bd
+
+    bw = plan.bw
+    if band_kernel == "pallas":
+        def solve_fn(Lb, Sb, rp, refine):
+            return jnp.swapaxes(refined_banded_solve_t(
+                Lb, Sb, jnp.swapaxes(rp, 0, 1), bw, refine=refine), 0, 1)
+
+        return (lambda c: band_scatter_t(plan, c),
+                lambda Sb: banded_cholesky_t(Sb, bw),
+                solve_fn)
+
+    def solve_fn(Lb, Sb, rp, refine):
+        v = bd.banded_solve(Lb, rp, bw)
+        for _ in range(refine):
+            resid = rp - bd.band_matvec(Sb, v, bw)
+            v = v + bd.banded_solve(Lb, resid, bw)
+        return v
+
+    return (lambda c: bd.band_scatter(plan, c),
+            lambda Sb: bd.banded_cholesky(Sb, bw),
+            solve_fn)
+
+
 # ----------------------------------------------------- transposed scatter
 def band_scatter_t(plan, contrib: jnp.ndarray) -> jnp.ndarray:
     """Schur entry values (B, n_s) → TRANSPOSED band storage (m, bw+1, B)
