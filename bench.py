@@ -46,6 +46,15 @@ PEAK_FLOPS = [
     ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
 ]
 
+# Peak HBM bandwidth per chip (bytes/s, public spec numbers).  The IPM's
+# band kernels have negligible matmul FLOPs — the meaningful utilization
+# metric for them is achieved HBM bandwidth, not MFU.
+PEAK_HBM_BW = [
+    ("v6", 1640e9), ("trillium", 1640e9),
+    ("v5p", 2765e9), ("v5e", 819e9), ("v5 lite", 819e9), ("v5", 2765e9),
+    ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+]
+
 
 def _log(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
@@ -235,8 +244,23 @@ def run_measured(args) -> dict:
             break
     if peak and solver_used == "admm":
         mfu = (flops_per_step * rate) / peak
+    hbm_util = bytes_per_step = None
     if solver_used != "admm":
         flops_per_step = None
+        # The IPM is bandwidth-bound: per iteration the fused band kernels
+        # stream the (B, m, bw+1) factor ~9 times (scatter write, Cholesky
+        # read+write, and 2 refined solves × [L fwd+bwd ×2 passes + band-S
+        # matvec] ≈ 10 passes counting rhs/solution vectors), plus the
+        # sparse A matvecs (~4 nnz/row over m rows, read ~6 times across
+        # predictor/corrector/residuals).  Loose analytic floor — reported
+        # as achieved-bandwidth fraction of the chip's HBM peak.
+        bw_band = 5  # bw+1 at the MPC pattern's RCM bandwidth of 4
+        bytes_iter = B * m * 4 * (9 * bw_band + 6 * 4 + 8)
+        bytes_per_step = mean_iters * bytes_iter
+        for key, val in PEAK_HBM_BW:
+            if key in str(device_kind).lower():
+                hbm_util = (bytes_per_step * rate) / val
+                break
 
     # Optional profiler trace for manual inspection (BENCH_TRACE_DIR=...).
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
@@ -265,6 +289,8 @@ def run_measured(args) -> dict:
         "phase_s_per_step": {k: round(v, 4) for k, v in phases.items()} if phases else None,
         "flops_per_step_est": flops_per_step,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "hbm_bytes_per_step_est": bytes_per_step,
+        "hbm_util": round(hbm_util, 4) if hbm_util is not None else None,
     }
 
 

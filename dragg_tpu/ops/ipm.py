@@ -144,7 +144,7 @@ def ipm_solve_qp(
     # Shared pallas/xla dispatch (ops/pallas_band.make_band_ops): pallas =
     # transposed (m, bw+1, B) storage + one fused kernel per refined solve,
     # xla = (B, m, bw+1) scans.  Same recurrences either way.
-    scatter_fn, chol_fn, band_solve_fn = pallas_band.make_band_ops(
+    scatter_fn, chol_fn, band_solve_fn, add_diag_fn = pallas_band.make_band_ops(
         plan, band_kernel)
 
     def solve_kkt(Lb, Sb, theta_inv, r1, r2):
@@ -181,12 +181,7 @@ def ipm_solve_qp(
         theta = jnp.where(frozen[:, None], 1.0, theta)  # benign factor input
         theta_inv = 1.0 / theta
         contrib = schur_contrib(schur, vals_s, theta_inv)
-        Sb = scatter_fn(contrib)
-        # Tikhonov the Schur diagonal (layout differs per kernel family).
-        if band_kernel == "pallas":                          # (m, bw+1, B)
-            Sb = Sb.at[:, 0, :].add(1e-6 * jnp.max(Sb[:, 0, :], axis=0, keepdims=True))
-        else:                                                # (B, m, bw+1)
-            Sb = Sb.at[:, :, 0].add(1e-6 * jnp.max(Sb[:, :, 0], axis=1, keepdims=True))
+        Sb = add_diag_fn(scatter_fn(contrib), 1e-6)  # Tikhonov the diagonal
         Lb = chol_fn(Sb)
 
         # Residuals.

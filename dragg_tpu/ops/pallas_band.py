@@ -216,12 +216,14 @@ def make_band_ops(plan, band_kernel: str):
     """One source of truth for the pallas/xla band-kernel dispatch, shared
     by the ADMM and IPM solvers.
 
-    Returns ``(scatter_fn, chol_fn, solve_fn)``:
+    Returns ``(scatter_fn, chol_fn, solve_fn, add_diag_fn)``:
       scatter_fn(contrib)            → band storage
       chol_fn(Sb)                    → band Cholesky factor (same layout)
       solve_fn(Lb, Sb, rp, refine)   → S⁻¹ rp with ``refine`` iterative-
                                        refinement passes; rp is (B, m) in
                                        PERMUTED row order for both kernels
+      add_diag_fn(Sb, rel)           → Sb with ``rel × max-diag`` Tikhonov
+                                       added per home (layout-aware)
     Under ``"pallas"`` the storage layout is the transposed (m, bw+1, B)
     and the whole refined solve is one fused kernel; under ``"xla"`` it is
     (B, m, bw+1) and the scan path runs 2(1+refine) scans + matvecs.
@@ -234,9 +236,13 @@ def make_band_ops(plan, band_kernel: str):
             return jnp.swapaxes(refined_banded_solve_t(
                 Lb, Sb, jnp.swapaxes(rp, 0, 1), bw, refine=refine), 0, 1)
 
+        def add_diag_fn(Sb, rel):
+            return Sb.at[:, 0, :].add(
+                rel * jnp.max(Sb[:, 0, :], axis=0, keepdims=True))
+
         return (lambda c: band_scatter_t(plan, c),
                 lambda Sb: banded_cholesky_t(Sb, bw),
-                solve_fn)
+                solve_fn, add_diag_fn)
 
     def solve_fn(Lb, Sb, rp, refine):
         v = bd.banded_solve(Lb, rp, bw)
@@ -245,9 +251,13 @@ def make_band_ops(plan, band_kernel: str):
             v = v + bd.banded_solve(Lb, resid, bw)
         return v
 
+    def add_diag_fn(Sb, rel):
+        return Sb.at[:, :, 0].add(
+            rel * jnp.max(Sb[:, :, 0], axis=1, keepdims=True))
+
     return (lambda c: bd.band_scatter(plan, c),
             lambda Sb: bd.banded_cholesky(Sb, bw),
-            solve_fn)
+            solve_fn, add_diag_fn)
 
 
 # ----------------------------------------------------- transposed scatter

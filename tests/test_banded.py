@@ -143,6 +143,8 @@ def test_band_backend_engine_chunk(tiny_config):
     from dragg_tpu.homes import build_home_batch, create_homes
 
     cfg = copy.deepcopy(tiny_config)
+    cfg["home"]["hems"]["solver"] = "admm"  # the band solve BACKEND is an
+    # ADMM knob; under the ipm default this test would never exercise it
     cfg["tpu"]["admm_solve_backend"] = "band"
     env = load_environment(cfg, data_dir=None)
     dt = int(cfg["agg"]["subhourly_steps"])

@@ -129,11 +129,11 @@ def test_sharded_engine_all_leaves_ipm(tiny_config):
     import copy
 
     cfg = copy.deepcopy(tiny_config)
-    assert cfg["home"]["hems"].get("solver", "ipm") == "ipm"
     cfg, env, batch = _setup(cfg)
     n = batch.n_homes
 
     ref_engine = make_engine(batch, env, cfg, 0)
+    assert ref_engine.params.solver == "ipm"  # premise: fixed-iteration solver
     sh_engine = make_sharded_engine(batch, env, cfg, 0, mesh=make_mesh(8))
 
     rps = np.zeros((3, ref_engine.params.horizon), dtype=np.float32)

@@ -1,0 +1,86 @@
+"""Microbenchmark: Pallas band kernels vs the XLA scan path.
+
+Times the two band operations that dominate an IPM iteration (Cholesky
+factor and the refined solve) at MPC-realistic shapes on whatever backend
+is up, printing one JSON line.  Engine-step comparisons come from
+bench.py's solver race / phase timers.  This is the measurement behind the
+band_kernel='auto' policy (docs/perf_notes.md).
+
+Usage: python tools/bench_band_kernel.py [--homes 10000] [--horizon 24]
+       [--iters 30]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--homes", type=int, default=10_000)
+    ap.add_argument("--horizon", type=int, default=24)
+    ap.add_argument("--iters", type=int, default=30, help="timing repetitions")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from dragg_tpu.ops import banded as bd
+    from dragg_tpu.ops import pallas_band as pb
+
+    dev = jax.devices()[0]
+    B, bw = args.homes, 4
+    m = 3 * args.horizon + 5  # MPC Schur size at H decision steps
+    rng = np.random.default_rng(0)
+    Sb = np.zeros((B, m, bw + 1), np.float32)
+    Sb[:, :, 0] = 10.0 + rng.random((B, m))
+    for k in range(1, bw + 1):
+        Sb[:, k:, k] = rng.standard_normal((B, m - k)).astype(np.float32) * 0.5
+    Sb = jax.device_put(jnp.asarray(Sb))
+    Sb_t = jnp.transpose(Sb, (1, 2, 0))
+    r = jax.device_put(jnp.asarray(rng.standard_normal((B, m)).astype(np.float32)))
+
+    chol_x = jax.jit(lambda s: bd.banded_cholesky(s, bw))
+    chol_p = jax.jit(lambda s: pb.banded_cholesky_t(s, bw))
+
+    def solve_x(L, S, rr):
+        v = bd.banded_solve(L, rr, bw)
+        resid = rr - bd.band_matvec(S, v, bw)
+        return v + bd.banded_solve(L, resid, bw)
+
+    solve_x = jax.jit(solve_x)
+    solve_p = jax.jit(lambda L, S, rr: pb.refined_banded_solve_t(L, S, rr, bw, refine=1))
+
+    def timeit(fn, *a):
+        out = jax.block_until_ready(fn(*a))  # compile
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(*a)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / args.iters
+
+    Lx = jax.block_until_ready(chol_x(Sb))
+    Lp = jax.block_until_ready(chol_p(Sb_t))
+    res = {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "homes": B, "m": m, "bw": bw,
+        "chol_xla_s": timeit(chol_x, Sb),
+        "chol_pallas_s": timeit(chol_p, Sb_t),
+        "solve_xla_s": timeit(solve_x, Lx, Sb, r),
+        "solve_pallas_s": timeit(solve_p, Lp, Sb_t, jnp.swapaxes(r, 0, 1)),
+    }
+    res["chol_speedup"] = round(res["chol_xla_s"] / res["chol_pallas_s"], 2)
+    res["solve_speedup"] = round(res["solve_xla_s"] / res["solve_pallas_s"], 2)
+    print(json.dumps({k: (round(v, 6) if isinstance(v, float) else v)
+                      for k, v in res.items()}))
+
+
+if __name__ == "__main__":
+    main()
