@@ -192,6 +192,9 @@ _DEFAULT: dict[str, Any] = {
         "admm_solve_backend": "auto",  # in-loop KKT solve: "dense_inv" |
                                        # "band" (no (B,m,m) array — the
                                        # 100k-home memory regime) | "auto"
+        "ipm_warm_start": False,  # seed the IPM from the receding-horizon
+                                  # shift (interior-safeguarded; see
+                                  # docs/perf_notes.md for the measurement)
         "ipm_iters": 0,  # Mehrotra iteration count (hems.solver="ipm");
                          # 0 = horizon-aware default: 16 + (decision steps)/2
         "band_kernel": "auto",  # band factor/solve impl: "pallas" (fused TPU

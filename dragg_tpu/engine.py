@@ -149,7 +149,8 @@ class EngineParams(NamedTuple):
     admm_anderson: int  # Anderson-acceleration history depth (0 = off)
     admm_banded_factor: bool  # banded-Cholesky Schur factorization
     admm_solve_backend: str  # "auto" | "dense_inv" | "band" in-loop solve
-    ipm_iters: int      # fixed Mehrotra iteration count (solver="ipm")
+    ipm_iters: int      # Mehrotra iteration cap (solver="ipm")
+    ipm_warm: bool      # seed the IPM from the receding-horizon shift
     band_kernel: str    # "auto" | "pallas" | "xla" band factor/solve impl
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
@@ -348,8 +349,10 @@ class Engine:
         refinement (SURVEY.md §7 step 3).
 
         ``solver="ipm"``: the Mehrotra interior point (ops/ipm.py) —
-        ~20 iterations cold, no warm starts or cross-step factor cache
-        (both are no-ops for it; the carry passes through untouched).
+        converges in ~15-30 iterations with an all-frozen early exit; no
+        cross-step factor cache (the carry passes through untouched).
+        Warm starts are opt-in (``tpu.ipm_warm_start`` → x0 from the
+        receding-horizon shift) and measured neutral — docs/perf_notes.md.
         """
         p = self.params
         if p.solver == "ipm":
@@ -360,6 +363,7 @@ class Engine:
                 qp.q, reg=p.admm_reg, iters=p.ipm_iters,
                 eps_abs=p.admm_eps, eps_rel=p.admm_eps,
                 band_kernel=self._band_kernel,
+                x0=state.warm_x if p.ipm_warm else None,
             )
             return sol, factor
         return admm_solve_qp_cached(
@@ -577,6 +581,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         # H=48: 25 iters → 95.3% solve rate, 35 → 97.9%, 45 → 99.0%);
         # 0 = horizon-aware default, explicit values override.
         ipm_iters=int(tpu_cfg.get("ipm_iters", 0)) or 16 + horizon // 2,
+        ipm_warm=bool(tpu_cfg.get("ipm_warm_start", False)),
         band_kernel=str(tpu_cfg.get("band_kernel", "auto")),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),

@@ -61,6 +61,8 @@ def ipm_solve_qp(
     eps_rel: float = 1e-4,
     ruiz_iters: int = 10,
     band_kernel: str = "xla",
+    x0: jnp.ndarray | None = None,
+    warm_mu: float = 1e-2,
 ) -> ADMMSolution:
     """Solve the batch; returns the ADMM-compatible solution record (y_box
     carries z_u − z_l; rho is 1s — kept for interface parity)."""
@@ -130,13 +132,35 @@ def ipm_solve_qp(
     def mvt(y):
         return jnp.sum(vp_c * y[:, col_rows], axis=2)
 
-    # --- Starting point: mid-box primal, unit slacks/duals.
-    x = jnp.where(fin_l & fin_u, 0.5 * (ls + us),
-                  jnp.where(fin_l, ls + 1.0, jnp.where(fin_u, us - 1.0, 0.0)))
-    s_l = jnp.where(fin_l, jnp.maximum(x - ls, 1.0), 1.0)
-    s_u = jnp.where(fin_u, jnp.maximum(us - x, 1.0), 1.0)
-    z_l = jnp.where(fin_l, jnp.ones_like(x), 0.0)
-    z_u = jnp.where(fin_u, jnp.ones_like(x), 0.0)
+    # --- Starting point: mid-box primal, unit slacks/duals — or, when a
+    # warm start is given (the engine's receding-horizon shift of the
+    # previous step's plan), the warm primal pushed a safe distance into
+    # the strict interior with μ-scaled duals.  Classic IPM warm-start
+    # jamming is avoided by the interior margin (min 1 % of the box width)
+    # and by NOT warm-starting the duals at their near-complementary
+    # values: z = warm_mu/s keeps the first barrier steps well-centered.
+    if x0 is not None:
+        xw = jnp.where(fixed, 0.0, x0 / d)  # scaled; eliminated vars at 0
+        width = jnp.where(fin_l & fin_u, us - ls, 2.0)
+        margin = jnp.maximum(0.01 * width, 1e-3)
+        x = jnp.clip(xw,
+                     jnp.where(fin_l, ls + margin, -_BIG),
+                     jnp.where(fin_u, us - margin, _BIG))
+        # Floor the slacks: a box narrower than 2×margin makes the clip
+        # bounds cross (lower > upper), so x − ls can come out negative —
+        # a negative slack flips the barrier signs and the ratio test.
+        # The r_sl/r_su residuals absorb the resulting x/s inconsistency.
+        s_l = jnp.where(fin_l, jnp.maximum(x - ls, 1e-4), 1.0)
+        s_u = jnp.where(fin_u, jnp.maximum(us - x, 1e-4), 1.0)
+        z_l = jnp.where(fin_l, warm_mu / jnp.maximum(s_l, 1e-3), 0.0)
+        z_u = jnp.where(fin_u, warm_mu / jnp.maximum(s_u, 1e-3), 0.0)
+    else:
+        x = jnp.where(fin_l & fin_u, 0.5 * (ls + us),
+                      jnp.where(fin_l, ls + 1.0, jnp.where(fin_u, us - 1.0, 0.0)))
+        s_l = jnp.where(fin_l, jnp.maximum(x - ls, 1.0), 1.0)
+        s_u = jnp.where(fin_u, jnp.maximum(us - x, 1.0), 1.0)
+        z_l = jnp.where(fin_l, jnp.ones_like(x), 0.0)
+        z_u = jnp.where(fin_u, jnp.ones_like(x), 0.0)
     y = jnp.zeros((B, m), dtype)
 
     n_act = jnp.maximum(jnp.sum(fin_l, axis=1) + jnp.sum(fin_u, axis=1), 1)
@@ -167,8 +191,8 @@ def ipm_solve_qp(
         gap_u = gap / jnp.maximum(jnp.abs(jnp.sum(qs * x, axis=1)), 1.0)
         return (rp <= eps_abs) & (rd <= 10 * eps_abs) & (gap_u <= jnp.maximum(eps_rel, 1e-7))
 
-    def body(_, carry):
-        x, y, s_l, s_u, z_l, z_u = carry
+    def body(carry):
+        i, _, x, y, s_l, s_u, z_l, z_u = carry
         # Lockstep freeze: once a home converges it stops iterating — letting
         # it keep driving mu toward 0 degenerates Theta (z/s spans ~1e12)
         # and NaNs the f32 band factor while slower homes still work.
@@ -257,10 +281,19 @@ def ipm_solve_qp(
         s_u = jnp.where(fin_ok, s_u_n, s_u)
         z_l = jnp.where(fin_ok, z_l_n, z_l)
         z_u = jnp.where(fin_ok, z_u_n, z_u)
-        return x, y, s_l, s_u, z_l, z_u
+        return i + 1, jnp.all(frozen), x, y, s_l, s_u, z_l, z_u
 
-    x, y, s_l, s_u, z_l, z_u = lax.fori_loop(
-        0, iters, body, (x, y, s_l, s_u, z_l, z_u)
+    # Early exit once every home is frozen: frozen homes take zero-length
+    # steps (a_p = a_d = 0), so stopping at that point is OUTPUT-IDENTICAL
+    # to running out the fixed budget — warm steady-state batches converge
+    # well before the horizon-aware cap and skip the dead iterations.
+    # ``frozen`` can only grow: a frozen home does not move, so it stays
+    # converged.  (all_frozen lags one iteration — it is computed from the
+    # PRE-step iterate — which only costs one extra sweep, not correctness.)
+    i_done, _, x, y, s_l, s_u, z_l, z_u = lax.while_loop(
+        lambda c: (c[0] < iters) & ~c[1],
+        body,
+        (jnp.asarray(0), jnp.asarray(False), x, y, s_l, s_u, z_l, z_u),
     )
 
     # --- Final residuals in UNSCALED units (ADMM-convention norms).
@@ -286,6 +319,6 @@ def ipm_solve_qp(
         r_dual=r_dual,
         solved=ok,
         infeasible=jnp.zeros((B,), bool),
-        iters=jnp.asarray(iters),
+        iters=i_done,
         rho=jnp.ones((B,), dtype),
     )
