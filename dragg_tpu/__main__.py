@@ -30,6 +30,18 @@ def build_parser() -> argparse.ArgumentParser:
     ref.add_argument("--home", default=None, help="sample home name for per-home plots")
     ref.add_argument("--no-save", action="store_true", help="don't write PNGs")
 
+    sweep = sub.add_parser(
+        "sweep",
+        help="run a prediction-horizon sweep and compare the runs "
+             "(the reference paper's horizon study and main.py's "
+             "commented-out parametric workflow)")
+    sweep.add_argument("--horizons", default="2,4,8",
+                       help="comma-separated prediction horizons (hours)")
+    sweep.add_argument("--config", default=None)
+    sweep.add_argument("--data-dir", default=None)
+    sweep.add_argument("--outputs-dir", default="outputs")
+    sweep.add_argument("--no-figures", action="store_true")
+
     sub.add_parser("bench", help="run the benchmark harness (prints one JSON line)")
 
     dash = sub.add_parser("dashboard", help="serve the results dashboard over HTTP")
@@ -38,6 +50,61 @@ def build_parser() -> argparse.ArgumentParser:
     dash.add_argument("--port", type=int, default=8050)
     dash.add_argument("--host", default="127.0.0.1")
     return p
+
+
+def run_sweep(args) -> int:
+    """Prediction-horizon sweep: one full run per horizon, then the
+    parametric comparison over all of them.
+
+    Reproduces the reference paper's horizon study (horizons 1-16 h,
+    solve-time-vs-cost tradeoff — BASELINE.md) through the workflow the
+    reference ships commented out in main.py:9-19 (parameter dicts fed to
+    Reformat).  Prints a per-horizon summary table and, unless
+    --no-figures, saves the parametric comparison figures.
+    """
+    import copy
+
+    from dragg_tpu.aggregator import Aggregator
+    from dragg_tpu.config import load_config
+    from dragg_tpu.reformat import Reformat
+
+    try:
+        horizons = sorted({int(h) for h in str(args.horizons).split(",") if h.strip()})
+    except ValueError:
+        print(f"sweep: --horizons must be comma-separated integers, got "
+              f"{args.horizons!r}", file=sys.stderr)
+        return 1
+    if not horizons or min(horizons) < 1:
+        print("sweep: need at least one horizon >= 1", file=sys.stderr)
+        return 1
+    base_cfg = load_config(args.config)
+    for h in horizons:
+        cfg = copy.deepcopy(base_cfg)
+        cfg["home"]["hems"]["prediction_horizon"] = h
+        Aggregator(cfg, data_dir=args.data_dir,
+                   outputs_dir=args.outputs_dir).run()
+
+    # Reformat discovery permutes over value SETS — extend the horizon axis
+    # to cover the sweep and re-discover (dragg/reformat.py:86-99 pattern).
+    r = Reformat(config=base_cfg, outputs_dir=args.outputs_dir)
+    r.mpc_params["mpc_prediction_horizons"] = set(horizons)
+    r.mpc_folders = r.set_mpc_folders()
+    r.files = r.set_files()
+
+    rows = []
+    for file in r.files:
+        s = r._load(file["results"])["Summary"]  # warms the figure cache too
+        rows.append((s.get("horizon"), s.get("solve_time"),
+                     s.get("p_max_aggregate"), file["case"]))
+    print(f"{'horizon':>8} {'solve_time_s':>13} {'p_max_kW':>10}  case")
+    for h, st, pmax, case in sorted(rows, key=lambda x: (x[0] or 0)):
+        print(f"{h!s:>8} {st:13.2f} {pmax:10.2f}  {case}")
+
+    if not args.no_figures:
+        r.save_images([("parametric", r.plot_parametric()),
+                       ("typical_day", r.plot_typ_day()),
+                       ("max_and_12hravg", r.plot_max_and_12hravg())])
+    return 0
 
 
 def main(argv=None) -> int:
@@ -65,6 +132,8 @@ def main(argv=None) -> int:
             r.sample_home = args.home
         r.main(save=not args.no_save)
         return 0
+    if args.cmd == "sweep":
+        return run_sweep(args)
     if args.cmd == "dashboard":
         from dragg_tpu.dashboard import serve
 

@@ -23,7 +23,7 @@ from datetime import timedelta
 
 import numpy as np
 
-from dragg_tpu.config import load_config
+from dragg_tpu.config import configured_solver, load_config
 from dragg_tpu.data import EnvironmentData, load_environment, load_waterdraw_profiles, parse_dt
 from dragg_tpu.engine import Engine, StepOutputs, make_engine
 from dragg_tpu.homes import build_home_batch, check_home_configs, create_homes
@@ -547,7 +547,7 @@ class Aggregator:
                 cfg["home"]["hems"]["prediction_horizon"],
                 self.dt,
                 int(cfg["home"]["hems"]["sub_subhourly_steps"]),
-                cfg["home"]["hems"].get("solver", "admm"),
+                configured_solver(cfg),
             ),
             f"version-{self.version}",
         )

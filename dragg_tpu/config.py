@@ -58,6 +58,17 @@ def validate_config(data: dict) -> dict:
     return data
 
 
+def configured_solver(config: dict) -> str:
+    """The raw configured solver name, with the framework default applied.
+
+    Single source of truth for every consumer of ``home.hems.solver`` —
+    run-directory naming (utils.layout), Reformat discovery, home metadata,
+    and the engine (which additionally maps reference solver names onto the
+    batched families) — so a config that omits the key gets ONE consistent
+    identity everywhere."""
+    return str(config["home"]["hems"].get("solver", "ipm"))
+
+
 def load_config(path: str | None = None) -> dict:
     """Load and validate a TOML config.
 

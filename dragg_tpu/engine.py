@@ -556,8 +556,23 @@ def engine_params(config, start_index: int) -> EngineParams:
     dt = int(config["agg"]["subhourly_steps"])
     tpu_cfg = config.get("tpu", {})
     horizon = max(1, int(hems["prediction_horizon"]) * dt)
+    # Reference solver names (the GLPK_MI/ECOS/GUROBI table,
+    # dragg/mpc_calc.py:141-145, and the shipped config.toml default
+    # "GLPK_MI") map onto the batched families so an unmodified reference
+    # config runs: the MILP semantics are covered by the relaxation +
+    # rounding contract (ops/qp.py), and ECOS — itself an interior-point
+    # code — maps to the IPM.
+    from dragg_tpu.config import configured_solver
+
+    solver = configured_solver(config).lower()
+    if solver in ("glpk_mi", "glpk", "gurobi", "ecos"):
+        solver = "ipm"
+    if solver not in ("admm", "ipm"):
+        raise ValueError(
+            f"home.hems.solver must be ipm|admm (or a reference solver name "
+            f"GLPK_MI|ECOS|GUROBI), got {hems.get('solver')!r}")
     return EngineParams(
-        solver=str(hems.get("solver", "admm")),
+        solver=solver,
         horizon=horizon,
         dt=dt,
         s=float(max(1, int(hems["sub_subhourly_steps"]))),

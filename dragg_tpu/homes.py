@@ -26,6 +26,7 @@ from typing import Any, NamedTuple
 import numpy as np
 import pandas as pd
 
+from dragg_tpu.config import configured_solver
 from dragg_tpu.names_data import FIRST_NAMES
 
 HOME_TYPES = ("pv_battery", "pv_only", "battery_only", "base")
@@ -127,7 +128,7 @@ def create_homes(
         "horizon": config["home"]["hems"]["prediction_horizon"],
         "hourly_agg_steps": dt,
         "sub_subhourly_steps": config["home"]["hems"]["sub_subhourly_steps"],
-        "solver": config["home"]["hems"].get("solver", "admm"),
+        "solver": configured_solver(config),
         "discount_factor": config["home"]["hems"]["discount_factor"],
     }
 

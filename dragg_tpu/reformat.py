@@ -25,7 +25,7 @@ from datetime import datetime, timedelta
 
 import numpy as np
 
-from dragg_tpu.config import load_config
+from dragg_tpu.config import configured_solver, load_config
 from dragg_tpu.logger import Logger
 
 
@@ -141,7 +141,7 @@ class Reformat:
             "mpc_hourly_steps": {cfg["home"]["hems"]["sub_subhourly_steps"]},
             "check_type": {cfg["simulation"]["check_type"]},
             "agg_interval": {cfg["agg"]["subhourly_steps"]},
-            "solver": {cfg["home"]["hems"].get("solver", "admm")},
+            "solver": {configured_solver(cfg)},
         }
 
     def _load(self, path: str) -> dict:

@@ -194,3 +194,23 @@ def test_long_horizon_season_gate(tmp_path):
     assert solved.mean() >= 0.8, f"H=48 solve rate {solved.mean():.2f}"
     # January: heating, never cooling.
     assert float(np.asarray(out.hvac_cool_on).max()) == 0.0
+
+
+def test_reference_solver_names_map(tiny_config):
+    """An unmodified reference config (solver='GLPK_MI', config.toml:64)
+    builds an engine on the batched IPM; unknown names raise."""
+    import copy
+
+    from dragg_tpu.engine import engine_params
+
+    cfg = copy.deepcopy(tiny_config)
+    for name in ("GLPK_MI", "ECOS", "GUROBI"):
+        cfg["home"]["hems"]["solver"] = name
+        assert engine_params(cfg, 0).solver == "ipm", name
+    cfg["home"]["hems"]["solver"] = "ADMM"
+    assert engine_params(cfg, 0).solver == "admm"
+    cfg["home"]["hems"]["solver"] = "simplex"
+    import pytest
+
+    with pytest.raises(ValueError, match="solver"):
+        engine_params(cfg, 0)

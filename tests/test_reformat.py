@@ -279,3 +279,26 @@ def test_cli_parser_dashboard():
 
     args = build_parser().parse_args(["dashboard", "--port", "9000"])
     assert args.cmd == "dashboard" and args.port == 9000
+
+
+def test_cli_sweep(tmp_path):
+    """Horizon sweep: one run per horizon, parametric comparison discovers
+    all of them (the reference paper's horizon study workflow)."""
+    from dragg_tpu.__main__ import main
+
+    cfg_path = str(tmp_path / "config.toml")
+    with open(cfg_path, "w") as f:
+        f.write(_CLI_TOML)
+    out = str(tmp_path / "outputs")
+    assert main(["sweep", "--horizons", "2,3", "--config", cfg_path,
+                 "--outputs-dir", out, "--no-figures"]) == 0
+    # Both horizon runs exist on disk under their own run dirs.
+    import glob
+
+    runs = glob.glob(os.path.join(out, "*", "*horizon_*", "version-*",
+                                  "baseline", "results.json"))
+    horizons = set()
+    for p in runs:
+        with open(p) as f:
+            horizons.add(json.load(f)["Summary"]["horizon"])
+    assert horizons == {2, 3}
