@@ -99,3 +99,31 @@ class TestData:
         assert env.start_index(start) == 0
         with pytest.raises(ValueError):
             env.check_coverage(start, parse_dt("2099-01-01 00"), 4)
+
+
+def test_config_reference_doc_covers_all_keys():
+    """docs/config.md documents every leaf key in default_config — a new
+    knob without documentation fails here."""
+    import os
+
+    from dragg_tpu.config import default_config
+
+    doc_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "config.md")
+    with open(doc_path) as f:
+        doc = f.read()
+
+    def leaves(d, pre=""):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                yield from leaves(v, pre + k + ".")
+            else:
+                yield pre + k, k
+
+    # Distribution keys are documented as a family, not per key.
+    families = ("home.hvac.", "home.wh.", "home.battery.", "home.pv.")
+    missing = [
+        path for path, key in leaves(default_config())
+        if not path.startswith(families) and f"`{key}`" not in doc
+    ]
+    assert not missing, f"undocumented config keys: {missing}"
