@@ -199,24 +199,22 @@ class Engine:
             n_shards=getattr(self, "_mesh_shards", 1),
         )
         # Resolve the "auto" band kernel HERE too: Pallas only when it
-        # compiles natively (TPU backend) AND the engine is single-shard —
-        # pallas_call inside a pjit-sharded program would need shard_map
-        # to partition, which the sharded path doesn't do (it stays on the
-        # XLA scans, which partition trivially).
+        # compiles natively (TPU backend).  On a sharded engine the pallas
+        # kernels run under shard_map over the homes axis (make_band_ops),
+        # so the mesh is no obstacle — it is threaded to the solvers below.
         from dragg_tpu.ops import pallas_band
 
         kern = params.band_kernel
         if kern not in ("auto", "pallas", "xla"):
             raise ValueError(f"tpu.band_kernel must be auto|pallas|xla, got {kern!r}")
-        if kern == "pallas" and getattr(self, "_mesh_shards", 1) > 1:
-            raise ValueError(
-                "tpu.band_kernel='pallas' is single-shard only (pallas_call "
-                "does not partition under the sharded engine without "
-                "shard_map); use 'auto' or 'xla' on a mesh")
         if kern == "auto":
-            kern = ("pallas" if pallas_band.available()
-                    and getattr(self, "_mesh_shards", 1) == 1 else "xla")
+            kern = "pallas" if pallas_band.available() else "xla"
         self._band_kernel = kern
+        # ShardedEngine sets these before super().__init__; the base engine
+        # runs unsharded.
+        self._solver_mesh = getattr(self, "mesh", None) \
+            if getattr(self, "_mesh_shards", 1) > 1 else None
+        self._solver_mesh_axis = getattr(self, "axis_name", "homes")
         self._step_fn = jax.jit(self._step)
         self._chunk_fn = jax.jit(self._chunk)
 
@@ -363,6 +361,7 @@ class Engine:
                 qp.q, reg=p.admm_reg, iters=p.ipm_iters,
                 eps_abs=p.admm_eps, eps_rel=p.admm_eps,
                 band_kernel=self._band_kernel,
+                mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
                 x0=state.warm_x if p.ipm_warm else None,
             )
             return sol, factor
@@ -381,6 +380,7 @@ class Engine:
             banded_factor=p.admm_banded_factor,
             solve_backend=self._solve_backend,
             band_kernel=self._band_kernel,
+            mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
             x0=state.warm_x, y_box0=state.warm_y_box,
             rho0=state.warm_rho,
         )

@@ -249,6 +249,8 @@ def _admm_impl(
                                # factor/solve (ops/pallas_band.py) — the
                                # factor carry then holds TRANSPOSED
                                # (m, bw+1, B) band storage; "xla": scan path
+    mesh=None,                 # sharded engines: shard_map the pallas
+    mesh_axis: str = "homes",  # kernels over this mesh axis
     anderson: int = 0,       # Anderson-acceleration history depth (0 = off).
                              # Type-II AA applied once per check window on
                              # the (z, y) pair — the window map T^check_every
@@ -339,7 +341,7 @@ def _admm_impl(
         # pallas uses TRANSPOSED (m, bw+1, B) band storage and one fused
         # kernel per solve, xla the (B, m, bw+1) scan path.
         scatter_fn, chol_fn, band_solve_fn, _ = pallas_band.make_band_ops(
-            band_plan, band_kernel)
+            band_plan, band_kernel, mesh=mesh, mesh_axis=mesh_axis)
 
     def factor(rho_b):
         """Schur-complement factor of the equality-constrained x-update.
@@ -629,7 +631,7 @@ def _admm_impl(
 
 _STATIC = ("pat", "iters", "check_every", "ruiz_iters", "adaptive_rho",
            "rho_update_every", "patience", "matvec_dtype", "refine", "anderson",
-           "banded_factor", "solve_backend", "band_kernel")
+           "banded_factor", "solve_backend", "band_kernel", "mesh", "mesh_axis")
 
 
 @partial(jax.jit, static_argnames=_STATIC)

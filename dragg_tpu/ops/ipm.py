@@ -46,7 +46,8 @@ from dragg_tpu.ops.qp import SparsePattern, schur_contrib
 _BIG = 1e20
 
 
-@partial(jax.jit, static_argnames=("pat", "iters", "ruiz_iters", "band_kernel"))
+@partial(jax.jit, static_argnames=("pat", "iters", "ruiz_iters", "band_kernel",
+                                   "mesh", "mesh_axis"))
 def ipm_solve_qp(
     pat: SparsePattern,
     vals: jnp.ndarray,      # (B, nnz) A values
@@ -61,6 +62,8 @@ def ipm_solve_qp(
     eps_rel: float = 1e-4,
     ruiz_iters: int = 10,
     band_kernel: str = "xla",
+    mesh=None,
+    mesh_axis: str = "homes",
     x0: jnp.ndarray | None = None,
     warm_mu: float = 1e-2,
 ) -> ADMMSolution:
@@ -169,7 +172,7 @@ def ipm_solve_qp(
     # transposed (m, bw+1, B) storage + one fused kernel per refined solve,
     # xla = (B, m, bw+1) scans.  Same recurrences either way.
     scatter_fn, chol_fn, band_solve_fn, add_diag_fn = pallas_band.make_band_ops(
-        plan, band_kernel)
+        plan, band_kernel, mesh=mesh, mesh_axis=mesh_axis)
 
     def solve_kkt(Lb, Sb, theta_inv, r1, r2):
         """One reduced-KKT solve: dy from the band factor (with one

@@ -212,9 +212,15 @@ def refined_banded_solve_t(Lb_t: jnp.ndarray, Sb_t: jnp.ndarray,
 
 
 # ------------------------------------------------------- shared dispatch
-def make_band_ops(plan, band_kernel: str):
+def make_band_ops(plan, band_kernel: str, mesh=None, mesh_axis: str = "homes"):
     """One source of truth for the pallas/xla band-kernel dispatch, shared
     by the ADMM and IPM solvers.
+
+    With ``mesh`` set (the sharded engine), the pallas kernels are wrapped
+    in ``shard_map`` over the home axis: each device runs the kernel on its
+    local shard — the band operations are embarrassingly parallel over
+    homes, so no collectives are needed.  The XLA scan path needs no
+    wrapping (it partitions under SPMD propagation).
 
     Returns ``(scatter_fn, chol_fn, solve_fn, add_diag_fn)``:
       scatter_fn(contrib)            → band storage
@@ -232,6 +238,9 @@ def make_band_ops(plan, band_kernel: str):
 
     bw = plan.bw
     if band_kernel == "pallas":
+        def chol_fn(Sb):
+            return banded_cholesky_t(Sb, bw)
+
         def solve_fn(Lb, Sb, rp, refine):
             return jnp.swapaxes(refined_banded_solve_t(
                 Lb, Sb, jnp.swapaxes(rp, 0, 1), bw, refine=refine), 0, 1)
@@ -240,9 +249,30 @@ def make_band_ops(plan, band_kernel: str):
             return Sb.at[:, 0, :].add(
                 rel * jnp.max(Sb[:, 0, :], axis=0, keepdims=True))
 
+        if mesh is not None:
+            from functools import partial
+
+            from jax.sharding import PartitionSpec as P
+
+            shard_map = partial(jax.shard_map, mesh=mesh, check_vma=False)
+
+            band_s = P(None, None, mesh_axis)   # (m, bw+1, B) — homes last
+            vec_s = P(mesh_axis, None)          # (B, m)
+            # check_vma=False: pallas_call outputs carry no varying-mesh-
+            # axes annotation; the maps are per-shard elementwise over
+            # homes, so replication checking has nothing to verify.
+            chol_fn = shard_map(chol_fn, in_specs=(band_s,),
+                                out_specs=band_s)
+            _solve = solve_fn
+
+            def solve_fn(Lb, Sb, rp, refine):  # refine is Python-static
+                return shard_map(
+                    partial(_solve, refine=refine),
+                    in_specs=(band_s, band_s, vec_s), out_specs=vec_s,
+                )(Lb, Sb, rp)
+
         return (lambda c: band_scatter_t(plan, c),
-                lambda Sb: banded_cholesky_t(Sb, bw),
-                solve_fn, add_diag_fn)
+                chol_fn, solve_fn, add_diag_fn)
 
     def solve_fn(Lb, Sb, rp, refine):
         v = bd.banded_solve(Lb, rp, bw)
