@@ -172,6 +172,7 @@ def run_measured(args) -> dict:
 
     chunk_rates = []
     iters_per_step = []
+    solve_rates = []
     t_cursor = steps
     for c in range(args.chunks):
         t0 = time.perf_counter()
@@ -181,8 +182,10 @@ def run_measured(args) -> dict:
         t_cursor += steps
         chunk_rates.append(steps / elapsed)
         iters_per_step.append(float(np.mean(np.asarray(outs.admm_iters))))
+        solve_rates.append(float(np.mean(np.asarray(outs.correct_solve))))
         _log(f"chunk {c}: {chunk_rates[-1]:.3f} ts/s, "
-             f"mean ADMM iters {iters_per_step[-1]:.0f}")
+             f"mean solver iters {iters_per_step[-1]:.0f}, "
+             f"solve rate {solve_rates[-1]:.4f}")
     rate = max(chunk_rates)  # steady-state rate; chunks differ only by noise
 
     # --- Phase breakdown (separately jitted; attribution, not headline).
@@ -286,6 +289,7 @@ def run_measured(args) -> dict:
         "chunk_rates": [round(r, 3) for r in chunk_rates],
         "compile_s": round(compile_s, 1),
         "admm_iters_per_step": round(float(np.mean(iters_per_step)), 1),
+        "solve_rate": round(float(np.mean(solve_rates)), 4),
         "phase_s_per_step": {k: round(v, 4) for k, v in phases.items()} if phases else None,
         "flops_per_step_est": flops_per_step,
         "mfu": round(mfu, 4) if mfu is not None else None,
