@@ -181,3 +181,11 @@ def test_sharded_pallas_band_kernels(tiny_config):
         np.asarray(sh_out.agg_load), np.asarray(ref_out.agg_load),
         rtol=1e-3, atol=1e-2,
     )
+
+
+def test_pallas_self_test_passes():
+    """The availability self-test (tiny diagonal system) validates the
+    kernels on the current backend (interpret mode here); available() on a
+    non-TPU backend reports False without running it."""
+    assert pb._run_self_test() is True
+    assert pb.available() is False  # CPU test backend
