@@ -31,7 +31,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-LANE_BLOCK = 512  # homes per kernel program (4 lane tiles)
+# Homes per kernel program (lane tiles of 128).  Env-tunable for on-chip
+# block-size experiments without code edits; 512 measured as the default.
+LANE_BLOCK = int(__import__("os").environ.get("DRAGG_LANE_BLOCK", 512))
 
 
 _SELFTEST: bool | None = None
