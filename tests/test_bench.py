@@ -1,0 +1,30 @@
+"""Benchmark-harness contract: the driver depends on bench.py always
+printing exactly one parseable JSON line with the headline fields, rc 0 —
+whatever happens to the backend (round-1 regression: a backend-init error
+produced a bare traceback and no number)."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_contract():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel here
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected ONE json line, got: {proc.stdout!r}"
+    result = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "solver",
+                "solve_rate", "phase_s_per_step", "admm_iters_per_step"):
+        assert key in result, key
+    assert result["unit"] == "timesteps/s"
+    assert result["value"] > 0
+    assert 0.5 <= result["solve_rate"] <= 1.0
