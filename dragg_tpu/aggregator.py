@@ -164,7 +164,33 @@ class Aggregator:
             self.all_homes, horizon, self.dt, int(hems["sub_subhourly_steps"])
         )
         self.batch = batch
-        self.engine = make_engine(batch, self.env, self.config, self.start_index)
+        # Multi-device processes (a TPU pod slice launched via
+        # deploy/launch_tpu_pod.sh, or any host with >1 visible device)
+        # shard the home axis over the mesh automatically; ``tpu.sharded``
+        # forces either behavior.  The sharded engine pads the home count
+        # to a multiple of the mesh — per-home outputs are sliced back to
+        # the true population in _collect_chunk.
+        sharded = self.config.get("tpu", {}).get("sharded", "auto")
+        if sharded not in ("auto", True, False):
+            raise ValueError(
+                f"tpu.sharded must be 'auto', true, or false, got {sharded!r}")
+        if sharded == "auto":
+            import jax
+
+            use_sharded = len(jax.devices()) > 1
+        else:
+            use_sharded = bool(sharded)
+        if use_sharded:
+            from dragg_tpu.parallel import make_sharded_engine
+
+            self.engine = make_sharded_engine(
+                batch, self.env, self.config, self.start_index)
+            self.log.logger.info(
+                f"sharded engine: {self.engine.mesh.devices.size} devices, "
+                f"{self.engine.n_homes} home slots "
+                f"({self.engine.true_n_homes} real)")
+        else:
+            self.engine = make_engine(batch, self.env, self.config, self.start_index)
 
     # ------------------------------------------------------------- data mgmt
     def _home_selected(self, home: dict) -> bool:
@@ -229,7 +255,19 @@ class Aggregator:
         ``track_setpoints=False`` skips the host-side ``gen_setpoint`` loop:
         the RL-aggregator scan already tracks the setpoint on device and
         overwrites ``all_sps`` with the authoritative values."""
-        host = {f: np.asarray(getattr(outs, f)) for f in StepOutputs._fields}
+        from dragg_tpu.checkpoint import to_host
+
+        n_true = getattr(self.engine, "true_n_homes", None) or self.engine.n_homes
+        host = {}
+        for f in StepOutputs._fields:
+            # to_host all-gathers leaves that span processes (multi-host
+            # pods) — it is a collective, so it runs on every process even
+            # though only process 0 writes files.
+            a = to_host(getattr(outs, f))
+            # Sharded engines pad the home axis to a mesh multiple; the
+            # replica homes are masked out of aggregates on device and
+            # dropped from per-home series here.
+            host[f] = a[:, :n_true] if a.ndim == 2 else a
         n_steps = host["p_grid"].shape[0]
         for out_key, field in (*_BASE_KEYS.items(), *_PV_KEYS.items(), *_BATT_KEYS.items()):
             self.collector.add_chunk(out_key, host[field])
@@ -337,8 +375,15 @@ class Aggregator:
         reads it."""
         import shutil
 
-        from dragg_tpu.checkpoint import save_progress, save_pytree
+        import jax
+        from dragg_tpu.checkpoint import save_progress, save_pytree, to_host
 
+        # Multi-host: gather sharded leaves on EVERY process (collective),
+        # then only process 0 touches the filesystem.  Resume expects the
+        # checkpoint visible to process 0 (shared FS or same host).
+        state = jax.tree_util.tree_map(to_host, state)
+        if jax.process_index() != 0:
+            return
         root = self._checkpoint_root()
         os.makedirs(root, exist_ok=True)
         name = f"ckpt_t{self.timestep:08d}"
@@ -407,6 +452,12 @@ class Aggregator:
             "num_timesteps": self.num_timesteps,
             "n_homes": len(self.all_homes) if self.all_homes else
                        self.config["community"]["total_number_homes"],
+            # Sharded engines pad the home axis, so the carry leaves are
+            # sized by the SLOT count — a checkpoint from a different
+            # device count / sharding mode must start fresh, not crash in
+            # load_pytree's leaf-shape check.
+            "n_home_slots": self.engine.n_homes if self.engine is not None
+                            else None,
             "horizon": int(self.config["home"]["hems"]["prediction_horizon"]),
         }
 
@@ -624,7 +675,13 @@ class Aggregator:
 
     def write_outputs(self) -> None:
         """Serialize per-home series + Summary → <run_dir>/<case>/results.json
-        (dragg/aggregator.py:831-844), streamed by the native writer."""
+        (dragg/aggregator.py:831-844), streamed by the native writer.
+        Multi-host: every process holds identical collected series (the
+        chunk gathers are collectives); only process 0 writes."""
+        import jax
+
+        if jax.process_index() != 0:
+            return
         summary = self.summarize_baseline()
         case_dir = os.path.join(self.run_dir, self.case)
         os.makedirs(case_dir, exist_ok=True)

@@ -23,10 +23,23 @@ import jax
 import numpy as np
 
 
+def to_host(a) -> np.ndarray:
+    """Fetch an array to host numpy, handling leaves sharded across
+    processes: a multi-host global array is all-gathered (a collective —
+    EVERY process must call this) before the local read."""
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+    return np.asarray(a)
+
+
 def save_pytree(path: str, tree) -> None:
-    """Write a pytree of arrays as an npz (leaves in flatten order)."""
+    """Write a pytree of arrays as an npz (leaves in flatten order).
+    Multi-host note: the gather runs on all processes; callers gate the
+    actual file write with ``jax.process_index() == 0``."""
     leaves = jax.tree_util.tree_leaves(tree)
-    arrays = {f"leaf_{i:04d}": np.asarray(l) for i, l in enumerate(leaves)}
+    arrays = {f"leaf_{i:04d}": to_host(l) for i, l in enumerate(leaves)}
     tmp = path + ".tmp.npz"
     np.savez_compressed(tmp, **arrays)
     os.replace(tmp, path)
@@ -52,7 +65,15 @@ def load_pytree(path: str, template):
             raise ValueError(
                 f"Checkpoint leaf {key} shape {arr.shape} != template {tshape}"
             )
-        new_leaves.append(jax.numpy.asarray(arr, dtype=np.asarray(tmpl).dtype))
+        if isinstance(tmpl, jax.Array):
+            # Restore the template's placement in ONE transfer: a sharded
+            # engine's state must come back with the SAME NamedSharding, or
+            # the resumed chunk compiles a differently-partitioned program
+            # whose fp reassociation breaks bit-exact resume.
+            leaf = jax.device_put(arr.astype(tmpl.dtype), tmpl.sharding)
+        else:
+            leaf = jax.numpy.asarray(arr, dtype=np.asarray(tmpl).dtype)
+        new_leaves.append(leaf)
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 

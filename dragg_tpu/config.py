@@ -221,6 +221,8 @@ _DEFAULT: dict[str, Any] = {
         "admm_eps": 1e-4,
         "fix_tou_peak": False,  # reference bug parity: peak price is overwritten by shoulder (dragg/aggregator.py:214-215)
         "mesh_axis": "homes",
+        "sharded": "auto",  # Aggregator engine: "auto" = shard the home axis
+                            # when >1 device is visible; true/false force
         "profile_dir": "",  # non-empty: jax.profiler trace of one device chunk
                             # (JAX_PROFILE_DIR env overrides)
         # Flax DDPG agent knobs (rl.parameters.agent = "ddpg").

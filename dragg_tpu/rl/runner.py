@@ -114,6 +114,18 @@ def run_rl_agg(agg) -> None:
     acarry = agent.carry
     env = init_env_carry(len(agg.all_homes), settings["prev_n"], norm)
     cstate = agg.engine.init_state()
+    mesh = getattr(agg.engine, "mesh", None)
+    if mesh is not None:
+        # Sharded engine: the community state is sharded over "homes";
+        # the agent/env carries (scalars and small windows) must be
+        # explicitly REPLICATED on the same mesh, or jit rejects the
+        # mixed single-device/mesh carry.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        put_rep = lambda a: jax.device_put(jnp.asarray(a), rep)
+        acarry = jax.tree_util.tree_map(put_rep, acarry)
+        env = jax.tree_util.tree_map(put_rep, env)
 
     step = partial(
         _fused_step, agg.engine, agent, agg.engine.params.dt, norm,

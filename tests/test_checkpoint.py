@@ -217,3 +217,44 @@ def test_rl_agg_resume_bit_exact(tmp_path):
     got_rl = json.load(open(os.path.join(res.run_dir, "rl_agg", "utility_agent-results.json")))
     assert len(exp_rl["reward"]) == len(got_rl["reward"]) == full.num_timesteps
     np.testing.assert_allclose(exp_rl["reward"], got_rl["reward"], rtol=1e-6)
+
+
+def test_resume_across_sharding_change_starts_fresh(tiny_config, tmp_path):
+    """A checkpoint written by the sharded engine (8 padded slots) must be
+    rejected gracefully — not crash in load_pytree — when the run is retried
+    unsharded (different slot count)."""
+    import copy
+
+    from dragg_tpu.aggregator import Aggregator
+
+    cfg = copy.deepcopy(tiny_config)
+    cfg["simulation"]["end_datetime"] = "2015-01-03 00"
+    cfg["simulation"]["resume"] = True
+    cfg["simulation"]["checkpoint_interval"] = "daily"
+    out = str(tmp_path / "out")
+
+    agg = Aggregator(copy.deepcopy(cfg), data_dir=None, outputs_dir=out)
+    agg.stop_after_chunks = 1
+    agg.run()  # auto-shards on the 8-device mesh; one checkpoint written
+    assert agg.timestep == 24
+
+    cfg2 = copy.deepcopy(cfg)
+    cfg2["tpu"]["sharded"] = False
+    agg2 = Aggregator(cfg2, data_dir=None, outputs_dir=out)
+    agg2.run()  # must start fresh (slot-count mismatch), not raise
+    assert agg2.resumed_from is None
+    assert agg2.timestep == agg2.num_timesteps
+
+
+def test_sharded_config_validation(tiny_config):
+    import copy
+
+    import pytest
+
+    from dragg_tpu.aggregator import Aggregator
+
+    cfg = copy.deepcopy(tiny_config)
+    cfg["tpu"]["sharded"] = "yes"
+    agg = Aggregator(cfg, data_dir=None, outputs_dir="/tmp/shv")
+    with pytest.raises(ValueError, match="sharded"):
+        agg.run()

@@ -175,3 +175,52 @@ def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(min(8, len(jax.devices())))
+
+
+def test_aggregator_auto_shards(tiny_config):
+    """With >1 visible device (the 8-device CPU test mesh), the Aggregator
+    builds a sharded engine automatically and produces the same results.json
+    schema with true-population per-home series (tpu.sharded='auto')."""
+    import copy
+    import glob
+    import json
+    import os
+    import tempfile
+
+    from dragg_tpu.aggregator import Aggregator
+    from dragg_tpu.parallel.mesh import ShardedEngine
+
+    cfg = copy.deepcopy(tiny_config)
+    cfg["simulation"]["end_datetime"] = "2015-01-02 00"
+    with tempfile.TemporaryDirectory() as td:
+        agg = Aggregator(cfg, data_dir=None, outputs_dir=td)
+        agg.run()
+        assert isinstance(agg.engine, ShardedEngine)
+        assert agg.engine.n_homes % 8 == 0
+        n = cfg["community"]["total_number_homes"]
+        res = glob.glob(os.path.join(td, "**", "results.json"), recursive=True)
+        assert res
+        data = json.load(open(res[0]))
+        homes = [k for k, v in data.items()
+                 if k != "Summary" and isinstance(v, dict) and "type" in v]
+        assert len(homes) == n  # no padded replicas leak into the output
+        for h in homes:
+            assert len(data[h]["p_grid_opt"]) == agg.num_timesteps
+        assert np.isfinite(np.asarray(
+            data["Summary"]["p_grid_aggregate"], dtype=float)).all()
+
+
+def test_aggregator_sharded_false_forces_single(tiny_config):
+    import copy
+    import tempfile
+
+    from dragg_tpu.aggregator import Aggregator
+    from dragg_tpu.parallel.mesh import ShardedEngine
+
+    cfg = copy.deepcopy(tiny_config)
+    cfg["simulation"]["end_datetime"] = "2015-01-01 02"
+    cfg["tpu"]["sharded"] = False
+    with tempfile.TemporaryDirectory() as td:
+        agg = Aggregator(cfg, data_dir=None, outputs_dir=td)
+        agg.run()
+        assert not isinstance(agg.engine, ShardedEngine)
