@@ -1,0 +1,67 @@
+"""Deterministic config-space fuzz: odd horizon/dt/sub-step/mix corners.
+
+The fixed tests pin behavior at the default shapes; this sweeps the shape
+knobs the reference exposes (prediction_horizon down to 1 h, subhourly
+aggregator steps, sub_subhourly duty cycles, each home-type mix) and
+asserts the engine invariants hold at every corner: finite outputs,
+box-respecting solved homes, fallback routing for the rest.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from dragg_tpu.config import default_config
+from dragg_tpu.data import load_environment, load_waterdraw_profiles
+from dragg_tpu.engine import make_engine
+from dragg_tpu.homes import build_home_batch, create_homes
+
+CASES = [
+    # (horizon_h, agg_dt, sub_steps, n, pv, batt, pvbatt, seed)
+    (1, 1, 1, 4, 1, 1, 0, 1),      # minimum horizon, no duty subdivision
+    (1, 2, 6, 4, 1, 1, 1, 2),      # subhourly aggregator steps
+    (3, 2, 2, 5, 0, 0, 0, 3),      # base-only community
+    (5, 1, 6, 4, 4, 0, 0, 4),      # all-PV
+    (2, 1, 6, 4, 0, 4, 0, 5),      # all-battery
+    (7, 1, 3, 6, 2, 2, 2, 6),      # odd horizon, every type
+]
+
+
+@pytest.mark.parametrize("h,dt,s,n,pv,bat,pvb,seed", CASES)
+def test_engine_invariants_across_config_corners(h, dt, s, n, pv, bat, pvb, seed):
+    cfg = copy.deepcopy(default_config())
+    cfg["community"]["total_number_homes"] = n
+    cfg["community"]["homes_pv"] = pv
+    cfg["community"]["homes_battery"] = bat
+    cfg["community"]["homes_pv_battery"] = pvb
+    cfg["simulation"]["random_seed"] = seed
+    cfg["agg"]["subhourly_steps"] = dt
+    cfg["home"]["hems"]["prediction_horizon"] = h
+    cfg["home"]["hems"]["sub_subhourly_steps"] = s
+
+    env = load_environment(cfg, data_dir=None)
+    wd = load_waterdraw_profiles(None, seed=seed)
+    homes = create_homes(cfg, 24 * dt, dt, wd)
+    batch = build_home_batch(homes, h * dt, dt, s)
+    eng = make_engine(batch, env, cfg, 0)
+    state = eng.init_state()
+    rps = np.zeros((3, eng.params.horizon), np.float32)
+    state, outs = eng.run_chunk(state, 0, rps)
+
+    for field in outs._fields:
+        a = np.asarray(getattr(outs, field))
+        assert np.isfinite(a).all(), f"{field} not finite at case {h,dt,s}"
+    solved = np.asarray(outs.correct_solve).astype(bool)
+    # Duty fractions live in [0, 1] wherever the QP solved.
+    for duty in ("hvac_cool_on", "hvac_heat_on", "wh_heat_on"):
+        d = np.asarray(getattr(outs, duty))[solved]
+        assert (d > -1e-3).all() and (d < 1 + 1e-3).all(), duty
+    # The thermal state stays inside physically plausible bounds everywhere
+    # (fallback bang-bang included).
+    ti = np.asarray(outs.temp_in)
+    tw = np.asarray(outs.temp_wh)
+    assert (ti > -10).all() and (ti < 50).all()
+    assert (tw > 0).all() and (tw < 90).all()
+    # At least the bulk of home-steps solve at every corner.
+    assert solved.mean() > 0.5, f"solve rate {solved.mean():.2f} at {h,dt,s}"
