@@ -28,3 +28,22 @@ def test_bench_smoke_contract():
     assert result["unit"] == "timesteps/s"
     assert result["value"] > 0
     assert 0.5 <= result["solve_rate"] <= 1.0
+
+
+def test_validate_scale_smoke():
+    """The scale-validation tool runs end-to-end at a tiny config and emits
+    its one-line JSON verdict with ok=true."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "validate_scale.py"),
+         "--homes", "16", "--horizon-hours", "4", "--days", "1",
+         "--chunk", "12", "--min-solve-rate", "0.8"],
+        capture_output=True, text=True, timeout=400, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["ok"] is True
+    assert result["homes"] == 16
+    assert 0.8 <= result["solve_rate"] <= 1.0
+    assert result["comfort_violation_max"] <= 0.05
