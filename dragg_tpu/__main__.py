@@ -42,6 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
     sweep.add_argument("--outputs-dir", default="outputs")
     sweep.add_argument("--no-figures", action="store_true")
 
+    doc = sub.add_parser("doctor", help="diagnose the environment (backend, "
+                                        "native runtime, data files, outputs)")
+    doc.add_argument("--outputs-dir", default="outputs")
+    doc.add_argument("--backend-timeout", type=float, default=60.0)
+
     sub.add_parser("bench", help="run the benchmark harness (prints one JSON line)")
 
     dash = sub.add_parser("dashboard", help="serve the results dashboard over HTTP")
@@ -132,6 +137,11 @@ def main(argv=None) -> int:
             r.sample_home = args.home
         r.main(save=not args.no_save)
         return 0
+    if args.cmd == "doctor":
+        from dragg_tpu.doctor import run_doctor
+
+        return run_doctor(outputs_dir=args.outputs_dir,
+                          backend_timeout=args.backend_timeout)
     if args.cmd == "sweep":
         return run_sweep(args)
     if args.cmd == "dashboard":

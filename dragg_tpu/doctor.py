@@ -1,0 +1,179 @@
+"""Environment diagnosis: ``python -m dragg_tpu doctor``.
+
+Answers "why isn't this working" in one screen: backend reachability
+(checked in a SUBPROCESS with a hard timeout, so a wedged TPU tunnel can
+never hang the diagnosis — the failure mode that motivated this tool),
+device inventory, Pallas kernel availability, the native C++ runtime,
+data-file resolution, and output-directory writability.
+
+Exit code 0 when every check passes or degrades gracefully (CPU fallback
+counts as degraded-ok); 1 when something is broken outright.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+OK, WARN, FAIL = "ok", "warn", "FAIL"
+
+
+def _check_backend(timeout_s: float = 60.0) -> dict:
+    """Probe jax backend init in a subprocess with a hard timeout."""
+    code = (
+        "import json, jax\n"
+        "ds = jax.devices()\n"
+        "print(json.dumps({'backend': jax.default_backend(),"
+        " 'devices': [str(d) for d in ds],"
+        " 'kind': getattr(ds[0], 'device_kind', '')}))\n"
+    )
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=timeout_s)
+        if proc.returncode == 0:
+            info = json.loads(proc.stdout.strip().splitlines()[-1])
+            return {"status": OK, **info}
+        return {"status": FAIL, "error": (proc.stderr or "")[-500:]}
+    except subprocess.TimeoutExpired:
+        return {"status": FAIL,
+                "error": f"backend init hung >{timeout_s:.0f}s (wedged "
+                         "accelerator tunnel? try JAX_PLATFORMS=cpu)"}
+
+
+def _check_cpu_fallback(timeout_s: float) -> dict:
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp\n"
+        "assert float(jnp.sum(jnp.ones(8))) == 8.0\n"
+        "print('cpu-ok')\n"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        ok = proc.returncode == 0 and "cpu-ok" in proc.stdout
+        return {"status": OK if ok else FAIL,
+                **({} if ok else {"error": (proc.stderr or "")[-300:]})}
+    except subprocess.TimeoutExpired:
+        return {"status": FAIL, "error": "CPU backend init hung"}
+
+
+def _check_native() -> dict:
+    try:
+        from dragg_tpu.native import StateBus
+
+        bus = StateBus()
+        bus.hset("doctor", "k", "v")
+        ok = bus.hget("doctor", "k") == "v"
+        return {"status": OK if ok else FAIL,
+                "native_extension": bool(bus.native),
+                **({} if bus.native else
+                   {"note": "pure-Python fallback active (g++ build unavailable)"})}
+    except Exception as e:
+        return {"status": FAIL, "error": repr(e)}
+
+
+def _check_data(cfg: dict | None) -> dict:
+    data_dir = os.environ.get("DATA_DIR")
+    if not data_dir:
+        return {"status": OK, "note": "no DATA_DIR — synthetic weather/draws/prices"}
+    # The exact file names the runtime resolves (dragg_tpu/data.py), env
+    # overrides included.
+    wanted = [os.environ.get("SOLAR_TEMPERATURE_DATA_FILE", "nsrdb.csv")]
+    if cfg is not None:
+        wanted.append(cfg["home"]["wh"].get("waterdraw_file",
+                                            "waterdraw_profiles.csv"))
+        if cfg["agg"].get("spp_enabled", False):
+            wanted.append(os.environ.get("SPP_DATA_FILE", "spp_data.csv"))
+    missing = [f for f in wanted
+               if not os.path.isfile(os.path.join(data_dir, f))]
+    return {"status": WARN if missing else OK, "data_dir": data_dir,
+            **({"missing": missing,
+                "note": "missing files substitute SYNTHETIC data (loudly)"}
+               if missing else {})}
+
+
+def _check_outputs(outputs_dir: str) -> dict:
+    try:
+        os.makedirs(outputs_dir, exist_ok=True)
+        with tempfile.NamedTemporaryFile(dir=outputs_dir, delete=True):
+            pass
+        return {"status": OK, "outputs_dir": os.path.abspath(outputs_dir)}
+    except OSError as e:
+        return {"status": FAIL, "error": repr(e)}
+
+
+def _check_config() -> tuple[dict, dict | None]:
+    try:
+        from dragg_tpu.config import configured_solver, load_config
+
+        # Report what load_config actually resolves: the default path only
+        # loads when the file exists (config.py load_config).
+        path = os.path.join(os.path.expanduser(os.environ.get("DATA_DIR", "data")),
+                            os.environ.get("CONFIG_FILE", "config.toml"))
+        source = f"file:{path}" if os.path.exists(path) else "defaults"
+        cfg = load_config(None)
+        return {"status": OK, "source": source,
+                "homes": cfg["community"]["total_number_homes"],
+                "solver": configured_solver(cfg)}, cfg
+    except Exception as e:
+        return {"status": FAIL, "error": repr(e)}, None
+
+
+def run_doctor(outputs_dir: str = "outputs", backend_timeout: float = 60.0,
+               stream=None) -> int:
+    stream = stream or sys.stdout
+    config_res, cfg = _check_config()
+    backend_res = _check_backend(backend_timeout)
+    checks = {
+        "config": config_res,
+        "backend": backend_res,
+        # The backend probe succeeding on "cpu" already proves CPU init.
+        "cpu_fallback": ({"status": OK, "note": "backend probe ran on cpu"}
+                         if backend_res.get("backend") == "cpu"
+                         else _check_cpu_fallback(max(backend_timeout, 120.0))),
+        "native_runtime": _check_native(),
+        "data_files": _check_data(cfg),
+        "outputs_writable": _check_outputs(outputs_dir),
+    }
+    # Pallas only matters when a TPU backend is up — and its self-test
+    # compiles a kernel, so it runs in a SUBPROCESS with the same hard
+    # timeout as the backend probe (a tunnel can wedge between probes).
+    if checks["backend"].get("backend") == "tpu":
+        code = ("from dragg_tpu.ops import pallas_band\n"
+                "print('PALLAS', pallas_band.available())\n")
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=max(backend_timeout, 120.0))
+            up = "PALLAS True" in proc.stdout
+            checks["pallas_kernels"] = {
+                "status": OK if up else WARN,
+                **({} if up else
+                   {"note": "self-test failed — XLA scan fallback active"}),
+            }
+        except subprocess.TimeoutExpired:
+            checks["pallas_kernels"] = {
+                "status": WARN, "note": "kernel self-test hung; scan fallback"}
+
+    hard_fail = False
+    for name, res in checks.items():
+        status = res["status"]
+        # An unreachable accelerator with a healthy CPU fallback is
+        # degraded-ok: every entry point still works on CPU.
+        if status == FAIL and name == "backend" \
+                and checks["cpu_fallback"]["status"] == OK:
+            status = WARN
+            res = {**res, "note": "accelerator unreachable; CPU fallback healthy"}
+        hard_fail |= status == FAIL
+        detail = {k: v for k, v in res.items() if k != "status"}
+        print(f"  {name:18s} [{status:4s}] "
+              f"{json.dumps(detail) if detail else ''}", file=stream)
+    print(("DOCTOR: FAIL — see [FAIL] lines above" if hard_fail else
+           "DOCTOR: environment usable"), file=stream)
+    return 1 if hard_fail else 0

@@ -47,3 +47,18 @@ def test_validate_scale_smoke():
     assert result["homes"] == 16
     assert 0.8 <= result["solve_rate"] <= 1.0
     assert result["comfort_violation_max"] <= 0.05
+
+
+def test_doctor_reports_usable_environment(tmp_path):
+    """doctor exits 0 with every check ok on the CPU test environment and
+    never hangs on backend init (hard subprocess timeout inside)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "dragg_tpu", "doctor",
+         "--outputs-dir", str(tmp_path / "out"), "--backend-timeout", "120"],
+        capture_output=True, text=True, timeout=400, env=env, cwd=ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-1000:]
+    assert "DOCTOR: environment usable" in proc.stdout
+    assert "[FAIL]" not in proc.stdout
