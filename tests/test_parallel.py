@@ -224,3 +224,37 @@ def test_aggregator_sharded_false_forces_single(tiny_config):
         agg = Aggregator(cfg, data_dir=None, outputs_dir=td)
         agg.run()
         assert not isinstance(agg.engine, ShardedEngine)
+
+
+def test_rl_agg_sharded_matches_single(tiny_config):
+    """The fused RL-aggregator scan produces the same aggregate trajectory
+    and reward prices sharded as single-device (fp tolerance — the IPM runs
+    fixed-style iterations so there is no stopping noise)."""
+    import copy
+    import glob
+    import json
+    import os
+    import tempfile
+
+    from dragg_tpu.aggregator import Aggregator
+
+    def run(sharded):
+        cfg = copy.deepcopy(tiny_config)
+        cfg["simulation"]["end_datetime"] = "2015-01-02 00"
+        cfg["simulation"]["run_rbo_mpc"] = False
+        cfg["simulation"]["run_rl_agg"] = True
+        cfg["tpu"]["sharded"] = sharded
+        with tempfile.TemporaryDirectory() as td:
+            agg = Aggregator(cfg, data_dir=None, outputs_dir=td)
+            agg.run()
+            res = glob.glob(os.path.join(td, "**", "rl_agg", "results.json"),
+                            recursive=True)[0]
+            with open(res) as f:
+                s = json.load(f)["Summary"]
+            return (np.asarray(s["p_grid_aggregate"], dtype=float),
+                    np.asarray(s["RP"], dtype=float))
+
+    load_1, rp_1 = run(False)
+    load_8, rp_8 = run(True)
+    np.testing.assert_allclose(load_8, load_1, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(rp_8, rp_1, rtol=1e-3, atol=1e-4)
