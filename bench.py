@@ -114,26 +114,39 @@ def run_measured(args) -> dict:
                        solver="admm" if args.solver == "auto" else args.solver)
     solver_used = engine.params.solver
     if args.solver == "auto":
-        # Race the two solver families on ONE single-step each and keep the
-        # winner (the ADMM/IPM balance flips with batch size and hardware —
-        # docs/perf_notes.md; compile cost is paid once per candidate).
+        # Race the two solver families over SEVERAL sequential steps and
+        # keep the winner (the ADMM/IPM balance flips with batch size and
+        # hardware — docs/perf_notes.md).  A one-step race is misleading:
+        # it samples the ADMM's best case (first warm-started step) while
+        # its steady-state iteration count keeps growing — at 1000 homes
+        # the one-step race picked an ADMM that then ran 683 iters/step in
+        # the timed chunks, 4x slower than the IPM it beat in the race.
         try:
             engine_ipm, _ = build(args.homes, args.horizon_hours,
                                   args.admm_iters, solver="ipm")
 
-            def step_time(eng):
+            def steps_time(eng, k=6, budget_s=60.0):
+                """Mean warm-step time over up to k steps, stopping early
+                once ``budget_s`` is spent — at 10k homes a warm step can
+                run ~20 s and the race must not eat the attempt timeout."""
                 st = eng.init_state()
                 rp0 = np.zeros(eng.params.horizon, dtype=np.float32)
-                st, out = eng.step(st, 0, rp0)       # compile
+                st, out = eng.step(st, 0, rp0)       # compile + cold step
                 jax.block_until_ready(out.agg_load)
                 t0 = time.perf_counter()
-                st, out = eng.step(st, 1, rp0)
-                jax.block_until_ready(out.agg_load)
-                return time.perf_counter() - t0
+                done = 0
+                for i in range(1, k + 1):
+                    st, out = eng.step(st, i, rp0)
+                    jax.block_until_ready(out.agg_load)
+                    done = i
+                    if time.perf_counter() - t0 > budget_s:
+                        break
+                return (time.perf_counter() - t0) / done, done
 
-            t_admm = step_time(engine)
-            t_ipm = step_time(engine_ipm)
-            _log(f"solver race: admm {t_admm:.2f}s/step vs ipm {t_ipm:.2f}s/step")
+            t_admm, k_a = steps_time(engine)
+            t_ipm, k_i = steps_time(engine_ipm)
+            _log(f"solver race: admm {t_admm:.2f}s/step over {k_a} warm "
+                 f"steps vs ipm {t_ipm:.2f}s/step over {k_i}")
             if t_ipm < t_admm:
                 engine, solver_used = engine_ipm, "ipm"
         except Exception as e:  # the race must never sink the benchmark
@@ -355,7 +368,8 @@ def main() -> None:
     ap.add_argument("--chunks", type=int, default=3, help="number of timed chunks")
     ap.add_argument("--admm-iters", type=int, default=1000)
     ap.add_argument("--solver", choices=["auto", "admm", "ipm"], default="auto",
-                    help="auto: race both on one step and keep the winner")
+                    help="auto: race both over several warm steps and keep "
+                         "the winner")
     ap.add_argument("--platform", choices=["auto", "tpu", "cpu"], default="auto")
     ap.add_argument("--cpu-fallback-homes", type=int, default=1_000,
                     help="community size for the CPU fallback attempt")
