@@ -206,8 +206,13 @@ _DEFAULT: dict[str, Any] = {
         "ipm_warm_start": False,  # seed the IPM from the receding-horizon
                                   # shift (interior-safeguarded; see
                                   # docs/perf_notes.md for the measurement)
-        "ipm_iters": 0,  # Mehrotra iteration count (hems.solver="ipm");
+        "ipm_iters": 0,  # Mehrotra iteration cap (hems.solver="ipm");
                          # 0 = horizon-aware default: 16 + (decision steps)/2
+        "ipm_tail_frac": 0.25,  # tail compaction: after a short full-batch
+                                # phase, gather the worst 25% of homes and
+                                # finish them alone (1.5-1.6x solver time,
+                                # equal-or-better solve rates); 0 disables
+        "ipm_tail_iters": 0,  # tail-phase iteration cap (0 = ipm_iters)
         "band_kernel": "auto",  # band factor/solve impl: "pallas" (fused TPU
                                 # kernels, ops/pallas_band.py) | "xla" (scan
                                 # path) | "auto" = pallas on TPU, xla elsewhere

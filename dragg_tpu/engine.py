@@ -150,6 +150,8 @@ class EngineParams(NamedTuple):
     admm_banded_factor: bool  # banded-Cholesky Schur factorization
     admm_solve_backend: str  # "auto" | "dense_inv" | "band" in-loop solve
     ipm_iters: int      # Mehrotra iteration cap (solver="ipm")
+    ipm_tail_frac: float  # straggler sub-batch fraction (0 disables)
+    ipm_tail_iters: int   # tail-phase iteration cap (0 = ipm_iters)
     ipm_warm: bool      # seed the IPM from the receding-horizon shift
     band_kernel: str    # "auto" | "pallas" | "xla" band factor/solve impl
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
@@ -356,9 +358,14 @@ class Engine:
         if p.solver == "ipm":
             from dragg_tpu.ops.ipm import ipm_solve_qp
 
+            # Tail compaction (1.5-1.6x solver wall-clock at equal-or-
+            # better solve counts, docs/perf_notes.md): the budget split
+            # and its eligibility conditions live inside ipm_solve_qp —
+            # the engine just forwards the cap and the knobs.
             sol = ipm_solve_qp(
                 self.static.pattern, qp.vals, qp.b_eq, qp.l_box, qp.u_box,
                 qp.q, reg=p.admm_reg, iters=p.ipm_iters,
+                tail_frac=p.ipm_tail_frac, tail_iters=p.ipm_tail_iters,
                 eps_abs=p.admm_eps, eps_rel=p.admm_eps,
                 band_kernel=self._band_kernel,
                 mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
@@ -596,6 +603,8 @@ def engine_params(config, start_index: int) -> EngineParams:
         # H=48: 25 iters → 95.3% solve rate, 35 → 97.9%, 45 → 99.0%);
         # 0 = horizon-aware default, explicit values override.
         ipm_iters=int(tpu_cfg.get("ipm_iters", 0)) or 16 + horizon // 2,
+        ipm_tail_frac=float(tpu_cfg.get("ipm_tail_frac", 0.25)),
+        ipm_tail_iters=int(tpu_cfg.get("ipm_tail_iters", 0)),
         ipm_warm=bool(tpu_cfg.get("ipm_warm_start", False)),
         band_kernel=str(tpu_cfg.get("band_kernel", "auto")),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),

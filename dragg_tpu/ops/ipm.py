@@ -46,8 +46,9 @@ from dragg_tpu.ops.qp import SparsePattern, schur_contrib
 _BIG = 1e20
 
 
-@partial(jax.jit, static_argnames=("pat", "iters", "ruiz_iters", "band_kernel",
-                                   "mesh", "mesh_axis"))
+@partial(jax.jit, static_argnames=("pat", "iters", "tail_frac", "tail_iters",
+                                   "ruiz_iters", "band_kernel", "mesh",
+                                   "mesh_axis"))
 def ipm_solve_qp(
     pat: SparsePattern,
     vals: jnp.ndarray,      # (B, nnz) A values
@@ -58,6 +59,8 @@ def ipm_solve_qp(
     *,
     reg: float = 1e-3,
     iters: int = 30,
+    tail_frac: float = 0.0,
+    tail_iters: int = 0,
     eps_abs: float = 1e-4,
     eps_rel: float = 1e-4,
     ruiz_iters: int = 10,
@@ -174,6 +177,39 @@ def ipm_solve_qp(
     scatter_fn, chol_fn, band_solve_fn, add_diag_fn = pallas_band.make_band_ops(
         plan, band_kernel, mesh=mesh, mesh_axis=mesh_axis)
 
+    # The Mehrotra loop is built by a factory over the per-home data so it
+    # runs identically on the full batch (phase 1) and on a gathered
+    # straggler sub-batch (tail-compaction phase 2, see below).
+    return _run_phases(
+        B, m, dtype, iters, tail_frac, tail_iters, mesh,
+        eps_abs, eps_rel,
+        (vals_s, vp_r, vp_c, qs, bs, ls, us, reg_s, fin_l, fin_u, n_act, c * d),
+        (x, y, s_l, s_u, z_l, z_u),
+        dict(row_cols=row_cols, col_rows=col_rows, perm_ix=perm_ix,
+             invp_ix=invp_ix, schur=schur,
+             scatter_fn=scatter_fn, chol_fn=chol_fn,
+             band_solve_fn=band_solve_fn, add_diag_fn=add_diag_fn),
+        # final-residual extras (full-batch):
+        dict(e_eq=e_eq, e_box=e_box, c=c, d=d, l_box=l_box, u_box=u_box,
+             fixed=fixed, fixval=fixval, inverted=inverted),
+    )
+
+
+def _make_loop(data, shared, eps_abs, eps_rel):
+    """(body, converged) closures over one per-home data tuple."""
+    (vals_s, vp_r, vp_c, qs, bs, ls, us, reg_s, fin_l, fin_u, n_act, cd) = data
+    row_cols, col_rows = shared["row_cols"], shared["col_rows"]
+    perm_ix, invp_ix = shared["perm_ix"], shared["invp_ix"]
+    schur = shared["schur"]
+    scatter_fn, chol_fn = shared["scatter_fn"], shared["chol_fn"]
+    band_solve_fn, add_diag_fn = shared["band_solve_fn"], shared["add_diag_fn"]
+
+    def mv(x):
+        return jnp.sum(vp_r * x[:, row_cols], axis=2)
+
+    def mvt(y):
+        return jnp.sum(vp_c * y[:, col_rows], axis=2)
+
     def solve_kkt(Lb, Sb, theta_inv, r1, r2, refine=1):
         """One reduced-KKT solve: dy from the band factor (``refine``
         refinement passes against the band S), dx by back-substitution.
@@ -183,22 +219,25 @@ def ipm_solve_qp(
         dx = theta_inv * (r1 - mvt(dy))
         return dx, dy
 
-    def _converged(x, y, s_l, s_u, z_l, z_u):
+    def converged(x, y, s_l, s_u, z_l, z_u):
         """Per-home convergence in the scaled space (loop-internal freeze
-        criterion; the authoritative check runs once at the end)."""
+        criterion; the authoritative check runs once at the end) plus a
+        residual score used to rank stragglers for tail compaction."""
         rp = jnp.max(jnp.abs(mv(x) - bs), axis=1)
-        rd = jnp.max(jnp.abs(reg_s * x + qs + mvt(y) - z_l + z_u) / (c * d), axis=1)
+        rd = jnp.max(jnp.abs(reg_s * x + qs + mvt(y) - z_l + z_u) / cd, axis=1)
         gap = (jnp.sum(s_l * z_l * fin_l, axis=1)
                + jnp.sum(s_u * z_u * fin_u, axis=1)) / n_act
         gap_u = gap / jnp.maximum(jnp.abs(jnp.sum(qs * x, axis=1)), 1.0)
-        return (rp <= eps_abs) & (rd <= 10 * eps_abs) & (gap_u <= jnp.maximum(eps_rel, 1e-7))
+        ok = (rp <= eps_abs) & (rd <= 10 * eps_abs) \
+            & (gap_u <= jnp.maximum(eps_rel, 1e-7))
+        return ok, rp + rd + gap_u
 
     def body(carry):
         i, _, x, y, s_l, s_u, z_l, z_u = carry
         # Lockstep freeze: once a home converges it stops iterating — letting
         # it keep driving mu toward 0 degenerates Theta (z/s spans ~1e12)
         # and NaNs the f32 band factor while slower homes still work.
-        frozen = _converged(x, y, s_l, s_u, z_l, z_u)
+        frozen, _ = converged(x, y, s_l, s_u, z_l, z_u)
         theta = reg_s + jnp.where(fin_l, z_l / s_l, 0.0) + jnp.where(fin_u, z_u / s_u, 0.0)
         # f32 conditioning: cap the barrier diagonal (bounds cond(S) so the
         # band Cholesky stays meaningful at ~7 decimal digits) and Tikhonov
@@ -292,6 +331,42 @@ def ipm_solve_qp(
         z_u = jnp.where(fin_ok, z_u_n, z_u)
         return i + 1, jnp.all(frozen), x, y, s_l, s_u, z_l, z_u
 
+    return body, converged
+
+
+def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
+                eps_abs, eps_rel, data, carry0, shared, fin):
+    """Phase-1 full-batch Mehrotra loop, optional phase-2 tail compaction,
+    final residual check.
+
+    Tail compaction: most homes converge well before the iteration cap
+    (H=48 cold: 77 % by iteration 16 while the cap runs 40 —
+    docs/perf_notes.md), yet every full-batch iteration pays for all B
+    homes.  With ``tail_frac`` > 0, phase 1 stops at ``iters`` and the
+    worst ``ceil(B·tail_frac)`` homes are GATHERED into a compact
+    sub-batch that alone runs up to ``tail_iters`` more iterations —
+    straggler cost scales by tail_frac instead of 1.  Static shapes
+    throughout (top_k with a static k).  Disabled under a mesh: the
+    gather would be a cross-shard all-to-all.
+    """
+    (vals_s, vp_r, vp_c, qs, bs, ls, us, reg_s, fin_l, fin_u, n_act, cd) = data
+    x, y, s_l, s_u, z_l, z_u = carry0
+    body, conv_fn = _make_loop(data, shared, eps_abs, eps_rel)
+
+    # Budget split lives HERE, next to the eligibility conditions, so the
+    # two cannot disagree: ``cap`` is the user-facing iteration cap.  With
+    # the tail eligible, phase 1 runs a shortened full-batch budget (2/5 of
+    # the cap, min 10 — from the measured convergence CDF) and the tail
+    # phase runs up to ``tail_iters`` (default: the cap) on the gathered
+    # stragglers.  Ineligible (mesh / tiny batch / tiny cap) → the full cap
+    # runs in phase 1, exactly the pre-compaction behavior.
+    do_tail = tail_frac > 0 and mesh is None and B >= 8 and cap > 10
+    if do_tail:
+        iters = min(cap, max(10, cap * 2 // 5))
+        tail_iters = tail_iters or cap
+    else:
+        iters = cap
+
     # Early exit once every home is frozen: frozen homes take zero-length
     # steps (a_p = a_d = 0), so stopping at that point is OUTPUT-IDENTICAL
     # to running out the fixed budget — warm steady-state batches converge
@@ -305,7 +380,41 @@ def ipm_solve_qp(
         (jnp.asarray(0), jnp.asarray(False), x, y, s_l, s_u, z_l, z_u),
     )
 
+    if do_tail:
+        k = int(np.ceil(B * float(tail_frac)))
+        k = max(1, min(B - 1, k))
+        frozen, score = conv_fn(x, y, s_l, s_u, z_l, z_u)
+        # Converged homes rank below any straggler; among stragglers the
+        # largest residuals go first (all fit within k when frac is sized
+        # from the measured convergence CDF).
+        idx = lax.top_k(jnp.where(frozen, -1.0, score), k)[1]
+        g = lambda a: a[idx]
+        data2 = tuple(g(a) for a in data)
+        body2, _ = _make_loop(data2, shared, eps_abs, eps_rel)
+        i2, _, x2, y2, s_l2, s_u2, z_l2, z_u2 = lax.while_loop(
+            lambda c: (c[0] < tail_iters) & ~c[1],
+            body2,
+            # Seed all-frozen from the phase-1 state: a warm steady-state
+            # batch that fully converged in phase 1 skips the tail loop
+            # entirely instead of paying one dead zero-step iteration.
+            (jnp.asarray(0), jnp.all(frozen),
+             g(x), g(y), g(s_l), g(s_u), g(z_l), g(z_u)),
+        )
+        x = x.at[idx].set(x2)
+        y = y.at[idx].set(y2)
+        s_l = s_l.at[idx].set(s_l2)
+        s_u = s_u.at[idx].set(s_u2)
+        z_l = z_l.at[idx].set(z_l2)
+        z_u = z_u.at[idx].set(z_u2)
+        i_done = i_done + i2
+
     # --- Final residuals in UNSCALED units (ADMM-convention norms).
+    e_eq, e_box, c, d = fin["e_eq"], fin["e_box"], fin["c"], fin["d"]
+    l_box, u_box = fin["l_box"], fin["u_box"]
+    fixed, fixval, inverted = fin["fixed"], fin["fixval"], fin["inverted"]
+    row_cols, col_rows = shared["row_cols"], shared["col_rows"]
+    mv = lambda xx: jnp.sum(vp_r * xx[:, row_cols], axis=2)
+    mvt = lambda yy: jnp.sum(vp_c * yy[:, col_rows], axis=2)
     r_prim = jnp.max(jnp.abs((mv(x) - bs) / e_eq), axis=1)
     box_viol = jnp.maximum(
         jnp.where(fin_l, ls - x, 0.0), jnp.where(fin_u, x - us, 0.0)

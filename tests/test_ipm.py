@@ -146,3 +146,23 @@ def test_ipm_early_exit_and_warm_start():
     fc = (q * np.asarray(cold.x)).sum(axis=1)
     fw = (q * np.asarray(warm.x)).sum(axis=1)
     np.testing.assert_allclose(fw[both], fc[both], rtol=1e-3, atol=1e-2)
+
+
+def test_ipm_tail_compaction_matches_quality():
+    """Tail compaction (short full-batch phase + straggler sub-batch) must
+    reach at least the solve count of the plain full-budget run at ~55%
+    of the unit-iteration cost (docs/perf_notes.md measurements)."""
+    qp, pat = _assemble_real_step(horizon_hours=24, n_homes=64)
+    base = ipm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                        iters=28)
+    tail = ipm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                        iters=11, tail_frac=0.25, tail_iters=28)
+    n_base = int(np.sum(np.asarray(base.solved)))
+    n_tail = int(np.sum(np.asarray(tail.solved)))
+    assert n_tail >= n_base
+    # Solved homes agree on objective between the two schedules.
+    both = np.asarray(base.solved) & np.asarray(tail.solved)
+    q = np.asarray(qp.q)
+    fb = (q * np.asarray(base.x)).sum(axis=1)
+    ft = (q * np.asarray(tail.x)).sum(axis=1)
+    np.testing.assert_allclose(ft[both], fb[both], rtol=2e-3, atol=1e-2)
