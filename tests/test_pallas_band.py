@@ -189,3 +189,28 @@ def test_pallas_self_test_passes():
     non-TPU backend reports False without running it."""
     assert pb._run_self_test() is True
     assert pb.available() is False  # CPU test backend
+
+
+def test_ipm_tail_with_pallas_matches_xla():
+    """The on-chip default combination — tail compaction + pallas band
+    kernels — agrees with the XLA path on solutions and solve flags."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_qp_parity import _assemble_real_step
+
+    from dragg_tpu.ops.ipm import ipm_solve_qp
+
+    qp, pat = _assemble_real_step(horizon_hours=4, n_homes=12)
+    kw = dict(iters=20, tail_frac=0.25, tail_iters=20)
+    sol_x = ipm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                         band_kernel="xla", **kw)
+    sol_p = ipm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                         band_kernel="pallas", **kw)
+    np.testing.assert_array_equal(np.asarray(sol_x.solved),
+                                  np.asarray(sol_p.solved))
+    both = np.asarray(sol_x.solved)
+    q = np.asarray(qp.q)
+    fx = (q * np.asarray(sol_x.x)).sum(axis=1)
+    fp = (q * np.asarray(sol_p.x)).sum(axis=1)
+    np.testing.assert_allclose(fp[both], fx[both], rtol=1e-3, atol=1e-2)
