@@ -269,8 +269,11 @@ def run_measured(args) -> dict:
         # matvec] ≈ 10 passes counting rhs/solution vectors), plus the
         # sparse A matvecs (~4 nnz/row over m rows, read ~6 times across
         # predictor/corrector/residuals).  Loose analytic floor — reported
-        # as achieved-bandwidth fraction of the chip's HBM peak.
-        bw_band = 5  # bw+1 at the MPC pattern's RCM bandwidth of 4
+        # as achieved-bandwidth fraction of the chip's HBM peak.  The band
+        # width comes from the engine's actual RCM plan (bw=4 at the MPC
+        # pattern today) rather than a hardcoded literal, so a pattern
+        # change can't silently skew hbm_util (ADVICE r2).
+        bw_band = (engine.band_bw or 4) + 1
         bytes_iter = B * m * 4 * (9 * bw_band + 6 * 4 + 8)
         bytes_per_step = mean_iters * bytes_iter
         for key, val in PEAK_HBM_BW:
@@ -289,6 +292,13 @@ def run_measured(args) -> dict:
         except Exception as e:
             _log(f"profiler trace failed: {e!r}")
 
+    # Which band factor/solve implementation ACTUALLY compiled into the
+    # engine ("pallas" or "xla" — "auto" is resolved at build), plus the
+    # Pallas compile self-test verdict (None = never attempted, e.g. CPU;
+    # False = attempted and fell back).  Without these a silent self-test
+    # fallback is indistinguishable from "pallas didn't help" (VERDICT r2).
+    from dragg_tpu.ops import pallas_band
+
     return {
         "metric": f"sim_timesteps_per_s_{args.homes}homes_{args.horizon_hours}h_horizon",
         "value": round(rate, 3),
@@ -298,6 +308,8 @@ def run_measured(args) -> dict:
         "device_kind": str(device_kind),
         "n_homes": args.homes,
         "solver": solver_used,
+        "band_kernel": engine.band_kernel,
+        "pallas_selftest": pallas_band._SELFTEST,
         "horizon_steps": H,
         "chunk_rates": [round(r, 3) for r in chunk_rates],
         "compile_s": round(compile_s, 1),

@@ -122,7 +122,31 @@ def main(argv=None) -> int:
         if os.environ.get("DRAGG_DISTRIBUTED") == "1":
             import jax
 
-            jax.distributed.initialize()
+            # CPU backends need an explicit cross-process collectives
+            # implementation (TPU rides ICI natively).  This makes the
+            # multi-host code path testable as N local processes —
+            # tests/test_distributed.py runs exactly this entry.
+            if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            # On TPU pods initialize() auto-detects the topology from the
+            # runtime; for N-local-process testing (and any cluster without
+            # auto-detection) the coordinator is passed explicitly.
+            kw = {}
+            if os.environ.get("DRAGG_COORDINATOR_ADDRESS"):
+                missing = [v for v in ("DRAGG_NUM_PROCESSES", "DRAGG_PROCESS_ID")
+                           if not os.environ.get(v)]
+                if missing:
+                    print("DRAGG_COORDINATOR_ADDRESS is set but "
+                          f"{' and '.join(missing)} "
+                          "is missing; all three are required for explicit "
+                          "multi-process init.", file=sys.stderr)
+                    return 2
+                kw = dict(
+                    coordinator_address=os.environ["DRAGG_COORDINATOR_ADDRESS"],
+                    num_processes=int(os.environ["DRAGG_NUM_PROCESSES"]),
+                    process_id=int(os.environ["DRAGG_PROCESS_ID"]),
+                )
+            jax.distributed.initialize(**kw)
 
         from dragg_tpu.aggregator import Aggregator
 

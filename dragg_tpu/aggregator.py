@@ -372,18 +372,20 @@ class Aggregator:
         pointer is atomically replaced.  A kill at any instant leaves either
         the previous complete checkpoint or the new complete one — never a
         torn mix.  results.json stays a user-facing output; resume never
-        reads it."""
+        reads it.
+
+        Multi-host (``jax.process_count() > 1``): every process dumps its
+        OWN addressable shard blocks (no gather collective, no shared-FS
+        assumption) — see :meth:`_save_checkpoint_multiprocess`."""
         import shutil
 
         import jax
         from dragg_tpu.checkpoint import save_progress, save_pytree, to_host
 
-        # Multi-host: gather sharded leaves on EVERY process (collective),
-        # then only process 0 touches the filesystem.  Resume expects the
-        # checkpoint visible to process 0 (shared FS or same host).
-        state = jax.tree_util.tree_map(to_host, state)
-        if jax.process_index() != 0:
+        if jax.process_count() > 1:
+            self._save_checkpoint_multiprocess(state, extra_json)
             return
+        state = jax.tree_util.tree_map(to_host, state)
         root = self._checkpoint_root()
         os.makedirs(root, exist_ok=True)
         name = f"ckpt_t{self.timestep:08d}"
@@ -396,18 +398,7 @@ class Aggregator:
                                   self._results_plan(None))
         for fname, obj in (extra_json or {}).items():
             save_progress(os.path.join(tmp, fname), obj)
-        save_progress(os.path.join(tmp, "progress.json"), {
-            "run_shape": self._run_shape(),
-            "timestep": self.timestep,
-            "elapsed": time.time() - self.start_time,
-            "baseline_agg_load_list": self.baseline_agg_load_list,
-            "all_rps": self.all_rps.tolist(),
-            "all_sps": self.all_sps.tolist(),
-            "solve_iters": self._solve_iters,
-            "tracked_loads": getattr(self, "tracked_loads", None),
-            "max_load": getattr(self, "max_load", None),
-            "min_load": getattr(self, "min_load", None),
-        })
+        save_progress(os.path.join(tmp, "progress.json"), self._progress_dict())
         final = os.path.join(root, name)
         # A previous run killed between this rename and the LATEST replace
         # leaves a complete ckpt dir at `final` while LATEST still points at
@@ -421,6 +412,69 @@ class Aggregator:
             f.write(name)
         os.replace(latest_tmp, os.path.join(root, "LATEST"))
         # Prune superseded checkpoints.
+        for entry in os.listdir(root):
+            if entry.startswith("ckpt_") and entry != name:
+                shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
+
+    def _progress_dict(self) -> dict:
+        return {
+            "run_shape": self._run_shape(),
+            "timestep": self.timestep,
+            "elapsed": time.time() - self.start_time,
+            "baseline_agg_load_list": self.baseline_agg_load_list,
+            "all_rps": self.all_rps.tolist(),
+            "all_sps": self.all_sps.tolist(),
+            "solve_iters": self._solve_iters,
+            "tracked_loads": getattr(self, "tracked_loads", None),
+            "max_load": getattr(self, "max_load", None),
+            "min_load": getattr(self, "min_load", None),
+        }
+
+    def _save_checkpoint_multiprocess(self, state, extra_json) -> None:
+        """Multi-host checkpoint: per-process shard dumps + barrier-gated
+        publish, so a pod whose workers have SEPARATE local disks can still
+        resume (round-2 open item, docs/round2_summary.md).
+
+        Protocol (every process runs it against its own filesystem):
+        1. each process atomically writes ``state.procXXXXX-of-YYYYY.npz``
+           with only ITS addressable blocks (checkpoint.save_pytree_local —
+           collective-free); process 0 also writes progress/collected/extras;
+        2. global barrier — no LATEST anywhere until every shard is durable;
+        3. every process atomically replaces its LATEST pointer (identical
+           bytes, so the racing writes on a shared FS are benign);
+        4. barrier, then prune superseded checkpoint dirs.
+        A crash between 2 and 3 tears LATEST across workers; resume detects
+        that via the broadcast decision + per-shard timestep check and
+        starts fresh instead of deadlocking (:meth:`try_resume`)."""
+        import shutil
+
+        import jax
+        from jax.experimental import multihost_utils
+
+        from dragg_tpu.checkpoint import (save_progress, save_pytree_local,
+                                          shard_file_name)
+
+        root = self._checkpoint_root()
+        name = f"ckpt_t{self.timestep:08d}"
+        final = os.path.join(root, name)
+        os.makedirs(final, exist_ok=True)
+        save_pytree_local(
+            os.path.join(final, shard_file_name(jax.process_index(),
+                                                jax.process_count())),
+            state, self.timestep)
+        if jax.process_index() == 0:
+            self.collector.write_json(os.path.join(final, "collected.json"),
+                                      self._results_plan(None))
+            for fname, obj in (extra_json or {}).items():
+                save_progress(os.path.join(final, fname), obj)
+            save_progress(os.path.join(final, "progress.json"),
+                          self._progress_dict())
+        multihost_utils.sync_global_devices(f"dragg_ckpt_files_{name}")
+        latest_tmp = os.path.join(root, f"LATEST.tmp{jax.process_index()}")
+        with open(latest_tmp, "w") as f:
+            f.write(name)
+        os.replace(latest_tmp, os.path.join(root, "LATEST"))
+        multihost_utils.sync_global_devices(f"dragg_ckpt_latest_{name}")
         for entry in os.listdir(root):
             if entry.startswith("ckpt_") and entry != name:
                 shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
@@ -459,18 +513,32 @@ class Aggregator:
             "n_home_slots": self.engine.n_homes if self.engine is not None
                             else None,
             "horizon": int(self.config["home"]["hems"]["prediction_horizon"]),
+            # Shard files are per-process; a checkpoint from a different
+            # process topology must start fresh, not mis-assemble.
+            "process_count": __import__("jax").process_count(),
         }
 
     def try_resume(self, template_state):
         """Restore (state, t) from the latest complete checkpoint if one
         exists and ``simulation.resume`` is enabled; else (template_state, 0).
         Sets ``self.resumed_from`` to the checkpoint directory so callers can
-        restore their own extras (e.g. RL agent telemetry)."""
+        restore their own extras (e.g. RL agent telemetry).
+
+        Multi-host: process 0 decides (it owns progress.json) and the
+        decision is BROADCAST so every process takes the same branch — a
+        local filesystem check on each process would deadlock the next
+        collective the first time the workers disagreed (advisor finding,
+        ADVICE round 2).  Each process then loads its own shard file;
+        per-shard validity is allgathered into one global go/no-go."""
+        import jax
+
         from dragg_tpu.checkpoint import load_progress, load_pytree
 
         self.resumed_from = None
         if not self.config["simulation"].get("resume", False):
             return template_state, 0
+        if jax.process_count() > 1:
+            return self._try_resume_multiprocess(template_state)
         d = self._latest_checkpoint_dir()
         if d is None:
             return template_state, 0
@@ -484,6 +552,21 @@ class Aggregator:
             )
             return template_state, 0
         state = load_pytree(os.path.join(d, "state.npz"), template_state)
+        self._restore_from_progress(d, prog)
+        self.timestep = int(prog["timestep"])
+        self.resumed_from = d
+        self.log.logger.info(f"Resuming {self.case} from timestep {self.timestep}.")
+        return state, self.timestep
+
+    def _restore_from_progress(self, d: str, prog: dict,
+                               include_tracker: bool = True) -> None:
+        """Rank-0 host bookkeeping restore from a checkpoint dir — ONE body
+        shared by the single- and multi-process resume paths so a new
+        progress.json field cannot silently desynchronize them.
+        ``include_tracker=False`` skips the setpoint-tracker fields (the
+        multi-process path restores those on every rank via broadcast)."""
+        from dragg_tpu.checkpoint import load_progress
+
         collected = load_progress(os.path.join(d, "collected.json"))
         for i, home in enumerate(self.all_homes):
             series = collected.get(home["name"])
@@ -492,19 +575,113 @@ class Aggregator:
             for key, values in series.items():
                 if isinstance(values, list):
                     self.collector.import_series(key, i, values)
-        self.timestep = int(prog["timestep"])
         self.baseline_agg_load_list = list(prog["baseline_agg_load_list"])
         self.all_rps = np.asarray(prog["all_rps"], dtype=np.float64)
         self.all_sps = np.asarray(prog["all_sps"], dtype=np.float64)
         self._solve_iters = list(prog["solve_iters"])
-        if prog.get("tracked_loads") is not None:
+        if include_tracker and prog.get("tracked_loads") is not None:
             self.tracked_loads = list(prog["tracked_loads"])
             self.max_load = prog["max_load"]
             self.min_load = prog["min_load"]
         # Keep cumulative solve_time meaningful across the restart.
         self.start_time = time.time() - float(prog.get("elapsed", 0.0))
-        self.resumed_from = d
-        self.log.logger.info(f"Resuming {self.case} from timestep {self.timestep}.")
+
+    def _try_resume_multiprocess(self, template_state):
+        """Deadlock-free multi-host resume over per-process shard files.
+
+        Decision flow (all of it collective, so every process branches the
+        same way): process 0 validates progress.json + run_shape and
+        broadcasts the candidate timestep (−1 = start fresh); each process
+        then checks ITS shard file (existence + stored timestep, catching a
+        checkpoint torn by a mid-publish crash) and the verdicts are
+        allgathered — any bad shard sends every process back to t=0."""
+        import jax
+        from jax.experimental import multihost_utils
+
+        from dragg_tpu.checkpoint import (load_progress, load_pytree_local,
+                                          shard_file_name)
+
+        t_resume = -1
+        prog = None
+        if jax.process_index() == 0:
+            d = self._latest_checkpoint_dir()
+            if d is not None:
+                try:
+                    prog = load_progress(os.path.join(d, "progress.json"))
+                    if prog.get("run_shape") == self._run_shape():
+                        t_resume = int(prog["timestep"])
+                    else:
+                        self.log.logger.warning(
+                            f"Checkpoint {d} run shape {prog.get('run_shape')} "
+                            f"!= current {self._run_shape()}; starting fresh.")
+                        prog = None
+                except Exception as e:
+                    self.log.logger.warning(
+                        f"Checkpoint {d} unreadable ({e!r}); starting fresh.")
+                    prog = None
+        t_resume = int(multihost_utils.broadcast_one_to_all(
+            np.asarray(t_resume, np.int32)))
+        if t_resume < 0:
+            return template_state, 0
+        name = f"ckpt_t{t_resume:08d}"
+        shard = os.path.join(self._checkpoint_root(), name,
+                             shard_file_name(jax.process_index(),
+                                             jax.process_count()))
+        local_ok = False
+        if os.path.isfile(shard):
+            try:
+                with np.load(shard) as data:
+                    local_ok = int(data["__timestep__"]) == t_resume
+            except Exception:
+                local_ok = False
+        all_ok = bool(np.all(multihost_utils.process_allgather(
+            np.asarray(local_ok))))
+        if not all_ok:
+            self.log.logger.warning(
+                f"Checkpoint {name}: shard missing/torn on some process "
+                f"(local ok={local_ok}); all processes starting fresh.")
+            return template_state, 0
+        state = load_pytree_local(shard, template_state,
+                                  expect_timestep=t_resume)
+        # Host bookkeeping that every process needs to step identically
+        # (reward prices feed the device chunks) travels by broadcast from
+        # process 0; output-only fields (collector series, baseline list)
+        # stay rank-0 — only rank 0 writes results.
+        if prog is not None:
+            rps = np.asarray(prog["all_rps"], dtype=np.float64)
+            sps = np.asarray(prog["all_sps"], dtype=np.float64)
+        else:
+            rps = np.zeros(self.num_timesteps)
+            sps = np.zeros(self.num_timesteps)
+        self.all_rps = np.asarray(
+            multihost_utils.broadcast_one_to_all(rps), dtype=np.float64)
+        self.all_sps = np.asarray(
+            multihost_utils.broadcast_one_to_all(sps), dtype=np.float64)
+        # The setpoint tracker advances on EVERY process (gen_setpoint runs
+        # inside _collect_chunk everywhere), so its host state must resume
+        # consistently too: [present_flag, max_load, min_load, *tracked].
+        prev_n = int(self.config["agg"].get("rl", {}).get("prev_timesteps", 12))
+        tl = np.zeros(prev_n + 3)
+        if prog is not None and prog.get("tracked_loads") is not None:
+            tracked = list(prog["tracked_loads"])[:prev_n]
+            tl[0] = 1.0
+            tl[1] = float(prog["max_load"])
+            tl[2] = float(prog["min_load"])
+            tl[3:3 + len(tracked)] = tracked
+        tl = np.asarray(multihost_utils.broadcast_one_to_all(tl))
+        if tl[0] > 0:
+            self.max_load = float(tl[1])
+            self.min_load = float(tl[2])
+            self.tracked_loads = [float(v) for v in tl[3:]]
+        if jax.process_index() == 0 and prog is not None:
+            self._restore_from_progress(
+                os.path.join(self._checkpoint_root(), name), prog,
+                include_tracker=False)
+        self.timestep = t_resume
+        self.resumed_from = os.path.join(self._checkpoint_root(), name)
+        self.log.logger.info(
+            f"Resuming {self.case} from timestep {t_resume} "
+            f"(process {jax.process_index()}/{jax.process_count()}).")
         return state, self.timestep
 
     # ------------------------------------------------------------------ runs

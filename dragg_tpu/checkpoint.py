@@ -77,6 +77,109 @@ def load_pytree(path: str, template):
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
+# --------------------------------------------------- multi-host shard files
+#
+# Rank-0-only checkpoints (save_pytree above) require the whole global state
+# gathered to one process and a shared filesystem at resume.  A TPU pod's
+# workers usually have *separate* local disks, so instead each process dumps
+# exactly its OWN addressable block of every sharded leaf — no collective at
+# save time, and resume reassembles the global arrays from per-process files
+# via jax.make_array_from_process_local_data.  File layout per checkpoint
+# dir: ``state.proc00000-of-00004.npz`` etc.; replicated / host leaves are
+# written in full into every process's file so a non-shared FS restores
+# without any cross-process reads.
+
+
+def shard_file_name(process_index: int, process_count: int) -> str:
+    return f"state.proc{process_index:05d}-of-{process_count:05d}.npz"
+
+
+def _local_block(a) -> np.ndarray:
+    """This process's addressable block of ``a`` as one contiguous numpy
+    array.  Cross-process leaves here are sharded along exactly one axis in
+    contiguous per-process blocks (the home axis under NamedSharding); a
+    non-contiguous layout is a config error and raises loudly."""
+    if not isinstance(a, jax.Array) or a.is_fully_addressable:
+        return np.asarray(a)
+    blocks = {}
+    for s in a.addressable_shards:
+        key = tuple((sl.start or 0, sl.stop if sl.stop is not None else dim)
+                    for sl, dim in zip(s.index, a.shape))
+        blocks.setdefault(key, s.data)
+    if len(blocks) == 1:
+        return np.asarray(next(iter(blocks.values())))
+    # Distinct blocks must tile a contiguous range along one axis.
+    keys = sorted(blocks)
+    varying = [ax for ax in range(len(keys[0]))
+               if len({k[ax] for k in keys}) > 1]
+    if len(varying) != 1:
+        raise ValueError(
+            f"checkpoint shard layout not contiguous-1D: blocks {keys}")
+    ax = varying[0]
+    keys.sort(key=lambda k: k[ax][0])
+    for prev, nxt in zip(keys, keys[1:]):
+        if prev[ax][1] != nxt[ax][0]:
+            raise ValueError(
+                f"checkpoint shard blocks not contiguous along axis {ax}: {keys}")
+    return np.concatenate([np.asarray(blocks[k]) for k in keys], axis=ax)
+
+
+def save_pytree_local(path: str, tree, timestep: int) -> None:
+    """Write THIS process's blocks of ``tree`` (no collectives — safe to
+    call on every process concurrently).  ``timestep`` is stored inside the
+    file so resume can detect a torn multi-process checkpoint (some workers
+    crashed between writing shards and publishing LATEST)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    arrays = {f"leaf_{i:04d}": _local_block(l) for i, l in enumerate(leaves)}
+    arrays["__timestep__"] = np.asarray(timestep, np.int64)
+    tmp = f"{path}.tmp{jax.process_index()}.npz"
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, path)
+
+
+def load_pytree_local(path: str, template, expect_timestep: int | None = None):
+    """Load this process's shard file into ``template``'s structure.  Leaves
+    whose template is a cross-process jax.Array are rebuilt from the local
+    block via ``jax.make_array_from_process_local_data`` (a collective-free
+    constructor — but every process must call it for its own shard);
+    fully-addressable leaves restore exactly like :func:`load_pytree`."""
+    data = np.load(path)
+    if expect_timestep is not None and "__timestep__" in data.files:
+        got = int(data["__timestep__"])
+        if got != expect_timestep:
+            raise ValueError(
+                f"shard file {path} holds timestep {got}, expected "
+                f"{expect_timestep} (torn multi-process checkpoint)")
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    keys = sorted((k for k in data.files if k.startswith("leaf_")),
+                  key=lambda k: int(k.rsplit("_", 1)[1]))
+    if len(keys) != len(leaves):
+        raise ValueError(
+            f"Checkpoint {path} has {len(keys)} leaves; template has {len(leaves)}")
+    new_leaves = []
+    for key, tmpl in zip(keys, leaves):
+        arr = data[key]
+        if isinstance(tmpl, jax.Array) and not tmpl.is_fully_addressable:
+            want = _local_block(tmpl).shape
+            if tuple(arr.shape) != tuple(want):
+                raise ValueError(
+                    f"Checkpoint leaf {key} local block {arr.shape} != "
+                    f"template's local block {want}")
+            leaf = jax.make_array_from_process_local_data(
+                tmpl.sharding, arr.astype(tmpl.dtype), tmpl.shape)
+        else:
+            if tuple(arr.shape) != tuple(np.shape(tmpl)):
+                raise ValueError(
+                    f"Checkpoint leaf {key} shape {arr.shape} != template "
+                    f"{np.shape(tmpl)}")
+            if isinstance(tmpl, jax.Array):
+                leaf = jax.device_put(arr.astype(tmpl.dtype), tmpl.sharding)
+            else:
+                leaf = jax.numpy.asarray(arr, dtype=np.asarray(tmpl).dtype)
+        new_leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
 def save_progress(path: str, progress: dict) -> None:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:

@@ -194,6 +194,7 @@ class Engine:
 
         plan = (plan_for(_schur_structure_for(self.static.pattern), lay.m_eq)
                 if params.admm_banded_factor else None)
+        self._band_plan = plan
         self._solve_backend = resolve_backend(
             params.admm_solve_backend, batch.n_homes, lay.m_eq,
             plan is not None,
@@ -217,8 +218,78 @@ class Engine:
         self._solver_mesh = getattr(self, "mesh", None) \
             if getattr(self, "_mesh_shards", 1) > 1 else None
         self._solver_mesh_axis = getattr(self, "axis_name", "homes")
-        self._step_fn = jax.jit(self._step)
-        self._chunk_fn = jax.jit(self._chunk)
+        # Commit every per-home constant to the device once, so passing
+        # them into the jitted step as ARGUMENTS is pointer-cheap.  They
+        # must be arguments, not closure captures: XLA refuses to bake in
+        # constants that span processes (multi-host mesh), and argument
+        # passing keeps their NamedShardings first-class either way.
+        # (ShardedEngine re-commits these with explicit global shardings
+        # right after this constructor.)
+        self.batch = type(batch)(*[jnp.asarray(np.asarray(f)) for f in batch])
+        self._step_fn = jax.jit(self._step_entry)
+        self._chunk_fn = jax.jit(self._chunk_entry)
+
+    # ------------------------------------------------- traced constant tree
+    _CONST_ATTRS = ("_oat", "_ghi", "_tou", "_draws", "_tank", "_check_mask")
+    _STATIC_ARRAYS = ("vals", "a_in", "a_wh", "kin", "kwh", "awr")
+
+    def _consts(self):
+        """Every device-resident constant the traced step reads, gathered
+        into one pytree that is passed INTO the jitted entry points."""
+        st = self.static
+        return {
+            "attrs": {k: getattr(self, k) for k in self._CONST_ATTRS},
+            "static": {k: getattr(st, k) for k in self._STATIC_ARRAYS},
+            "batch": tuple(self.batch),
+        }
+
+    def _bound(self, consts):
+        """Context manager that swaps the constant attributes for the traced
+        values while the step functions trace, restoring the real arrays
+        after.  This keeps the step-code bodies reading ``self._oat`` etc.
+        while the compiled program receives those arrays as inputs."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            saved = (self.static, self.batch,
+                     {k: getattr(self, k) for k in self._CONST_ATTRS})
+            try:
+                for k, v in consts["attrs"].items():
+                    setattr(self, k, v)
+                self.static = self.static._replace(**consts["static"])
+                self.batch = type(self.batch)(*consts["batch"])
+                yield
+            finally:
+                self.static, self.batch = saved[0], saved[1]
+                for k, v in saved[2].items():
+                    setattr(self, k, v)
+
+        return cm()
+
+    def _step_entry(self, consts, state, t, rp, refresh, factor):
+        with self._bound(consts):
+            return self._step(state, t, rp, refresh, factor)
+
+    def _chunk_entry(self, consts, state, t0, rps):
+        with self._bound(consts):
+            return self._chunk(state, t0, rps)
+
+    @property
+    def band_bw(self) -> int | None:
+        """Bandwidth of the RCM band plan the solvers factor with (None when
+        the banded factorization is disabled) — the authoritative input to
+        bench.py's HBM-bandwidth model."""
+        return self._band_plan.bw if self._band_plan is not None else None
+
+    @property
+    def band_kernel(self) -> str:
+        """The RESOLVED band kernel ("pallas" | "xla") this engine compiled
+        with — "auto" has already been settled against the backend + the
+        Pallas compile self-test, so benchmark artifacts can record which
+        implementation actually ran (a silent self-test fallback would
+        otherwise be indistinguishable from 'pallas didn't help')."""
+        return self._band_kernel
 
     # ---------------------------------------------------------------- state
     def init_state(self) -> CommunityState:
@@ -537,6 +608,7 @@ class Engine:
         if getattr(self, "_factor0", None) is None:
             self._factor0 = self.init_factor()
         state, _, out = self._step_fn(
+            self._consts(),
             state, jnp.asarray(t), jnp.asarray(rp, dtype=jnp.float32),
             jnp.asarray(True), self._factor0,
         )
@@ -546,7 +618,8 @@ class Engine:
         """Run a chunk of timesteps with a device-side scan.  ``rps`` is
         (n_steps, H) reward prices (zeros for the baseline case).  Returns
         (final_state, outputs stacked along time)."""
-        return self._chunk_fn(state, jnp.asarray(t0), jnp.asarray(rps, dtype=jnp.float32))
+        return self._chunk_fn(self._consts(), state, jnp.asarray(t0),
+                              jnp.asarray(rps, dtype=jnp.float32))
 
     # ----------------------------------------------------------- profiling
     def phase_fns(self):
@@ -554,7 +627,17 @@ class Engine:
         the benchmark's per-phase timers.  Splitting loses cross-phase XLA
         fusion, so the phase-time sum slightly over-estimates the fused
         step — use for attribution, not as the headline rate."""
-        return jax.jit(self._prepare), jax.jit(self._solve), jax.jit(self._finish)
+        consts = self._consts()
+
+        def entry(fn):
+            def wrapped(c, *a):
+                with self._bound(c):
+                    return fn(*a)
+
+            jitted = jax.jit(wrapped)
+            return lambda *a: jitted(consts, *a)
+
+        return entry(self._prepare), entry(self._solve), entry(self._finish)
 
 
 def engine_params(config, start_index: int) -> EngineParams:

@@ -386,7 +386,11 @@ def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
         frozen, score = conv_fn(x, y, s_l, s_u, z_l, z_u)
         # Converged homes rank below any straggler; among stragglers the
         # largest residuals go first (all fit within k when frac is sized
-        # from the measured convergence CDF).
+        # from the measured convergence CDF).  A diverged home whose score
+        # is NaN has implementation-defined top_k ordering — rank it as
+        # worst (it needs the tail phase the most, or at least the final
+        # residual check must see its frozen non-finite state).
+        score = jnp.nan_to_num(score, nan=jnp.inf, posinf=jnp.inf)
         idx = lax.top_k(jnp.where(frozen, -1.0, score), k)[1]
         g = lambda a: a[idx]
         data2 = tuple(g(a) for a in data)

@@ -33,7 +33,34 @@ from jax import lax
 
 # Homes per kernel program (lane tiles of 128).  Env-tunable for on-chip
 # block-size experiments without code edits; 512 measured as the default.
-LANE_BLOCK = int(__import__("os").environ.get("DRAGG_LANE_BLOCK", 512))
+def _lane_block_from_env() -> int:
+    """Parse DRAGG_LANE_BLOCK defensively: a bad value must not make every
+    dragg_tpu import raise, and a non-multiple of 128 (the TPU lane width)
+    would break Mosaic lowering in a way the self-test only catches on
+    TPU — round it up and warn instead."""
+    import logging
+    import os
+
+    raw = os.environ.get("DRAGG_LANE_BLOCK", "")
+    try:
+        v = int(raw) if raw else 512
+    except ValueError:
+        logging.getLogger("dragg_tpu.pallas").warning(
+            "DRAGG_LANE_BLOCK=%r is not an integer; using 512", raw)
+        return 512
+    if v <= 0:
+        logging.getLogger("dragg_tpu.pallas").warning(
+            "DRAGG_LANE_BLOCK=%d must be positive; using 512", v)
+        return 512
+    rounded = -(-v // 128) * 128
+    if rounded != v:
+        logging.getLogger("dragg_tpu.pallas").warning(
+            "DRAGG_LANE_BLOCK=%d is not a multiple of the TPU lane width "
+            "(128); rounding up to %d", v, rounded)
+    return rounded
+
+
+LANE_BLOCK = _lane_block_from_env()
 
 
 _SELFTEST: bool | None = None

@@ -133,12 +133,15 @@ def run_rl_agg(agg) -> None:
     )
 
     @jax.jit
-    def chunk(carry, ts):
+    def chunk(consts, carry, ts):
         # The factor cache enters/leaves here so the checkpointed carry
-        # (and try_resume's template) never includes it.
-        (carry, _), stacked = lax.scan(
-            lambda c, t: step(c, t, ts[0]), (carry, agg.engine.init_factor()), ts
-        )
+        # (and try_resume's template) never includes it.  Engine constants
+        # arrive as arguments via the same _bound mechanism as
+        # Engine._chunk_entry (multi-host: no closing over global arrays).
+        with agg.engine._bound(consts):
+            (carry, _), stacked = lax.scan(
+                lambda c, t: step(c, t, ts[0]), (carry, agg.engine.init_factor()), ts
+            )
         return carry, stacked
 
     agg.checkpoint_interval = agg._checkpoint_steps()
@@ -160,7 +163,8 @@ def run_rl_agg(agg) -> None:
     chunks = 0
     while t < agg.num_timesteps:
         n_steps = min(agg.checkpoint_interval, agg.num_timesteps - t)
-        carry, (outs, recs, rps, sps) = chunk(carry, jnp.arange(t, t + n_steps))
+        carry, (outs, recs, rps, sps) = chunk(agg.engine._consts(), carry,
+                                              jnp.arange(t, t + n_steps))
         agg._collect_chunk(outs, track_setpoints=False)
         agent.record_chunk(recs)
         agg.all_rps[t:t + n_steps] = np.asarray(rps)

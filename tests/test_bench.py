@@ -23,11 +23,16 @@ def test_bench_smoke_contract():
     assert len(lines) == 1, f"expected ONE json line, got: {proc.stdout!r}"
     result = json.loads(lines[0])
     for key in ("metric", "value", "unit", "vs_baseline", "solver",
-                "solve_rate", "phase_s_per_step", "admm_iters_per_step"):
+                "solve_rate", "phase_s_per_step", "admm_iters_per_step",
+                "band_kernel", "pallas_selftest"):
         assert key in result, key
     assert result["unit"] == "timesteps/s"
     assert result["value"] > 0
     assert 0.5 <= result["solve_rate"] <= 1.0
+    # On the CPU smoke run the resolved kernel must be the XLA path and the
+    # Pallas self-test must not have been attempted.
+    assert result["band_kernel"] == "xla"
+    assert result["pallas_selftest"] is None
 
 
 def test_validate_scale_smoke():

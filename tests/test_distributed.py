@@ -1,0 +1,238 @@
+"""Multi-host (multi-process) tests run as N local CPU processes.
+
+The reference scales across hosts by launching one pathos process per home
+against a shared Redis (dragg/aggregator.py:723-724); here the equivalent is
+one JAX program spanning processes (deploy/launch_tpu_pod.sh +
+``DRAGG_DISTRIBUTED=1``).  These tests exercise that path for real — two
+OS processes, gloo CPU collectives, a device mesh spanning both — covering:
+
+* the ``python -m dragg_tpu run`` multi-host init path (VERDICT r2 #6);
+* per-process shard checkpoints + broadcast-coordinated resume on
+  SEPARATE outputs directories, i.e. the non-shared-filesystem pod case
+  (VERDICT r2 #7, ADVICE r2 aggregator.try_resume finding).
+
+Each subprocess gets its own coordinator port (OS-assigned, freed just
+before use) and 2 virtual CPU devices, so the global mesh is 4-wide.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _toml_dump(d: dict) -> str:
+    """Minimal TOML writer for the config dict (flat scalar/list values in
+    nested tables — all default_config ever contains)."""
+
+    def fmt(v):
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, (int, float)):
+            return repr(v)
+        if isinstance(v, str):
+            return json.dumps(v)
+        if isinstance(v, list):
+            return "[" + ", ".join(fmt(x) for x in v) + "]"
+        raise TypeError(f"cannot TOML-serialize {type(v).__name__}")
+
+    lines: list[str] = []
+
+    def walk(table: dict, prefix: str) -> None:
+        scalars = {k: v for k, v in table.items() if not isinstance(v, dict)}
+        subs = {k: v for k, v in table.items() if isinstance(v, dict)}
+        if prefix and scalars:
+            lines.append(f"[{prefix}]")
+        for k, v in scalars.items():
+            lines.append(f"{k} = {fmt(v)}")
+        for k, v in subs.items():
+            walk(v, f"{prefix}.{k}" if prefix else k)
+
+    walk(d, "")
+    return "\n".join(lines) + "\n"
+
+
+def _tiny_cfg_dict(days: int = 1, resume: bool = False) -> dict:
+    from dragg_tpu.config import default_config
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = 4
+    cfg["community"]["homes_pv"] = 1
+    cfg["community"]["homes_battery"] = 1
+    cfg["community"]["homes_pv_battery"] = 1
+    cfg["simulation"]["start_datetime"] = "2015-01-01 00"
+    cfg["simulation"]["end_datetime"] = f"2015-01-0{1 + days} 00"
+    cfg["simulation"]["checkpoint_interval"] = "daily"
+    cfg["simulation"]["resume"] = resume
+    cfg["home"]["hems"]["prediction_horizon"] = 2
+    cfg["tpu"]["admm_iters"] = 200
+    return cfg
+
+
+def _launch_pair(cmd_for, env_extra, timeout=600):
+    """Run process 0 and 1 concurrently; return their CompletedProcess-like
+    (rc, out) pairs.  ``cmd_for(pid)`` builds each argv."""
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # axon plugin hooks interpreter start
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "DRAGG_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "DRAGG_NUM_PROCESSES": "2",
+            "DRAGG_PROCESS_ID": str(pid),
+        })
+        env.update(env_extra)
+        procs.append(subprocess.Popen(
+            cmd_for(pid), env=env, cwd=ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out))
+    return outs
+
+
+def test_distributed_run_entry_two_process(tmp_path):
+    """`python -m dragg_tpu run` with DRAGG_DISTRIBUTED=1 as two CPU
+    processes: the real multi-host entry (deploy/launch_tpu_pod.sh:48-60)
+    initializes, runs one simulated day over the 4-device global mesh, and
+    only process 0 writes results."""
+    from dragg_tpu.config import default_config  # noqa: F401 — import check
+
+    cfg = _tiny_cfg_dict(days=1)
+    cfg_path = str(tmp_path / "config.toml")
+    with open(cfg_path, "w") as f:
+        f.write(_toml_dump(cfg))
+    outs_dir = {pid: str(tmp_path / f"host{pid}") for pid in range(2)}
+
+    results = _launch_pair(
+        lambda pid: [sys.executable, "-m", "dragg_tpu", "run",
+                     "--config", cfg_path, "--outputs-dir", outs_dir[pid]],
+        env_extra={"DRAGG_DISTRIBUTED": "1"},
+    )
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"process {pid} failed:\n{out[-4000:]}"
+
+    # Rank 0 wrote the full-length results; rank 1's "disk" has none
+    # (write_outputs is rank-0-gated — aggregator.py).
+    found = []
+    for root, _, files in os.walk(outs_dir[0]):
+        if "results.json" in files:
+            found.append(os.path.join(root, "results.json"))
+    assert found, "process 0 wrote no results.json"
+    res = json.load(open(found[0]))
+    a_home = next(n for n in res if n != "Summary")
+    assert len(res[a_home]["p_grid_opt"]) == 24
+    for root, _, files in os.walk(outs_dir[1]):
+        assert "results.json" not in files
+
+
+_DRIVER = textwrap.dedent("""
+    import json, os, sys
+    import jax
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(os.environ["DRAGG_COORDINATOR_ADDRESS"],
+                               int(os.environ["DRAGG_NUM_PROCESSES"]),
+                               int(os.environ["DRAGG_PROCESS_ID"]))
+    sys.path.insert(0, {root!r})
+    sys.path.insert(0, os.path.join({root!r}, "tests"))
+    from test_distributed import _tiny_cfg_dict
+    from dragg_tpu.aggregator import Aggregator
+
+    mode = sys.argv[1]            # full | partial | resume
+    outputs_dir = sys.argv[2]
+    cfg = _tiny_cfg_dict(days=2, resume=(mode == "resume"))
+    agg = Aggregator(cfg, data_dir=None, outputs_dir=outputs_dir)
+    if mode == "partial":
+        agg.stop_after_chunks = 1
+    agg.run()
+    print("DRIVER_DONE", mode, "resumed_from", agg.resumed_from, flush=True)
+""")
+
+
+def test_distributed_checkpoint_resume_bit_exact(tmp_path):
+    """Non-shared-FS pod resume: two processes checkpoint to SEPARATE
+    outputs directories (each holding only its own state shard), the run is
+    interrupted, and the resumed 2-process run reproduces the uninterrupted
+    2-process run's results bit-exactly."""
+    driver = str(tmp_path / "driver.py")
+    with open(driver, "w") as f:
+        f.write(_DRIVER.format(root=ROOT))
+
+    def run_mode(mode, base):
+        dirs = {pid: str(tmp_path / base / f"host{pid}") for pid in range(2)}
+        results = _launch_pair(
+            lambda pid: [sys.executable, driver, mode, dirs[pid]],
+            env_extra={})
+        for pid, (rc, out) in enumerate(results):
+            assert rc == 0, f"{mode} process {pid} failed:\n{out[-4000:]}"
+            assert "DRIVER_DONE" in out
+        return dirs, results
+
+    # Uninterrupted 2-process reference.
+    full_dirs, _ = run_mode("full", "full")
+
+    def results_json(dirs):
+        for root, _, files in os.walk(dirs[0]):
+            if "results.json" in files:
+                return json.load(open(os.path.join(root, "results.json")))
+        raise AssertionError("no results.json under " + dirs[0])
+
+    expected = results_json(full_dirs)
+
+    # Interrupted run in fresh directories, then resume in the SAME ones.
+    part_dirs, _ = run_mode("partial", "resumed")
+    # Both hosts hold their own shard of the checkpoint; host1 has no
+    # progress.json (rank-0-only) — exactly the non-shared-FS layout.
+    ck0 = ck1 = None
+    for pid, d in part_dirs.items():
+        for root, _, files in os.walk(d):
+            for fn in files:
+                if fn.startswith("state.proc"):
+                    if pid == 0:
+                        ck0 = os.path.join(root, fn)
+                    else:
+                        ck1 = os.path.join(root, fn)
+    assert ck0 and "proc00000-of-00002" in ck0
+    assert ck1 and "proc00001-of-00002" in ck1
+
+    _, resume_results = run_mode("resume", "resumed")
+    assert any("resumed_from" in out and "ckpt_t" in out
+               for _, out in resume_results), \
+        "resume run did not actually resume from a checkpoint"
+    got = results_json(part_dirs)
+
+    for name in expected:
+        if name == "Summary":
+            continue
+        for key, vals in expected[name].items():
+            if isinstance(vals, list):
+                np.testing.assert_array_equal(
+                    np.asarray(vals), np.asarray(got[name][key]),
+                    err_msg=f"{name}.{key} diverged across distributed resume")
+    np.testing.assert_array_equal(
+        np.asarray(expected["Summary"]["p_grid_aggregate"]),
+        np.asarray(got["Summary"]["p_grid_aggregate"]))
