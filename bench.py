@@ -101,6 +101,10 @@ def run_measured(args) -> dict:
 
     if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    from dragg_tpu.utils.compile_cache import enable_compile_cache
+
+    cache_dir = enable_compile_cache()
+    _log(f"compile cache: {cache_dir}")
     _log(f"initializing backend (platform={args.platform})...")
     dev = jax.devices()[0]
     platform = dev.platform

@@ -19,7 +19,6 @@ from __future__ import annotations
 import json
 import os
 import time
-from datetime import timedelta
 
 import numpy as np
 
@@ -126,6 +125,11 @@ class Aggregator:
         self.version = self.config["simulation"].get("named_version", "test")
         self.run_dir = None
         self._solve_iters: list[int] = []
+        # Persistent XLA compilation cache: a re-run of the same config
+        # skips the 20-40 s cold compile entirely (docs/perf_notes.md).
+        from dragg_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache(self.config)
 
     # ----------------------------------------------------------- population
     def get_homes(self) -> None:

@@ -219,6 +219,10 @@ _DEFAULT: dict[str, Any] = {
         "forecast_noise_cap": 3.0,  # max forecast-noise std (degC): the reference's
                                     # unbounded 1.1^k growth breaks the season gate
                                     # beyond ~16h horizons (see engine._prepare)
+        "compile_cache": True,  # persistent XLA compilation cache: re-runs of
+                                # the same config skip the cold compile
+        "compile_cache_dir": "",  # cache location ("" = $DRAGG_COMPILE_CACHE_DIR
+                                  # or ~/.cache/dragg_tpu/xla)
         "admm_rho": 0.1,
         "admm_sigma": 1e-6,
         "admm_reg": 1e-3,

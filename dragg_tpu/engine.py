@@ -31,7 +31,6 @@ parallel layer shards over the TPU mesh.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax

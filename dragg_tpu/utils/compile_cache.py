@@ -1,0 +1,62 @@
+"""Persistent XLA compilation cache wiring.
+
+Cold-start compiles are pure latency on every run — 20.9 s for the 10k-home
+chunk on chip, and even the 50-home smoke bench pays ~20 s (docs/
+perf_notes.md).  JAX can persist compiled executables keyed by (HLO,
+backend, flags) so the SECOND process-level run of the same config skips
+XLA entirely.  The reference has no analog (CVXPY re-canonicalizes every
+process; GLPK has no compile step) — this is a TPU-stack-specific cost and
+win.
+
+Enabled by default (``tpu.compile_cache = true``); the directory resolves
+from ``tpu.compile_cache_dir`` → ``$DRAGG_COMPILE_CACHE_DIR`` →
+``$JAX_COMPILATION_CACHE_DIR`` → ``~/.cache/dragg_tpu/xla``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_log = logging.getLogger("dragg_tpu.compile_cache")
+_ENABLED_DIR: str | None = None
+
+
+def enable_compile_cache(config: dict | None = None) -> str | None:
+    """Idempotently enable JAX's persistent compilation cache; returns the
+    cache directory, or None when disabled (``tpu.compile_cache = false``)
+    or unavailable.  Safe to call before or after backend initialization —
+    the cache config is read at compile time."""
+    global _ENABLED_DIR
+    tpu_cfg = (config or {}).get("tpu", {})
+    if not tpu_cfg.get("compile_cache", True):
+        return None
+    if _ENABLED_DIR is not None:
+        return _ENABLED_DIR
+    cache_dir = (
+        str(tpu_cfg.get("compile_cache_dir") or "")
+        or os.environ.get("DRAGG_COMPILE_CACHE_DIR", "")
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
+        or os.path.join(os.path.expanduser("~"), ".cache", "dragg_tpu", "xla")
+    )
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Persist everything that took any real compile time; the default
+        # 1 s floor would skip most of the small per-phase programs whose
+        # compiles still add up across a sweep.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # Bound the directory: JAX never evicts without a cap, and every
+        # distinct (homes, horizon, solver) combination persists entries —
+        # sweeps would grow it monotonically.  2 GiB holds hundreds of
+        # full-size community programs; LRU eviction handles the rest.
+        jax.config.update("jax_compilation_cache_max_size",
+                          2 * 1024 * 1024 * 1024)
+        _ENABLED_DIR = cache_dir
+        return cache_dir
+    except Exception as e:  # never let cache plumbing sink a run
+        _log.warning("persistent compilation cache unavailable (%r)", e)
+        return None

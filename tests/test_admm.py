@@ -2,7 +2,6 @@
 against a CPU reference on identical matrices, <=1% objective-cost gap)."""
 
 import numpy as np
-import pytest
 import scipy.optimize
 
 import jax.numpy as jnp

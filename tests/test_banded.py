@@ -7,10 +7,7 @@ import pytest
 import jax.numpy as jnp
 
 from dragg_tpu.ops.banded import (
-    BandPlan,
-    band_scatter,
     banded_cholesky,
-    banded_explicit_inverse,
     banded_forward_solve,
     plan_for,
     rcm_order,

@@ -13,7 +13,6 @@ actually ships:
   Settlement Point Price (dragg/aggregator.py:167-204; xlsx needs openpyxl).
 """
 
-import os
 from datetime import datetime
 
 import numpy as np

@@ -25,7 +25,6 @@ import sys
 import textwrap
 
 import numpy as np
-import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

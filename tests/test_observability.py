@@ -4,7 +4,6 @@ logs, the VERBOSE solver telemetry toggle, and reset_seed."""
 import os
 
 import numpy as np
-import pytest
 
 from dragg_tpu.aggregator import Aggregator
 from dragg_tpu.config import default_config

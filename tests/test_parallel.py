@@ -2,7 +2,6 @@
 (SURVEY.md §4(f): CPU-mesh emulation stands in for real ICI)."""
 
 import numpy as np
-import pytest
 
 import jax
 

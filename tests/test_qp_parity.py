@@ -17,7 +17,7 @@ from dragg_tpu.data import load_environment
 from dragg_tpu.engine import make_engine
 from dragg_tpu.homes import build_home_batch, create_homes
 from dragg_tpu.data import load_waterdraw_profiles
-from dragg_tpu.ops.admm import admm_solve, admm_solve_qp
+from dragg_tpu.ops.admm import admm_solve_qp
 from dragg_tpu.ops.qp import TAP_TEMP, assemble_qp_step, densify_A
 
 import jax.numpy as jnp

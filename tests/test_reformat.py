@@ -224,7 +224,7 @@ def test_cli_parser():
 def test_dashboard_index_and_figures(finished_run):
     """The plotter.py-equivalent webapp renders an index over the discovered
     runs and serves every comparison figure as SVG."""
-    from dragg_tpu.dashboard import FIGURES, Dashboard
+    from dragg_tpu.dashboard import Dashboard
 
     cfg, out, agg = finished_run
     dash = Dashboard(config=cfg, outputs_dir=out)

@@ -13,7 +13,6 @@ from jax import lax
 from dragg_tpu.config import default_config
 from dragg_tpu.rl import neural
 from dragg_tpu.rl.core import (
-    AgentParams,
     RLObservation,
     _phi_s,
     init_carry as linear_init,

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Dependency-free lint, runnable in the hermetic build image.
+
+Mirrors the enforcement the reference gets from its pre-commit suite
+(reference .pre-commit-config.yaml: flake8, autoflake, check-ast) with
+what the stdlib can check:
+
+* every Python file parses (`check-ast` parity);
+* no unused imports (autoflake parity; `# noqa` opt-out honored);
+* no tabs in indentation, no trailing whitespace, newline at EOF.
+
+The full flake8/autoflake hooks run via .pre-commit-config.yaml and CI
+where those tools are installable; this script is the offline floor and
+is itself wired into CI so the two can't drift silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SKIP_DIRS = {".git", "__pycache__", ".cache", "outputs", "native/_build",
+             ".pytest_cache", ".claude"}
+
+
+def iter_py_files():
+    for base, dirs, files in os.walk(ROOT):
+        dirs[:] = [d for d in dirs
+                   if d not in SKIP_DIRS and not d.startswith(".")]
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(base, f)
+
+
+class ImportUsage(ast.NodeVisitor):
+    def __init__(self):
+        self.imported: dict[str, int] = {}   # bound name -> lineno
+        self.used: set[str] = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = a.asname or a.name.split(".")[0]
+            self.imported[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imported[a.asname or a.name] = node.lineno
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def check_file(path: str) -> list[str]:
+    problems = []
+    rel = os.path.relpath(path, ROOT)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
+
+    lines = src.splitlines()
+    for i, line in enumerate(lines, 1):
+        if line != line.rstrip():
+            problems.append(f"{rel}:{i}: trailing whitespace")
+        if line[:len(line) - len(line.lstrip())].count("\t"):
+            problems.append(f"{rel}:{i}: tab in indentation")
+    if src and not src.endswith("\n"):
+        problems.append(f"{rel}:{len(lines)}: no newline at end of file")
+
+    uses = ImportUsage()
+    uses.visit(tree)
+    # Names referenced in __all__ or docstring-level re-export idioms count.
+    for name, lineno in sorted(uses.imported.items(), key=lambda kv: kv[1]):
+        if name in uses.used or name == "annotations":
+            continue
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        if "noqa" in line:
+            continue
+        if f'"{name}"' in src or f"'{name}'" in src:  # __all__ / getattr use
+            continue
+        problems.append(f"{rel}:{lineno}: unused import '{name}'")
+    return problems
+
+
+def main() -> int:
+    all_problems = []
+    n = 0
+    for path in sorted(iter_py_files()):
+        n += 1
+        all_problems.extend(check_file(path))
+    for p in all_problems:
+        print(p)
+    print(f"lint: {n} files, {len(all_problems)} problem(s)",
+          file=sys.stderr)
+    return 1 if all_problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
