@@ -340,7 +340,7 @@ def _admm_impl(
         # Bind the kernel family once per trace (band_kernel is static):
         # pallas uses TRANSPOSED (m, bw+1, B) band storage and one fused
         # kernel per solve, xla the (B, m, bw+1) scan path.
-        scatter_fn, chol_fn, band_solve_fn, _ = pallas_band.make_band_ops(
+        scatter_fn, chol_fn, band_solve_fn, _, _ = pallas_band.make_band_ops(
             band_plan, band_kernel, mesh=mesh, mesh_axis=mesh_axis)
 
     def factor(rho_b):

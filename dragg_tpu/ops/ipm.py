@@ -174,7 +174,8 @@ def ipm_solve_qp(
     # Shared pallas/xla dispatch (ops/pallas_band.make_band_ops): pallas =
     # transposed (m, bw+1, B) storage + one fused kernel per refined solve,
     # xla = (B, m, bw+1) scans.  Same recurrences either way.
-    scatter_fn, chol_fn, band_solve_fn, add_diag_fn = pallas_band.make_band_ops(
+    (scatter_fn, _chol_fn, band_solve_fn, add_diag_fn,
+     factor_solve_fn) = pallas_band.make_band_ops(
         plan, band_kernel, mesh=mesh, mesh_axis=mesh_axis)
 
     # The Mehrotra loop is built by a factory over the per-home data so it
@@ -187,8 +188,9 @@ def ipm_solve_qp(
         (x, y, s_l, s_u, z_l, z_u),
         dict(row_cols=row_cols, col_rows=col_rows, perm_ix=perm_ix,
              invp_ix=invp_ix, schur=schur,
-             scatter_fn=scatter_fn, chol_fn=chol_fn,
+             scatter_fn=scatter_fn,
              band_solve_fn=band_solve_fn, add_diag_fn=add_diag_fn,
+             factor_solve_fn=factor_solve_fn,
              plan=plan, band_kernel=band_kernel, mesh_axis=mesh_axis),
         # final-residual extras (full-batch):
         dict(e_eq=e_eq, e_box=e_box, c=c, d=d, l_box=l_box, u_box=u_box,
@@ -202,8 +204,9 @@ def _make_loop(data, shared, eps_abs, eps_rel):
     row_cols, col_rows = shared["row_cols"], shared["col_rows"]
     perm_ix, invp_ix = shared["perm_ix"], shared["invp_ix"]
     schur = shared["schur"]
-    scatter_fn, chol_fn = shared["scatter_fn"], shared["chol_fn"]
+    scatter_fn = shared["scatter_fn"]
     band_solve_fn, add_diag_fn = shared["band_solve_fn"], shared["add_diag_fn"]
+    factor_solve_fn = shared["factor_solve_fn"]
 
     def mv(x):
         return jnp.sum(vp_r * x[:, row_cols], axis=2)
@@ -219,6 +222,18 @@ def _make_loop(data, shared, eps_abs, eps_rel):
         dy = band_solve_fn(Lb, Sb, rhs[:, perm_ix], refine)[:, invp_ix]
         dx = theta_inv * (r1 - mvt(dy))
         return dx, dy
+
+    def factor_solve_kkt(Sb, theta_inv, r1, r2):
+        """solve_kkt with the band factor computed IN the same call —
+        factor + first solve run as one fused kernel on the pallas path.
+        Same rhs construction and back-substitution as solve_kkt (keep the
+        two in lockstep); returns (Lb, dx, dy) so later solves against the
+        same factor use solve_kkt."""
+        rhs = mv(theta_inv * r1) - r2
+        Lb, dy_p = factor_solve_fn(Sb, rhs[:, perm_ix], 0)
+        dy = dy_p[:, invp_ix]
+        dx = theta_inv * (r1 - mvt(dy))
+        return Lb, dx, dy
 
     def converged(x, y, s_l, s_u, z_l, z_u):
         """Per-home convergence in the scaled space (loop-internal freeze
@@ -250,9 +265,9 @@ def _make_loop(data, shared, eps_abs, eps_rel):
         theta_inv = 1.0 / theta
         contrib = schur_contrib(schur, vals_s, theta_inv)
         Sb = add_diag_fn(scatter_fn(contrib), 1e-6)  # Tikhonov the diagonal
-        Lb = chol_fn(Sb)
 
-        # Residuals.
+        # Residuals (factor-independent — computed BEFORE the factor so the
+        # predictor rhs is ready for the fused factor+solve kernel).
         r_dual = -(reg_s * x + qs + mvt(y) - z_l + z_u)        # stationarity
         r_prim = bs - mv(x)                                     # equality
         r_sl = jnp.where(fin_l, x - ls - s_l, 0.0)
@@ -268,8 +283,11 @@ def _make_loop(data, shared, eps_abs, eps_rel):
         # the Mehrotra cross terms — refinement there buys nothing
         # measurable (H=24: identical convergence; H=48 engine-day: solve
         # rate 0.9927 vs 0.9901 — docs/perf_notes.md) and costs two extra
-        # substitution passes + a matvec per iteration.
-        dx_a, dy_a = solve_kkt(Lb, Sb, theta_inv, r1, r_prim, refine=0)
+        # substitution passes + a matvec per iteration.  Factor + predictor
+        # solve run as ONE fused kernel on the pallas path (the factor
+        # stays in VMEM for its first consumer); the corrector below
+        # re-reads the emitted factor.
+        Lb, dx_a, dy_a = factor_solve_kkt(Sb, theta_inv, r1, r_prim)
         ds_l_a = jnp.where(fin_l, r_sl + dx_a, 0.0)
         ds_u_a = jnp.where(fin_u, r_su - dx_a, 0.0)
         dz_l_a = jnp.where(fin_l, (rc_l - z_l * ds_l_a) / s_l, 0.0)
@@ -402,10 +420,11 @@ def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
             # Inside the shard_map region the band ops must be the PLAIN
             # per-shard kernels — the mesh-wrapped ones in ``shared`` would
             # nest shard_map.
-            sc, ch, so, ad = pallas_band.make_band_ops(
+            sc, _ch, so, ad, fs = pallas_band.make_band_ops(
                 shared["plan"], shared["band_kernel"], mesh=None)
-            shared_t = dict(shared, scatter_fn=sc, chol_fn=ch,
-                            band_solve_fn=so, add_diag_fn=ad)
+            shared_t = dict(shared, scatter_fn=sc,
+                            band_solve_fn=so, add_diag_fn=ad,
+                            factor_solve_fn=fs)
 
         def tail_phase(data_l, x, y, s_l, s_u, z_l, z_u):
             """Rank, gather, and finish the worst-k stragglers of one

@@ -28,7 +28,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 # Homes per kernel program (lane tiles of 128).  Env-tunable for on-chip
@@ -110,10 +109,14 @@ def _run_self_test() -> bool:
         Lb = banded_cholesky_t(Sb, bw)
         x = refined_banded_solve_t(Lb, Sb, jnp.swapaxes(r, 0, 1), bw,
                                    refine=1)
+        Lb2, x2 = factor_refined_solve_t(Sb, jnp.swapaxes(r, 0, 1), bw,
+                                         refine=1)
         ok = bool(
             jnp.all(jnp.isfinite(x))
             & jnp.all(jnp.abs(jnp.transpose(Lb, (2, 0, 1)) - L_ref) < 1e-5)
             & jnp.all(jnp.abs(jnp.swapaxes(x, 0, 1) - x_ref) < 1e-4)
+            & jnp.all(jnp.abs(Lb2 - Lb) < 1e-6)
+            & jnp.all(jnp.abs(x2 - x) < 1e-5)
         )
         if not ok:
             import logging
@@ -142,7 +145,9 @@ def _unit_row(bwp1: int, Bt: int, dtype) -> jnp.ndarray:
 
 
 # ----------------------------------------------------------------- cholesky
-def _chol_kernel(s_ref, l_ref, *, m: int, bw: int):
+def _chol_body(s_ref, l_ref, *, m: int, bw: int):
+    """In-kernel band Cholesky: l_ref ← factor(s_ref), row by row.  Shared
+    by the standalone factor kernel and the fused factor+solve kernel."""
     from jax.experimental import pallas as pl
 
     bwp1 = bw + 1
@@ -175,23 +180,29 @@ def _chol_kernel(s_ref, l_ref, *, m: int, bw: int):
     lax.fori_loop(0, m, row_step, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("bw",))
-def banded_cholesky_t(Sb_t: jnp.ndarray, bw: int) -> jnp.ndarray:
+def _chol_kernel(s_ref, l_ref, *, m: int, bw: int):
+    _chol_body(s_ref, l_ref, m=m, bw=bw)
+
+
+@functools.partial(jax.jit, static_argnames=("bw", "lane_block"))
+def banded_cholesky_t(Sb_t: jnp.ndarray, bw: int,
+                      lane_block: int | None = None) -> jnp.ndarray:
     """Batched band Cholesky in transposed storage: (m, bw+1, B) → L same
-    layout, one kernel per LANE_BLOCK homes."""
+    layout, one kernel per ``lane_block`` (default LANE_BLOCK) homes."""
     from jax.experimental import pallas as pl
 
+    lb = lane_block or LANE_BLOCK
     m, bwp1, B = Sb_t.shape
-    Bp = -(-B // LANE_BLOCK) * LANE_BLOCK
+    Bp = -(-B // lb) * lb
     if Bp != B:
         pad = jnp.zeros((m, bwp1, Bp - B), Sb_t.dtype).at[:, 0, :].set(1.0)
         Sb_t = jnp.concatenate([Sb_t, pad], axis=-1)
     out = pl.pallas_call(
         functools.partial(_chol_kernel, m=m, bw=bw),
         out_shape=jax.ShapeDtypeStruct((m, bwp1, Bp), Sb_t.dtype),
-        grid=(Bp // LANE_BLOCK,),
-        in_specs=[pl.BlockSpec((m, bwp1, LANE_BLOCK), lambda b: (0, 0, b))],
-        out_specs=pl.BlockSpec((m, bwp1, LANE_BLOCK), lambda b: (0, 0, b)),
+        grid=(Bp // lb,),
+        in_specs=[pl.BlockSpec((m, bwp1, lb), lambda b: (0, 0, b))],
+        out_specs=pl.BlockSpec((m, bwp1, lb), lambda b: (0, 0, b)),
         interpret=_interpret(),
     )(Sb_t)
     return out[:, :, :B]
@@ -260,10 +271,11 @@ def _refined_solve_kernel(l_ref, s_ref, r_ref, out_ref, y_ref, t_ref, *,
         out_ref[:] = out_ref[:] + t_ref[:]
 
 
-@functools.partial(jax.jit, static_argnames=("bw", "refine"))
+@functools.partial(jax.jit, static_argnames=("bw", "refine", "lane_block"))
 def refined_banded_solve_t(Lb_t: jnp.ndarray, Sb_t: jnp.ndarray,
                            r_t: jnp.ndarray, bw: int,
-                           refine: int = 1) -> jnp.ndarray:
+                           refine: int = 1,
+                           lane_block: int | None = None) -> jnp.ndarray:
     """x ≈ S⁻¹ r via band factor + ``refine`` iterative-refinement passes,
     fused into ONE kernel (the XLA path runs 2(1+refine) scans + a matvec).
 
@@ -271,8 +283,9 @@ def refined_banded_solve_t(Lb_t: jnp.ndarray, Sb_t: jnp.ndarray,
     """
     from jax.experimental import pallas as pl
 
+    lb = lane_block or LANE_BLOCK
     m, bwp1, B = Lb_t.shape
-    Bp = -(-B // LANE_BLOCK) * LANE_BLOCK
+    Bp = -(-B // lb) * lb
     if Bp != B:
         padL = jnp.zeros((m, bwp1, Bp - B), Lb_t.dtype).at[:, 0, :].set(1.0)
         Lb_t = jnp.concatenate([Lb_t, padL], axis=-1)
@@ -283,20 +296,77 @@ def refined_banded_solve_t(Lb_t: jnp.ndarray, Sb_t: jnp.ndarray,
     out = pl.pallas_call(
         functools.partial(_refined_solve_kernel, m=m, bw=bw, refine=refine),
         out_shape=jax.ShapeDtypeStruct((m, Bp), r_t.dtype),
-        grid=(Bp // LANE_BLOCK,),
+        grid=(Bp // lb,),
         in_specs=[
-            pl.BlockSpec((m, bwp1, LANE_BLOCK), lambda b: (0, 0, b)),
-            pl.BlockSpec((m, bwp1, LANE_BLOCK), lambda b: (0, 0, b)),
-            pl.BlockSpec((m, LANE_BLOCK), lambda b: (0, b)),
+            pl.BlockSpec((m, bwp1, lb), lambda b: (0, 0, b)),
+            pl.BlockSpec((m, bwp1, lb), lambda b: (0, 0, b)),
+            pl.BlockSpec((m, lb), lambda b: (0, b)),
         ],
-        out_specs=pl.BlockSpec((m, LANE_BLOCK), lambda b: (0, b)),
+        out_specs=pl.BlockSpec((m, lb), lambda b: (0, b)),
         scratch_shapes=[
-            pltpu.VMEM((m, LANE_BLOCK), r_t.dtype),
-            pltpu.VMEM((m, LANE_BLOCK), r_t.dtype),
+            pltpu.VMEM((m, lb), r_t.dtype),
+            pltpu.VMEM((m, lb), r_t.dtype),
         ],
         interpret=_interpret(),
     )(Lb_t, Sb_t, r_t)
     return out[:, :B]
+
+
+# ----------------------------------------------- fused factor + first solve
+def _factor_solve_kernel(s_ref, r_ref, l_ref, out_ref, y_ref, t_ref, *,
+                         m: int, bw: int, refine: int):
+    """Band Cholesky AND the first refined solve in one kernel: the factor
+    stays VMEM-resident for the solve instead of round-tripping through HBM
+    between two launches.  The IPM consumes this for the predictor step
+    (whose rhs is factor-independent); the corrector re-reads the emitted
+    ``l_ref`` through the plain solve kernel."""
+    _chol_body(s_ref, l_ref, m=m, bw=bw)
+    _solve_into(l_ref, r_ref, y_ref, out_ref, m=m, bw=bw)
+    for _ in range(refine):
+        t_ref[:] = r_ref[:] - _band_matvec_body(s_ref, out_ref[:], m=m, bw=bw)
+        _solve_into(l_ref, t_ref, y_ref, t_ref, m=m, bw=bw)
+        out_ref[:] = out_ref[:] + t_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("bw", "refine", "lane_block"))
+def factor_refined_solve_t(Sb_t: jnp.ndarray, r_t: jnp.ndarray, bw: int,
+                           refine: int = 0, lane_block: int | None = None,
+                           ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(L, x) with x ≈ S⁻¹ r — factor + first solve fused into ONE kernel.
+
+    Identical recurrences and operation order to ``banded_cholesky_t``
+    followed by ``refined_banded_solve_t`` (parity pinned in
+    tests/test_pallas_band.py), one fewer launch and one fewer HBM pass
+    over the (m, bw+1, B) factor per call.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    lb = lane_block or LANE_BLOCK
+    m, bwp1, B = Sb_t.shape
+    Bp = -(-B // lb) * lb
+    if Bp != B:
+        pad = jnp.zeros((m, bwp1, Bp - B), Sb_t.dtype).at[:, 0, :].set(1.0)
+        Sb_t = jnp.concatenate([Sb_t, pad], axis=-1)
+        r_t = jnp.concatenate([r_t, jnp.zeros((m, Bp - B), r_t.dtype)], axis=-1)
+    L, x = pl.pallas_call(
+        functools.partial(_factor_solve_kernel, m=m, bw=bw, refine=refine),
+        out_shape=(jax.ShapeDtypeStruct((m, bwp1, Bp), Sb_t.dtype),
+                   jax.ShapeDtypeStruct((m, Bp), r_t.dtype)),
+        grid=(Bp // lb,),
+        in_specs=[
+            pl.BlockSpec((m, bwp1, lb), lambda b: (0, 0, b)),
+            pl.BlockSpec((m, lb), lambda b: (0, b)),
+        ],
+        out_specs=(pl.BlockSpec((m, bwp1, lb), lambda b: (0, 0, b)),
+                   pl.BlockSpec((m, lb), lambda b: (0, b))),
+        scratch_shapes=[
+            pltpu.VMEM((m, lb), r_t.dtype),
+            pltpu.VMEM((m, lb), r_t.dtype),
+        ],
+        interpret=_interpret(),
+    )(Sb_t, r_t)
+    return L[:, :, :B], x[:, :B]
 
 
 # ------------------------------------------------------- shared dispatch
@@ -310,7 +380,7 @@ def make_band_ops(plan, band_kernel: str, mesh=None, mesh_axis: str = "homes"):
     homes, so no collectives are needed.  The XLA scan path needs no
     wrapping (it partitions under SPMD propagation).
 
-    Returns ``(scatter_fn, chol_fn, solve_fn, add_diag_fn)``:
+    Returns ``(scatter_fn, chol_fn, solve_fn, add_diag_fn, factor_solve_fn)``:
       scatter_fn(contrib)            → band storage
       chol_fn(Sb)                    → band Cholesky factor (same layout)
       solve_fn(Lb, Sb, rp, refine)   → S⁻¹ rp with ``refine`` iterative-
@@ -318,6 +388,11 @@ def make_band_ops(plan, band_kernel: str, mesh=None, mesh_axis: str = "homes"):
                                        PERMUTED row order for both kernels
       add_diag_fn(Sb, rel)           → Sb with ``rel × max-diag`` Tikhonov
                                        added per home (layout-aware)
+      factor_solve_fn(Sb, rp, refine) → (Lb, S⁻¹ rp): factor + first solve
+                                       in ONE fused kernel on the pallas
+                                       path (the factor never leaves VMEM
+                                       between the two), plain chol+solve
+                                       composition on the XLA path
     Under ``"pallas"`` the storage layout is the transposed (m, bw+1, B)
     and the whole refined solve is one fused kernel; under ``"xla"`` it is
     (B, m, bw+1) and the scan path runs 2(1+refine) scans + matvecs.
@@ -337,6 +412,11 @@ def make_band_ops(plan, band_kernel: str, mesh=None, mesh_axis: str = "homes"):
             return Sb.at[:, 0, :].add(
                 rel * jnp.max(Sb[:, 0, :], axis=0, keepdims=True))
 
+        def factor_solve_fn(Sb, rp, refine):
+            Lb, x = factor_refined_solve_t(
+                Sb, jnp.swapaxes(rp, 0, 1), bw, refine=refine)
+            return Lb, jnp.swapaxes(x, 0, 1)
+
         if mesh is not None:
             from functools import partial
 
@@ -352,6 +432,7 @@ def make_band_ops(plan, band_kernel: str, mesh=None, mesh_axis: str = "homes"):
             chol_fn = shard_map(chol_fn, in_specs=(band_s,),
                                 out_specs=band_s)
             _solve = solve_fn
+            _fsolve = factor_solve_fn
 
             def solve_fn(Lb, Sb, rp, refine):  # refine is Python-static
                 return shard_map(
@@ -359,8 +440,14 @@ def make_band_ops(plan, band_kernel: str, mesh=None, mesh_axis: str = "homes"):
                     in_specs=(band_s, band_s, vec_s), out_specs=vec_s,
                 )(Lb, Sb, rp)
 
+            def factor_solve_fn(Sb, rp, refine):
+                return shard_map(
+                    partial(_fsolve, refine=refine),
+                    in_specs=(band_s, vec_s), out_specs=(band_s, vec_s),
+                )(Sb, rp)
+
         return (lambda c: band_scatter_t(plan, c),
-                chol_fn, solve_fn, add_diag_fn)
+                chol_fn, solve_fn, add_diag_fn, factor_solve_fn)
 
     def solve_fn(Lb, Sb, rp, refine):
         v = bd.banded_solve(Lb, rp, bw)
@@ -373,9 +460,14 @@ def make_band_ops(plan, band_kernel: str, mesh=None, mesh_axis: str = "homes"):
         return Sb.at[:, :, 0].add(
             rel * jnp.max(Sb[:, :, 0], axis=1, keepdims=True))
 
+    chol_x = lambda Sb: bd.banded_cholesky(Sb, bw)
+
+    def factor_solve_fn(Sb, rp, refine):
+        Lb = chol_x(Sb)
+        return Lb, solve_fn(Lb, Sb, rp, refine)
+
     return (lambda c: bd.band_scatter(plan, c),
-            lambda Sb: bd.banded_cholesky(Sb, bw),
-            solve_fn, add_diag_fn)
+            chol_x, solve_fn, add_diag_fn, factor_solve_fn)
 
 
 # ----------------------------------------------------- transposed scatter

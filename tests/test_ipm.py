@@ -4,7 +4,6 @@ handling, and the engine's solver="ipm" path."""
 import sys
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 

@@ -59,6 +59,34 @@ def test_refined_solve_t_matches_scan_path(band_problem):
                                rtol=0, atol=1e-6)
 
 
+def test_fused_factor_solve_matches_split(band_problem):
+    """factor_refined_solve_t (one fused kernel) must be BIT-EQUAL to
+    banded_cholesky_t followed by refined_banded_solve_t — identical
+    recurrences, identical operation order, one fewer launch."""
+    B, m, bw, Sb, r = band_problem
+    St = jnp.transpose(Sb, (1, 2, 0))
+    Lt = pb.banded_cholesky_t(St, bw)
+    for refine in (0, 1):
+        x_split = pb.refined_banded_solve_t(Lt, St, r.T, bw, refine=refine)
+        L_fused, x_fused = pb.factor_refined_solve_t(St, r.T, bw,
+                                                     refine=refine)
+        np.testing.assert_array_equal(np.asarray(L_fused), np.asarray(Lt))
+        np.testing.assert_array_equal(np.asarray(x_fused), np.asarray(x_split))
+
+
+def test_fused_factor_solve_lane_block_invariant(band_problem):
+    """lane_block only tiles the home axis — results are identical for any
+    block size (the on-chip DRAGG_LANE_BLOCK sweep must be free to pick)."""
+    B, m, bw, Sb, r = band_problem
+    St = jnp.transpose(Sb, (1, 2, 0))
+    L128, x128 = pb.factor_refined_solve_t(St, r.T, bw, refine=1,
+                                           lane_block=128)
+    L512, x512 = pb.factor_refined_solve_t(St, r.T, bw, refine=1,
+                                           lane_block=512)
+    np.testing.assert_array_equal(np.asarray(L128), np.asarray(L512))
+    np.testing.assert_array_equal(np.asarray(x128), np.asarray(x512))
+
+
 def test_lane_padding_is_benign():
     """B not a multiple of LANE_BLOCK pads with identity rows; results for
     the real homes are unchanged vs a padded-by-hand batch."""

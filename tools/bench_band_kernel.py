@@ -67,17 +67,40 @@ def main():
 
     Lx = jax.block_until_ready(chol_x(Sb))
     Lp = jax.block_until_ready(chol_p(Sb_t))
+    r_t = jnp.swapaxes(r, 0, 1)
     res = {
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "homes": B, "m": m, "bw": bw,
+        "lane_block": pb.LANE_BLOCK,
         "chol_xla_s": timeit(chol_x, Sb),
         "chol_pallas_s": timeit(chol_p, Sb_t),
         "solve_xla_s": timeit(solve_x, Lx, Sb, r),
-        "solve_pallas_s": timeit(solve_p, Lp, Sb_t, jnp.swapaxes(r, 0, 1)),
+        "solve_pallas_s": timeit(solve_p, Lp, Sb_t, r_t),
     }
     res["chol_speedup"] = round(res["chol_xla_s"] / res["chol_pallas_s"], 2)
     res["solve_speedup"] = round(res["solve_xla_s"] / res["solve_pallas_s"], 2)
+
+    # Fused factor+solve (one kernel) vs the split chol → solve pair — the
+    # predictor-step shape the IPM actually runs (refine=0).
+    fused = jax.jit(lambda S, rr: pb.factor_refined_solve_t(S, rr, bw, refine=0))
+    split = jax.jit(lambda S, rr: pb.refined_banded_solve_t(
+        pb.banded_cholesky_t(S, bw), S, rr, bw, refine=0))
+    res["pred_split_s"] = timeit(split, Sb_t, r_t)
+    res["pred_fused_s"] = timeit(fused, Sb_t, r_t)
+    res["fused_speedup"] = round(res["pred_split_s"] / res["pred_fused_s"], 2)
+
+    # LANE_BLOCK sweep over the fused kernel (the env knob DRAGG_LANE_BLOCK
+    # applies the winner process-wide).  Skipped in interpret mode — block
+    # size only matters on real Mosaic.
+    if dev.platform == "tpu":
+        sweep = {}
+        for lbs in (128, 256, 512, 1024):
+            f = jax.jit(lambda S, rr, _lb=lbs: pb.factor_refined_solve_t(
+                S, rr, bw, refine=0, lane_block=_lb))
+            sweep[str(lbs)] = round(timeit(f, Sb_t, r_t), 6)
+        res["lane_block_sweep_s"] = sweep
+
     print(json.dumps({k: (round(v, 6) if isinstance(v, float) else v)
                       for k, v in res.items()}))
 

@@ -1,0 +1,46 @@
+#!/bin/bash
+# On-chip measurement runbook — run the moment the TPU tunnel is alive.
+# Captures every round-3 measurement in priority order (CLAUDE.md "First
+# actions"), each under its own timeout so a mid-run tunnel flap still
+# leaves the earlier results on disk.  Output: docs/onchip_r3/*.json|log.
+#
+#   bash tools/onchip_runbook.sh [outdir]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-docs/onchip_r3}
+mkdir -p "$OUT"
+stamp() { date +%H:%M:%S; }
+run() { # run <name> <timeout_s> <cmd...>
+  local name=$1 t=$2; shift 2
+  echo "[$(stamp)] >>> $name ($*)" | tee -a "$OUT/runbook.log"
+  timeout "$t" "$@" >"$OUT/$name.json" 2>"$OUT/$name.log"
+  local rc=$?
+  echo "[$(stamp)] <<< $name rc=$rc" | tee -a "$OUT/runbook.log"
+  tail -c 2000 "$OUT/$name.json" >> "$OUT/runbook.log" || true
+  return $rc
+}
+
+# 0. Is the chip actually reachable? (hard timeout; a wedged tunnel hangs)
+timeout 60 python -c "import jax; d=jax.devices()[0]; print(d.platform, d.device_kind)" \
+  > "$OUT/probe.txt" 2>&1 || { echo "TPU unreachable; aborting" | tee -a "$OUT/runbook.log"; exit 1; }
+cat "$OUT/probe.txt" | tee -a "$OUT/runbook.log"
+
+# 1. Band-kernel microbench: first-ever Mosaic timing of the pallas kernels,
+#    the fused factor+solve variant, and the LANE_BLOCK sweep.
+run band_kernel_24h 600 python tools/bench_band_kernel.py --homes 10000 --horizon 24
+run band_kernel_48h 600 python tools/bench_band_kernel.py --homes 25000 --horizon 48
+
+# 2. Headline bench at the BASELINE row-3 config (24h) — phase timers,
+#    hbm_util, band_kernel field.  --solver ipm skips the ADMM race: the
+#    default is settled (docs/perf_notes.md "Solver default decision") and
+#    racing would burn ~half the live-tunnel window recompiling ADMM.
+run bench_10k_24h 1800 python bench.py --homes 10000 --horizon-hours 24 --solver ipm
+
+# 3. The row-5 per-chip slice: 25k homes x 48h.
+run bench_25k_48h 2400 python bench.py --homes 25000 --horizon-hours 48 --steps 8 --solver ipm
+
+# 4. Scale validation at 10k x 48h x 2 days (solve rate + comfort).
+run validate_10k_48h 2400 python tools/validate_scale.py \
+  --homes 10000 --horizon-hours 48 --days 2 --solver ipm
+
+echo "[$(stamp)] runbook complete — record results in docs/perf_notes.md" | tee -a "$OUT/runbook.log"

@@ -27,6 +27,15 @@ def main():
     ap.add_argument("--chunk", type=int, default=8)
     ap.add_argument("--solver", choices=["admm", "ipm"], default="admm")
     ap.add_argument("--min-solve-rate", type=float, default=0.97)
+    ap.add_argument("--sharded", action="store_true",
+                    help="shard the home axis over every visible device "
+                         "(BASELINE row-5 topology; on the CPU test host "
+                         "pair with XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count=8)")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="cap the simulated timesteps (0 = days*24*dt); "
+                         "lets the 100k-home community run ONE chunk "
+                         "without a multi-hour CPU sim")
     args = ap.parse_args()
 
     import jax
@@ -35,6 +44,7 @@ def main():
     from dragg_tpu.data import load_environment, load_waterdraw_profiles
     from dragg_tpu.engine import make_engine
     from dragg_tpu.homes import build_home_batch, create_homes
+    from dragg_tpu.parallel.mesh import make_sharded_engine
 
     cfg = default_config()
     n = args.homes
@@ -53,8 +63,13 @@ def main():
     hems = cfg["home"]["hems"]
     batch = build_home_batch(homes, args.horizon_hours * dt, dt,
                              int(hems["sub_subhourly_steps"]))
-    eng = make_engine(batch, env, cfg, 0)
+    if args.sharded:
+        eng = make_sharded_engine(batch, env, cfg, 0)
+    else:
+        eng = make_engine(batch, env, cfg, 0)
     state = eng.init_state()
+    if args.steps:
+        num_ts = args.steps
 
     tin_min = np.asarray(batch.temp_in_min)
     tin_max = np.asarray(batch.temp_in_max)
@@ -72,13 +87,16 @@ def main():
         state, outs = eng.run_chunk(state, t, rps)
         jax.block_until_ready(outs.agg_load)
         chunk_times.append(time.perf_counter() - t0)
-        solved = np.asarray(outs.correct_solve)       # (k, n)
+        # Sharded engines pad the home axis to a mesh multiple; validate
+        # only the real homes (replica homes are masked out of aggregates).
+        n_true = getattr(eng, "true_n_homes", n)
+        solved = np.asarray(outs.correct_solve)[:, :n_true]   # (k, n)
         rates.append(float(solved.mean()))
         for leaf, name in zip(outs, outs._fields):
             a = np.asarray(leaf)
             assert np.all(np.isfinite(a)), f"non-finite {name} at t={t}"
-        tin = np.asarray(outs.temp_in)
-        twh = np.asarray(outs.temp_wh)
+        tin = np.asarray(outs.temp_in)[:, :n_true]
+        twh = np.asarray(outs.temp_wh)[:, :n_true]
         # Comfort bands on solved steps (unsolved steps run the bang-bang
         # fallback, which tolerates excursions by design).
         vi = np.where(solved > 0,
@@ -92,15 +110,23 @@ def main():
               file=sys.stderr, flush=True)
 
     solve_rate = float(np.mean(rates))
+    import resource
+
     result = {
         "homes": n, "horizon_h": args.horizon_hours, "days": args.days,
+        "steps": num_ts,
         "solver": args.solver,
         "platform": jax.devices()[0].platform,
         "device_kind": str(getattr(jax.devices()[0], "device_kind", "")),
+        "sharded": bool(args.sharded),
+        "n_devices": len(jax.devices()) if args.sharded else 1,
+        "home_slots": eng.n_homes,
         "solve_rate": round(solve_rate, 4),
         "comfort_violation_max": round(viol_max, 5),
         "timesteps_per_s": round(num_ts / sum(chunk_times), 3),
         "total_s": round(time.perf_counter() - t_all, 1),
+        "peak_rss_gb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2),
         "ok": bool(solve_rate >= args.min_solve_rate and viol_max <= band_tol),
     }
     print(json.dumps(result))
