@@ -312,7 +312,8 @@ def run_measured(args) -> dict:
         "device_kind": str(device_kind),
         "n_homes": args.homes,
         "solver": solver_used,
-        "band_kernel": engine.band_kernel,
+        "band_kernel": (engine.admm_band_kernel if solver_used == "admm"
+                        else engine.band_kernel),
         "pallas_selftest": pallas_band._SELFTEST,
         "horizon_steps": H,
         "chunk_rates": [round(r, 3) for r in chunk_rates],

@@ -152,7 +152,7 @@ class EngineParams(NamedTuple):
     ipm_tail_frac: float  # straggler sub-batch fraction (0 disables)
     ipm_tail_iters: int   # tail-phase iteration cap (0 = ipm_iters)
     ipm_warm: bool      # seed the IPM from the receding-horizon shift
-    band_kernel: str    # "auto" | "pallas" | "xla" band factor/solve impl
+    band_kernel: str    # "auto" | "pallas" | "xla" | "cr" band factor/solve
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
 
@@ -207,11 +207,16 @@ class Engine:
         from dragg_tpu.ops import pallas_band
 
         kern = params.band_kernel
-        if kern not in ("auto", "pallas", "xla"):
-            raise ValueError(f"tpu.band_kernel must be auto|pallas|xla, got {kern!r}")
+        if kern not in ("auto", "pallas", "xla", "cr"):
+            raise ValueError(
+                f"tpu.band_kernel must be auto|pallas|xla|cr, got {kern!r}")
         if kern == "auto":
             kern = "pallas" if pallas_band.available() else "xla"
         self._band_kernel = kern
+        # The ADMM factor cache stores the band factor as an ARRAY inside
+        # FactorCarry; the CR "factor" is a pytree, so the ADMM path keeps
+        # the scan kernels when cr is selected (the IPM uses cr fully).
+        self._admm_band_kernel = "xla" if kern == "cr" else kern
         # ShardedEngine sets these before super().__init__; the base engine
         # runs unsharded.
         self._solver_mesh = getattr(self, "mesh", None) \
@@ -283,12 +288,22 @@ class Engine:
 
     @property
     def band_kernel(self) -> str:
-        """The RESOLVED band kernel ("pallas" | "xla") this engine compiled
-        with — "auto" has already been settled against the backend + the
-        Pallas compile self-test, so benchmark artifacts can record which
-        implementation actually ran (a silent self-test fallback would
-        otherwise be indistinguishable from 'pallas didn't help')."""
+        """The RESOLVED band kernel ("pallas" | "xla" | "cr") the IPM path
+        compiled with — "auto" has already been settled against the
+        backend + the Pallas compile self-test, so benchmark artifacts can
+        record which implementation actually ran (a silent self-test
+        fallback would otherwise be indistinguishable from 'pallas didn't
+        help').  The ADMM path may differ (see :attr:`admm_band_kernel`)."""
         return self._band_kernel
+
+    @property
+    def admm_band_kernel(self) -> str:
+        """The band kernel the ADMM factor cache compiled with — "cr" is
+        demoted to "xla" there (the cache stores the factor as an array,
+        and cr's factor is a pytree).  Bench artifacts must report THIS
+        when the ADMM solver ran, or a cr-configured ADMM run would look
+        like a cr measurement."""
+        return self._admm_band_kernel
 
     # ---------------------------------------------------------------- state
     def init_state(self) -> CommunityState:
@@ -327,7 +342,7 @@ class Engine:
                                  matvec_dtype=self.params.admm_matvec_dtype,
                                  solve_backend=self._solve_backend,
                                  banded_factor=self.params.admm_banded_factor,
-                                 band_kernel=self._band_kernel)
+                                 band_kernel=self._admm_band_kernel)
 
     # ----------------------------------------------------------------- step
     def _prepare(self, state: CommunityState, t, rp):
@@ -456,7 +471,7 @@ class Engine:
             anderson=p.admm_anderson,
             banded_factor=p.admm_banded_factor,
             solve_backend=self._solve_backend,
-            band_kernel=self._band_kernel,
+            band_kernel=self._admm_band_kernel,
             mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
             x0=state.warm_x, y_box0=state.warm_y_box,
             rho0=state.warm_rho,

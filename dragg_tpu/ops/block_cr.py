@@ -1,0 +1,165 @@
+"""Block cyclic-reduction solver for the banded Schur systems.
+
+Why a third band backend: on TPU the sequential factor/solve recurrences
+are LATENCY-bound — ``m`` dependent row steps with only (bw+1, lanes) of
+work each, whether they run as an XLA scan (round-2 on-chip profile: the
+scan dispatch IS the solve phase) or inside one Pallas kernel (round-3:
+the in-kernel ``fori_loop`` still serializes ``m`` VPU steps, and grid
+blocks execute sequentially per core).  Cyclic reduction restructures the
+same SPD system as a block-tridiagonal solve with bw×bw blocks and
+eliminates every other block per level: the serial chain shrinks from
+``m`` steps to ``ceil(log2(m/bw))`` levels (~6 at the H=48 shapes), and
+each level is a handful of batched (bw, bw) einsums — exactly the shape
+XLA tiles onto the MXU.  FLOPs roughly double vs the sequential factor;
+on latency-bound hardware that trade is the point.
+
+Accuracy: the reduction is algebraically exact; in f32 the elimination
+order differs from the sequential Cholesky, so results differ at rounding
+level.  The IPM's iterative-refinement pass against the true band S
+(ops/ipm.py solve_kkt) applies unchanged — solution quality rests on the
+refined residual, not on which elimination order produced the factor.
+Diagonal pivot blocks are handled via Cholesky triangular solves (every
+even/odd Schur complement of an SPD matrix is SPD; no explicit inverses).
+
+Block-tridiagonal form: with bandwidth bw, rows ks..ks+s−1 (s = bw) form
+diagonal blocks D_k and the only off-diagonal coupling is to the adjacent
+block (|i−j| ≤ bw spans at most one block boundary):
+
+    U_{k−1}ᵀ x_{k−1} + D_k x_k + U_k x_{k+1} = r_k .
+
+One reduction level eliminates the odd blocks: with A_t = U_{2t} (even t
+→ odd t) and B_t = U_{2t+1} (odd t → even t+1),
+
+    D'_t   = D_t − A_t D̂_t⁻¹ A_tᵀ − B_{t−1}ᵀ D̂_{t−1}⁻¹ B_{t−1}
+    U'_t   = −A_t D̂_t⁻¹ B_t
+    r'_t   = r_t − A_t D̂_t⁻¹ r̂_t − B_{t−1}ᵀ D̂_{t−1}⁻¹ r̂_{t−1}
+    x̂_t    = D̂_t⁻¹ (r̂_t − A_tᵀ x'_t − B_t x'_{t+1})        (back-subst.)
+
+(hats = odd-block quantities).  Recurse on the even half until one block
+remains.  All shapes are static; the level loop is a Python loop over a
+statically known depth.
+
+Reference anchor: plays GLPK's basis-factorization role for the per-home
+solves (dragg/mpc_calc.py:141-145), batched community-wide.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _tri_solve(L, X, trans=False):
+    """Triangular solve with a batched Cholesky factor L: L⁻¹X or L⁻ᵀX."""
+    if trans:
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(L, -1, -2), X, lower=False)
+    return jax.scipy.linalg.solve_triangular(L, X, lower=True)
+
+
+def _spd_solve(L, X):
+    """(L Lᵀ)⁻¹ X for batched blocks."""
+    return _tri_solve(L, _tri_solve(L, X), trans=True)
+
+
+def band_to_blocktri(Sb: jnp.ndarray, bw: int):
+    """Band storage (B, m, bw+1) with ``Sb[:, i, d] = S[i, i−d]`` →
+    block-tridiagonal ``(D, U)``: D (B, N, s, s) diagonal blocks,
+    U (B, N−1, s, s) upper couplings, s = bw, N = ceil(m/s).  Rows beyond
+    m are padded with identity (decoupled; their solution is 0/benign)."""
+    B, m, _ = Sb.shape
+    s = bw
+    N = -(-m // s)
+    mp = N * s
+    padded = jnp.zeros((B, mp, bw + 1), Sb.dtype).at[:, :m, :].set(Sb)
+    padded = padded.at[:, m:, 0].set(1.0)
+
+    # D_k[a, b] = S[ks+a, ks+b]; symmetric read from the lower band.
+    D = jnp.zeros((B, N, s, s), Sb.dtype)
+    for a in range(s):
+        for b in range(s):
+            if a >= b:
+                D = D.at[:, :, a, b].set(padded[:, a::s, a - b])
+            else:
+                D = D.at[:, :, a, b].set(padded[:, b::s, b - a])
+    # U_k[a, b] = S[ks+a, (k+1)s+b] — in-band iff b ≤ a (offset s+b−a ≤ bw).
+    U = jnp.zeros((B, N - 1, s, s), Sb.dtype) if N > 1 else \
+        jnp.zeros((B, 0, s, s), Sb.dtype)
+    for a in range(s):
+        for b in range(a + 1):
+            col_rows = padded[:, (s + b)::s, s + b - a]   # rows (k+1)s+b
+            U = U.at[:, :, a, b].set(col_rows[:, : N - 1])
+    return D, U, N, mp
+
+
+def cr_factor(Sb: jnp.ndarray, bw: int):
+    """Build the multilevel cyclic-reduction factor of the SPD band matrix.
+    Returns an opaque pytree consumed by :func:`cr_solve`."""
+    D, U, N, mp = band_to_blocktri(Sb, bw)
+    levels = []
+    while N > 1:
+        n_odd = N // 2             # odd blocks 1, 3, …
+        n_b = (N - 1) // 2         # odd blocks that have a RIGHT even
+        Dod = D[:, 1::2]
+        A = U[:, 0::2]                                   # (B, n_odd, s, s)
+        Bc = U[:, 1::2]                                  # (B, n_b, s, s)
+        Lod = jnp.linalg.cholesky(Dod)
+        DinvAT = _spd_solve(Lod, jnp.swapaxes(A, -1, -2))
+        DinvB = _spd_solve(Lod[:, :n_b], Bc)
+        Dev = D[:, 0::2]
+        # Right-neighbor correction on even t < n_odd.
+        Dev = Dev.at[:, :n_odd].add(
+            -jnp.einsum("bnij,bnjk->bnik", A, DinvAT))
+        # Left-neighbor correction on even t = 1..n_b.
+        Dev = Dev.at[:, 1:1 + n_b].add(
+            -jnp.einsum("bnji,bnjk->bnik", Bc, DinvB))
+        levels.append(dict(
+            Lod=Lod, A=A, B=Bc,
+            GA=jnp.swapaxes(DinvAT, -1, -2),     # A D̂⁻¹     (B, n_odd, s, s)
+            GBT=jnp.swapaxes(DinvB, -1, -2),     # Bᵀ D̂⁻¹    (B, n_b, s, s)
+        ))
+        U = -jnp.einsum("bnij,bnjk->bnik", A[:, :n_b], DinvB)
+        D = Dev
+        N = D.shape[1]
+    levels.append(jnp.linalg.cholesky(D[:, 0]))
+    return dict(levels=levels, mp=mp, bw=bw)
+
+
+def cr_solve(factor, r: jnp.ndarray) -> jnp.ndarray:
+    """Solve S x = r with a cached CR factor; r is (B, m) in the same
+    (permuted) row order as the band storage the factor was built from."""
+    levels, mp, bw = factor["levels"], factor["mp"], factor["bw"]
+    B, m = r.shape
+    s = bw
+    rb = jnp.zeros((B, mp), r.dtype).at[:, :m].set(r).reshape(B, mp // s, s)
+
+    stack = []
+    for lv in levels[:-1]:
+        n_odd = lv["A"].shape[1]
+        n_b = lv["B"].shape[1]
+        rod = rb[:, 1::2]
+        rev = rb[:, 0::2]
+        rev = rev.at[:, :n_odd].add(
+            -jnp.einsum("bnij,bnj->bni", lv["GA"], rod))
+        rev = rev.at[:, 1:1 + n_b].add(
+            -jnp.einsum("bnij,bnj->bni", lv["GBT"], rod[:, :n_b]))
+        stack.append(rod)
+        rb = rev
+
+    Lroot = levels[-1]
+    x = _spd_solve(Lroot, rb[:, 0, :, None])[:, :, 0][:, None]
+
+    for lv, rod in zip(reversed(levels[:-1]), reversed(stack)):
+        n_odd = lv["A"].shape[1]
+        n_b = lv["B"].shape[1]
+        t = rod - jnp.einsum("bnji,bnj->bni", lv["A"], x[:, :n_odd])
+        t = t.at[:, :n_b].add(
+            -jnp.einsum("bnij,bnj->bni", lv["B"], x[:, 1:1 + n_b]))
+        xod = _spd_solve(lv["Lod"], t[..., None])[..., 0]
+        N = x.shape[1] + xod.shape[1]
+        out = jnp.zeros((B, N, s), x.dtype)
+        out = out.at[:, 0::2].set(x)
+        out = out.at[:, 1::2].set(xod)
+        x = out
+
+    return x.reshape(B, mp)[:, :m]

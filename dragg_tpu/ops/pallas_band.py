@@ -449,18 +449,29 @@ def make_band_ops(plan, band_kernel: str, mesh=None, mesh_axis: str = "homes"):
         return (lambda c: band_scatter_t(plan, c),
                 chol_fn, solve_fn, add_diag_fn, factor_solve_fn)
 
+    # xla and cr share the (B, m, bw+1) storage layout — only the
+    # factor/solve pair differs (cr's "factor" is an opaque pytree with
+    # serial depth log2(m/bw); pure-jax ops, so sharding propagates under
+    # SPMD with no shard_map wrapping).
+    if band_kernel == "cr":
+        from dragg_tpu.ops import block_cr
+
+        chol_x = lambda Sb: block_cr.cr_factor(Sb, bw)
+        base_solve = block_cr.cr_solve
+    else:
+        chol_x = lambda Sb: bd.banded_cholesky(Sb, bw)
+        base_solve = lambda Lb, rp: bd.banded_solve(Lb, rp, bw)
+
     def solve_fn(Lb, Sb, rp, refine):
-        v = bd.banded_solve(Lb, rp, bw)
+        v = base_solve(Lb, rp)
         for _ in range(refine):
             resid = rp - bd.band_matvec(Sb, v, bw)
-            v = v + bd.banded_solve(Lb, resid, bw)
+            v = v + base_solve(Lb, resid)
         return v
 
     def add_diag_fn(Sb, rel):
         return Sb.at[:, :, 0].add(
             rel * jnp.max(Sb[:, :, 0], axis=1, keepdims=True))
-
-    chol_x = lambda Sb: bd.banded_cholesky(Sb, bw)
 
     def factor_solve_fn(Sb, rp, refine):
         Lb = chol_x(Sb)

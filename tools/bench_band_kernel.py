@@ -90,6 +90,16 @@ def main():
     res["pred_fused_s"] = timeit(fused, Sb_t, r_t)
     res["fused_speedup"] = round(res["pred_split_s"] / res["pred_fused_s"], 2)
 
+    # Block cyclic reduction (ops/block_cr.py): serial depth log2(m/bw)
+    # instead of m.  CPU-measured 2.9x SLOWER than the scans (it doubles
+    # FLOPs and CPUs aren't latency-bound — docs/perf_notes.md); this
+    # timing decides whether the latency hypothesis holds on real TPU.
+    from dragg_tpu.ops import block_cr as cr
+
+    cr_fs = jax.jit(lambda S, rr: cr.cr_solve(cr.cr_factor(S, bw), rr))
+    res["pred_cr_s"] = timeit(cr_fs, Sb, r)
+    res["cr_vs_pallas"] = round(res["pred_fused_s"] / res["pred_cr_s"], 2)
+
     # LANE_BLOCK sweep over the fused kernel (the env knob DRAGG_LANE_BLOCK
     # applies the winner process-wide).  Skipped in interpret mode — block
     # size only matters on real Mosaic.
