@@ -166,7 +166,13 @@ class RLAgent:
                 self.rl_data[name].append(float(np.asarray(field)[k]))
 
     def write_rl_data(self, output_dir: str) -> None:
-        """<output_dir>/<name>_agent-results.json (dragg/agent.py:270-273)."""
+        """<output_dir>/<name>_agent-results.json (dragg/agent.py:270-273).
+        Multi-host: rank-0 only, like every other output writer — the run
+        directory tree is never created on non-zero processes."""
+        import jax
+
+        if jax.process_index() != 0:
+            return
         path = os.path.join(output_dir, f"{self.name}_agent-results.json")
         with open(path, "w") as f:
             json.dump(self.rl_data, f, indent=4)

@@ -15,15 +15,9 @@ sys.path.insert(0, "tests")
 from dragg_tpu.ops import banded as bd
 from dragg_tpu.ops.block_cr import band_to_blocktri, cr_factor, cr_solve
 from dragg_tpu.ops.ipm import ipm_solve_qp
-
-
-def _random_band_spd(B, m, bw, seed=0):
-    rng = np.random.default_rng(seed)
-    Sb = np.zeros((B, m, bw + 1), np.float32)
-    Sb[:, :, 0] = 10.0 + rng.random((B, m))
-    for k in range(1, bw + 1):
-        Sb[:, k:, k] = rng.standard_normal((B, m - k)).astype(np.float32) * 0.5
-    return jnp.asarray(Sb)
+# One SPD-band generator for every band-backend test family, so cr and
+# pallas/xla are always compared on the same matrix distribution.
+from test_pallas_band import _random_band_spd
 
 
 def test_blocktri_reconstructs_dense():

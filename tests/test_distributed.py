@@ -161,15 +161,42 @@ _DRIVER = textwrap.dedent("""
     from test_distributed import _tiny_cfg_dict
     from dragg_tpu.aggregator import Aggregator
 
-    mode = sys.argv[1]            # full | partial | resume
+    mode = sys.argv[1]            # full | partial | resume | rl
     outputs_dir = sys.argv[2]
-    cfg = _tiny_cfg_dict(days=2, resume=(mode == "resume"))
+    days = 1 if mode == "rl" else 2
+    cfg = _tiny_cfg_dict(days=days, resume=(mode == "resume"))
+    if mode == "rl":
+        cfg["simulation"]["run_rbo_mpc"] = False
+        cfg["simulation"]["run_rl_agg"] = True
     agg = Aggregator(cfg, data_dir=None, outputs_dir=outputs_dir)
     if mode == "partial":
         agg.stop_after_chunks = 1
     agg.run()
     print("DRIVER_DONE", mode, "resumed_from", agg.resumed_from, flush=True)
 """)
+
+
+def test_distributed_rl_agg_two_process(tmp_path):
+    """The RL-aggregator run mode (fused agent + community scan) over two
+    processes: the chunk jit takes the engine constants as arguments
+    (rl/runner.py) and the agent/env carries replicate on the global
+    mesh — this is the one multi-host code path the baseline tests don't
+    touch."""
+    driver = str(tmp_path / "driver.py")
+    with open(driver, "w") as f:
+        f.write(_DRIVER.format(root=ROOT))
+    dirs = {pid: str(tmp_path / f"host{pid}") for pid in range(2)}
+    results = _launch_pair(
+        lambda pid: [sys.executable, driver, "rl", dirs[pid]], env_extra={})
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"rl process {pid} failed:\n{out[-4000:]}"
+    found = False
+    for root, _, files in os.walk(dirs[0]):
+        if "results.json" in files and os.path.basename(root) == "rl_agg":
+            res = json.load(open(os.path.join(root, "results.json")))
+            assert len(res["Summary"]["RP"]) == 24
+            found = True
+    assert found, "rank 0 wrote no rl_agg results.json"
 
 
 def test_distributed_checkpoint_resume_bit_exact(tmp_path):
