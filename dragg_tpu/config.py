@@ -204,8 +204,9 @@ _DEFAULT: dict[str, Any] = {
                                        # "band" (no (B,m,m) array — the
                                        # 100k-home memory regime) | "auto"
         "ipm_warm_start": False,  # seed the IPM from the receding-horizon
-                                  # shift (interior-safeguarded; see
-                                  # docs/perf_notes.md for the measurement)
+                                  # shift — measured PESSIMIZATION (+55%
+                                  # steady-state iterations, warm-start
+                                  # jamming; docs/perf_notes.md round 3)
         "ipm_iters": 0,  # Mehrotra iteration cap (hems.solver="ipm");
                          # 0 = horizon-aware default: 16 + (decision steps)/2
         "ipm_tail_frac": 0.25,  # tail compaction: after a short full-batch

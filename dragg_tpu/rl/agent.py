@@ -169,8 +169,6 @@ class RLAgent:
         """<output_dir>/<name>_agent-results.json (dragg/agent.py:270-273).
         Multi-host: rank-0 only, like every other output writer — the run
         directory tree is never created on non-zero processes."""
-        import jax
-
         if jax.process_index() != 0:
             return
         path = os.path.join(output_dir, f"{self.name}_agent-results.json")

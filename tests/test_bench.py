@@ -54,6 +54,28 @@ def test_validate_scale_smoke():
     assert result["comfort_violation_max"] <= 0.05
 
 
+def test_validate_scale_sharded_smoke():
+    """--sharded mode (the row-5 topology the 100k instantiation and the
+    on-chip runbook use) runs a capped-step chunk over the mesh and emits
+    the extended JSON (home_slots / n_devices / peak_rss_gb)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "validate_scale.py"),
+         "--homes", "32", "--horizon-hours", "4", "--days", "1",
+         "--chunk", "4", "--steps", "4", "--sharded",
+         "--min-solve-rate", "0.8"],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["ok"] is True
+    assert result["sharded"] is True and result["n_devices"] == 8
+    assert result["steps"] == 4 and result["home_slots"] >= 32
+    assert result["peak_rss_gb"] > 0
+
+
 def test_doctor_reports_usable_environment(tmp_path):
     """doctor exits 0 with every check ok on the CPU test environment and
     never hangs on backend init (hard subprocess timeout inside)."""

@@ -190,13 +190,21 @@ def test_distributed_rl_agg_two_process(tmp_path):
         lambda pid: [sys.executable, driver, "rl", dirs[pid]], env_extra={})
     for pid, (rc, out) in enumerate(results):
         assert rc == 0, f"rl process {pid} failed:\n{out[-4000:]}"
-    found = False
+    found = telemetry = False
     for root, _, files in os.walk(dirs[0]):
         if "results.json" in files and os.path.basename(root) == "rl_agg":
             res = json.load(open(os.path.join(root, "results.json")))
             assert len(res["Summary"]["RP"]) == 24
             found = True
+        if "utility_agent-results.json" in files:
+            rl = json.load(open(os.path.join(root, "utility_agent-results.json")))
+            assert len(rl["reward"]) == 24
+            telemetry = True
     assert found, "rank 0 wrote no rl_agg results.json"
+    assert telemetry, "rank 0 wrote no agent telemetry (write_rl_data)"
+    for root, _, files in os.walk(dirs[1]):
+        assert "utility_agent-results.json" not in files, \
+            "non-zero rank wrote agent telemetry"
 
 
 def test_distributed_checkpoint_resume_bit_exact(tmp_path):
