@@ -152,6 +152,7 @@ class EngineParams(NamedTuple):
     ipm_tail_frac: float  # straggler sub-batch fraction (0 disables)
     ipm_tail_iters: int   # tail-phase iteration cap (0 = ipm_iters)
     ipm_warm: bool      # seed the IPM from the receding-horizon shift
+    ipm_eps: float      # IPM stopping tolerance (decoupled from admm_eps)
     band_kernel: str    # "auto" | "pallas" | "xla" | "cr" band factor/solve
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
@@ -451,7 +452,7 @@ class Engine:
                 self.static.pattern, qp.vals, qp.b_eq, qp.l_box, qp.u_box,
                 qp.q, reg=p.admm_reg, iters=p.ipm_iters,
                 tail_frac=p.ipm_tail_frac, tail_iters=p.ipm_tail_iters,
-                eps_abs=p.admm_eps, eps_rel=p.admm_eps,
+                eps_abs=p.ipm_eps, eps_rel=p.ipm_eps,
                 band_kernel=self._band_kernel,
                 mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
                 x0=state.warm_x if p.ipm_warm else None,
@@ -703,6 +704,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         ipm_tail_frac=float(tpu_cfg.get("ipm_tail_frac", 0.25)),
         ipm_tail_iters=int(tpu_cfg.get("ipm_tail_iters", 0)),
         ipm_warm=bool(tpu_cfg.get("ipm_warm_start", False)),
+        ipm_eps=float(tpu_cfg.get("ipm_eps", 2e-4)),
         band_kernel=str(tpu_cfg.get("band_kernel", "auto")),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),
