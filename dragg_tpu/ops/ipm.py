@@ -242,7 +242,19 @@ def _make_loop(data, shared, eps_abs, eps_rel):
     def converged(x, y, s_l, s_u, z_l, z_u):
         """Per-home convergence in the scaled space (loop-internal freeze
         criterion; the authoritative check runs once at the end) plus a
-        residual score used to rank stragglers for tail compaction."""
+        residual score used to rank stragglers for tail compaction.
+
+        Divergence freeze: a primal-INFEASIBLE home can never reach
+        rp ≤ eps — its box duals grow without bound while rp stalls
+        (measured: rp stuck at ~5-12 with duals 5e3→5e4 while feasible
+        homes sit at rp ≤ 5e-3, duals O(1) — docs/perf_notes.md).  Such a
+        home previously burned the full iteration cap EVERY sim step and
+        blocked the all-frozen early exit for the whole batch.  Freezing
+        it changes nothing about its outcome (it fails the authoritative
+        final residual check and routes to the fallback controller either
+        way) but releases the batch.  Both conditions must hold, so a
+        merely-slow feasible home (small duals) or a cold start (large
+        rp, unit duals) cannot trip it."""
         rp = jnp.max(jnp.abs(mv(x) - bs), axis=1)
         rd = jnp.max(jnp.abs(reg_s * x + qs + mvt(y) - z_l + z_u) / cd, axis=1)
         gap = (jnp.sum(s_l * z_l * fin_l, axis=1)
@@ -250,7 +262,10 @@ def _make_loop(data, shared, eps_abs, eps_rel):
         gap_u = gap / jnp.maximum(jnp.abs(jnp.sum(qs * x, axis=1)), 1.0)
         ok = (rp <= eps_abs) & (rd <= 10 * eps_abs) \
             & (gap_u <= jnp.maximum(eps_rel, 1e-7))
-        return ok, rp + rd + gap_u
+        zmax = jnp.maximum(jnp.max(z_l * fin_l, axis=1),
+                           jnp.max(z_u * fin_u, axis=1))
+        diverged = (rp > 100 * jnp.maximum(eps_abs, 1e-6)) & (zmax > 1e4)
+        return ok | diverged, rp + rd + gap_u
 
     def body(carry):
         i, _, x, y, s_l, s_u, z_l, z_u = carry
