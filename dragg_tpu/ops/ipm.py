@@ -254,7 +254,10 @@ def _make_loop(data, shared, eps_abs, eps_rel):
         final residual check and routes to the fallback controller either
         way) but releases the batch.  Both conditions must hold, so a
         merely-slow feasible home (small duals) or a cold start (large
-        rp, unit duals) cannot trip it."""
+        rp, unit duals) cannot trip it.  Threshold 1e3: feasible homes
+        measure O(1) duals in the scaled space, so three orders of margin
+        remain, and the 1e4->1e3 step cut hard-chunk iterations 21-39 ->
+        9-16 at bit-identical per-chunk solve rates (perf_notes)."""
         rp = jnp.max(jnp.abs(mv(x) - bs), axis=1)
         rd = jnp.max(jnp.abs(reg_s * x + qs + mvt(y) - z_l + z_u) / cd, axis=1)
         gap = (jnp.sum(s_l * z_l * fin_l, axis=1)
@@ -264,7 +267,7 @@ def _make_loop(data, shared, eps_abs, eps_rel):
             & (gap_u <= jnp.maximum(eps_rel, 1e-7))
         zmax = jnp.maximum(jnp.max(z_l * fin_l, axis=1),
                            jnp.max(z_u * fin_u, axis=1))
-        diverged = (rp > 100 * jnp.maximum(eps_abs, 1e-6)) & (zmax > 1e4)
+        diverged = (rp > 100 * jnp.maximum(eps_abs, 1e-6)) & (zmax > 1e3)
         return ok | diverged, rp + rd + gap_u
 
     def body(carry):
@@ -450,13 +453,16 @@ def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
             (local) batch; scatter the improved iterates back."""
             _, conv2 = _make_loop(data_l, shared_t, eps_abs, eps_rel)
             frozen, score = conv2(x, y, s_l, s_u, z_l, z_u)
-            # Converged homes rank below any straggler; among stragglers
-            # the largest residuals go first (all fit within k when frac is
-            # sized from the measured convergence CDF).  A diverged home
-            # whose score is NaN has implementation-defined top_k ordering
-            # — rank it as worst (it needs the tail phase the most, or at
-            # least the final residual check must see its frozen
-            # non-finite state).
+            # Frozen homes — converged OR certified-diverged (the
+            # divergence freeze in ``converged``) — rank below any live
+            # straggler: tail slots are for homes that can still improve,
+            # and letting diverged homes hog them was measured as part of
+            # the pre-freeze slowdown (docs/perf_notes.md).  Among live
+            # stragglers the largest residuals go first.  NaN scores
+            # (non-finite residuals that did NOT trip the freeze) have
+            # implementation-defined top_k ordering — sanitize to +inf so
+            # they rank as the worst live straggler instead of silently
+            # dropping out.
             score = jnp.nan_to_num(score, nan=jnp.inf, posinf=jnp.inf)
             idx = lax.top_k(jnp.where(frozen, -1.0, score), k)[1]
             g = lambda a: a[idx]
