@@ -384,9 +384,12 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=16, help="timesteps per timed chunk")
     ap.add_argument("--chunks", type=int, default=3, help="number of timed chunks")
     ap.add_argument("--admm-iters", type=int, default=1000)
-    ap.add_argument("--solver", choices=["auto", "admm", "ipm"], default="auto",
-                    help="auto: race both over several warm steps and keep "
-                         "the winner")
+    ap.add_argument("--solver", choices=["auto", "admm", "ipm"], default="ipm",
+                    help="ipm (default): the measured-fastest family in "
+                         "every recorded regime (docs/perf_notes.md "
+                         "'Solver default decision') — skipping the race "
+                         "saves half a constrained TPU window; auto: race "
+                         "both over several warm steps and keep the winner")
     ap.add_argument("--platform", choices=["auto", "tpu", "cpu"], default="auto")
     ap.add_argument("--cpu-fallback-homes", type=int, default=1_000,
                     help="community size for the CPU fallback attempt")

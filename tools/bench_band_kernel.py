@@ -3,7 +3,7 @@
 Times the two band operations that dominate an IPM iteration (Cholesky
 factor and the refined solve) at MPC-realistic shapes on whatever backend
 is up, printing one JSON line.  Engine-step comparisons come from
-bench.py's solver race / phase timers.  This is the measurement behind the
+bench.py's phase timers (and its --solver auto race).  This is the measurement behind the
 band_kernel='auto' policy (docs/perf_notes.md).
 
 Usage: python tools/bench_band_kernel.py [--homes 10000] [--horizon 24]
