@@ -56,6 +56,14 @@ def test_ipm_flags_infeasible_home():
     assert not bool(sol.solved[0])
     # The other homes still solve despite the lockstep neighbor diverging.
     assert int(jnp.sum(sol.solved[1:])) >= 4
+    # Divergence-freeze contract (round 3): the infeasible home must not
+    # hold the batch at the iteration cap — once it trips the freeze
+    # (stalled rp + exploding duals) and the rest converge, the all-frozen
+    # early exit fires well before the cap.  Measured exit: 7 iterations;
+    # the bound leaves slack for fp wiggle while still failing loudly if
+    # the freeze regresses to cap-burning (docs/perf_notes.md, +20%
+    # whole-day A/B).
+    assert int(sol.iters) < 20, f"expected early exit, ran {int(sol.iters)}/25"
 
 
 def test_ipm_handles_fixed_variables():
