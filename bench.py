@@ -277,13 +277,20 @@ def run_measured(args) -> dict:
         # width comes from the engine's actual RCM plan (bw=4 at the MPC
         # pattern today) rather than a hardcoded literal, so a pattern
         # change can't silently skew hbm_util (ADVICE r2).
-        bw_band = (engine.band_bw or 4) + 1
-        bytes_iter = B * m * 4 * (9 * bw_band + 6 * 4 + 8)
-        bytes_per_step = mean_iters * bytes_iter
-        for key, val in PEAK_HBM_BW:
-            if key in str(device_kind).lower():
-                hbm_util = (bytes_per_step * rate) / val
-                break
+        if engine.band_bw is None:
+            # Band plan disabled: the analytic model below is specific to
+            # the banded path — substituting a literal bandwidth here would
+            # silently skew hbm_util for that configuration (ADVICE r3);
+            # emit null instead.
+            bytes_per_step = hbm_util = None
+        else:
+            bw_band = engine.band_bw + 1
+            bytes_iter = B * m * 4 * (9 * bw_band + 6 * 4 + 8)
+            bytes_per_step = mean_iters * bytes_iter
+            for key, val in PEAK_HBM_BW:
+                if key in str(device_kind).lower():
+                    hbm_util = (bytes_per_step * rate) / val
+                    break
 
     # Optional profiler trace for manual inspection (BENCH_TRACE_DIR=...).
     trace_dir = os.environ.get("BENCH_TRACE_DIR")

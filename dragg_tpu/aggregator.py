@@ -461,18 +461,41 @@ class Aggregator:
         root = self._checkpoint_root()
         name = f"ckpt_t{self.timestep:08d}"
         final = os.path.join(root, name)
-        os.makedirs(final, exist_ok=True)
-        save_pytree_local(
-            os.path.join(final, shard_file_name(jax.process_index(),
-                                                jax.process_count())),
-            state, self.timestep)
-        if jax.process_index() == 0:
-            self.collector.write_json(os.path.join(final, "collected.json"),
-                                      self._results_plan(None))
-            for fname, obj in (extra_json or {}).items():
-                save_progress(os.path.join(final, fname), obj)
-            save_progress(os.path.join(final, "progress.json"),
-                          self._progress_dict())
+        # Any write failure (disk full, permissions) is allgathered as a
+        # go/no-go flag BEFORE the barrier — a rank that raised inside the
+        # write block would otherwise leave every other rank blocked in
+        # sync_global_devices forever (ADVICE round 3).  On no-go, no rank
+        # publishes LATEST: the previous checkpoint stays authoritative and
+        # the run continues.
+        ok = True
+        try:
+            os.makedirs(final, exist_ok=True)
+            save_pytree_local(
+                os.path.join(final, shard_file_name(jax.process_index(),
+                                                    jax.process_count())),
+                state, self.timestep)
+            if jax.process_index() == 0:
+                self.collector.write_json(
+                    os.path.join(final, "collected.json"),
+                    self._results_plan(None))
+                for fname, obj in (extra_json or {}).items():
+                    save_progress(os.path.join(final, fname), obj)
+                save_progress(os.path.join(final, "progress.json"),
+                              self._progress_dict())
+        except Exception:
+            self.log.logger.exception(
+                f"checkpoint write failed on process {jax.process_index()}; "
+                f"skipping publish of {name} (previous checkpoint remains "
+                f"authoritative)")
+            ok = False
+        all_ok = bool(np.all(multihost_utils.process_allgather(
+            np.asarray([ok]))))
+        if not all_ok:
+            if ok:
+                self.log.logger.warning(
+                    f"checkpoint {name} aborted: another process failed its "
+                    f"write; no LATEST update")
+            return
         multihost_utils.sync_global_devices(f"dragg_ckpt_files_{name}")
         latest_tmp = os.path.join(root, f"LATEST.tmp{jax.process_index()}")
         with open(latest_tmp, "w") as f:

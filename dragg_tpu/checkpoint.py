@@ -48,32 +48,35 @@ def save_pytree(path: str, tree) -> None:
 def load_pytree(path: str, template):
     """Load an npz produced by :func:`save_pytree` into ``template``'s
     structure.  Shapes must match the template's leaves."""
-    data = np.load(path)
-    leaves, treedef = jax.tree_util.tree_flatten(template)
-    # Sort numerically: lexicographic sort would interleave leaf_10000
-    # between leaf_1000 and leaf_1001, silently permuting same-shaped leaves.
-    keys = sorted(data.files, key=lambda k: int(k.rsplit("_", 1)[1]))
-    if len(keys) != len(leaves):
-        raise ValueError(
-            f"Checkpoint {path} has {len(keys)} leaves; template has {len(leaves)}"
-        )
-    new_leaves = []
-    for key, tmpl in zip(keys, leaves):
-        arr = data[key]
-        tshape = np.shape(tmpl)
-        if tuple(arr.shape) != tuple(tshape):
+    with np.load(path) as data:
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        # Sort numerically: lexicographic sort would interleave leaf_10000
+        # between leaf_1000 and leaf_1001, silently permuting same-shaped
+        # leaves.
+        keys = sorted(data.files, key=lambda k: int(k.rsplit("_", 1)[1]))
+        if len(keys) != len(leaves):
             raise ValueError(
-                f"Checkpoint leaf {key} shape {arr.shape} != template {tshape}"
+                f"Checkpoint {path} has {len(keys)} leaves; template has "
+                f"{len(leaves)}"
             )
-        if isinstance(tmpl, jax.Array):
-            # Restore the template's placement in ONE transfer: a sharded
-            # engine's state must come back with the SAME NamedSharding, or
-            # the resumed chunk compiles a differently-partitioned program
-            # whose fp reassociation breaks bit-exact resume.
-            leaf = jax.device_put(arr.astype(tmpl.dtype), tmpl.sharding)
-        else:
-            leaf = jax.numpy.asarray(arr, dtype=np.asarray(tmpl).dtype)
-        new_leaves.append(leaf)
+        new_leaves = []
+        for key, tmpl in zip(keys, leaves):
+            arr = data[key]
+            tshape = np.shape(tmpl)
+            if tuple(arr.shape) != tuple(tshape):
+                raise ValueError(
+                    f"Checkpoint leaf {key} shape {arr.shape} != template "
+                    f"{tshape}"
+                )
+            if isinstance(tmpl, jax.Array):
+                # Restore the template's placement in ONE transfer: a sharded
+                # engine's state must come back with the SAME NamedSharding,
+                # or the resumed chunk compiles a differently-partitioned
+                # program whose fp reassociation breaks bit-exact resume.
+                leaf = jax.device_put(arr.astype(tmpl.dtype), tmpl.sharding)
+            else:
+                leaf = jax.numpy.asarray(arr, dtype=np.asarray(tmpl).dtype)
+            new_leaves.append(leaf)
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
@@ -143,40 +146,43 @@ def load_pytree_local(path: str, template, expect_timestep: int | None = None):
     block via ``jax.make_array_from_process_local_data`` (a collective-free
     constructor — but every process must call it for its own shard);
     fully-addressable leaves restore exactly like :func:`load_pytree`."""
-    data = np.load(path)
-    if expect_timestep is not None and "__timestep__" in data.files:
-        got = int(data["__timestep__"])
-        if got != expect_timestep:
+    # Context manager: NpzFile holds the file descriptor open until closed
+    # (ADVICE round 3 — the resume probe used one, the loader leaked it).
+    with np.load(path) as data:
+        if expect_timestep is not None and "__timestep__" in data.files:
+            got = int(data["__timestep__"])
+            if got != expect_timestep:
+                raise ValueError(
+                    f"shard file {path} holds timestep {got}, expected "
+                    f"{expect_timestep} (torn multi-process checkpoint)")
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        keys = sorted((k for k in data.files if k.startswith("leaf_")),
+                      key=lambda k: int(k.rsplit("_", 1)[1]))
+        if len(keys) != len(leaves):
             raise ValueError(
-                f"shard file {path} holds timestep {got}, expected "
-                f"{expect_timestep} (torn multi-process checkpoint)")
-    leaves, treedef = jax.tree_util.tree_flatten(template)
-    keys = sorted((k for k in data.files if k.startswith("leaf_")),
-                  key=lambda k: int(k.rsplit("_", 1)[1]))
-    if len(keys) != len(leaves):
-        raise ValueError(
-            f"Checkpoint {path} has {len(keys)} leaves; template has {len(leaves)}")
-    new_leaves = []
-    for key, tmpl in zip(keys, leaves):
-        arr = data[key]
-        if isinstance(tmpl, jax.Array) and not tmpl.is_fully_addressable:
-            want = _local_block(tmpl).shape
-            if tuple(arr.shape) != tuple(want):
-                raise ValueError(
-                    f"Checkpoint leaf {key} local block {arr.shape} != "
-                    f"template's local block {want}")
-            leaf = jax.make_array_from_process_local_data(
-                tmpl.sharding, arr.astype(tmpl.dtype), tmpl.shape)
-        else:
-            if tuple(arr.shape) != tuple(np.shape(tmpl)):
-                raise ValueError(
-                    f"Checkpoint leaf {key} shape {arr.shape} != template "
-                    f"{np.shape(tmpl)}")
-            if isinstance(tmpl, jax.Array):
-                leaf = jax.device_put(arr.astype(tmpl.dtype), tmpl.sharding)
+                f"Checkpoint {path} has {len(keys)} leaves; template has "
+                f"{len(leaves)}")
+        new_leaves = []
+        for key, tmpl in zip(keys, leaves):
+            arr = data[key]
+            if isinstance(tmpl, jax.Array) and not tmpl.is_fully_addressable:
+                want = _local_block(tmpl).shape
+                if tuple(arr.shape) != tuple(want):
+                    raise ValueError(
+                        f"Checkpoint leaf {key} local block {arr.shape} != "
+                        f"template's local block {want}")
+                leaf = jax.make_array_from_process_local_data(
+                    tmpl.sharding, arr.astype(tmpl.dtype), tmpl.shape)
             else:
-                leaf = jax.numpy.asarray(arr, dtype=np.asarray(tmpl).dtype)
-        new_leaves.append(leaf)
+                if tuple(arr.shape) != tuple(np.shape(tmpl)):
+                    raise ValueError(
+                        f"Checkpoint leaf {key} shape {arr.shape} != template "
+                        f"{np.shape(tmpl)}")
+                if isinstance(tmpl, jax.Array):
+                    leaf = jax.device_put(arr.astype(tmpl.dtype), tmpl.sharding)
+                else:
+                    leaf = jax.numpy.asarray(arr, dtype=np.asarray(tmpl).dtype)
+            new_leaves.append(leaf)
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 

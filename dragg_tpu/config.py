@@ -214,6 +214,12 @@ _DEFAULT: dict[str, Any] = {
                                 # finish them alone (1.5-1.6x solver time,
                                 # equal-or-better solve rates); 0 disables
         "ipm_tail_iters": 0,  # tail-phase iteration cap (0 = ipm_iters)
+        "ipm_freeze_zmax": 1e3,  # divergence-freeze dual threshold (scaled
+                                 # space): freeze a home when rp stalls AND
+                                 # its box duals exceed this; feasible homes
+                                 # measure O(1) duals (CPU) so 1e3 keeps 3
+                                 # orders of margin — exposed for on-chip
+                                 # re-tuning (ADVICE round 3)
         "ipm_eps": 2e-4,  # IPM stopping tolerance: halves iterations vs
                           # 1e-4 at equal-or-better solve rate, 0 comfort
                           # violations, identical ≤0.36% objective gap vs

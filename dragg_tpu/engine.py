@@ -153,6 +153,7 @@ class EngineParams(NamedTuple):
     ipm_tail_iters: int   # tail-phase iteration cap (0 = ipm_iters)
     ipm_warm: bool      # seed the IPM from the receding-horizon shift
     ipm_eps: float      # IPM stopping tolerance (decoupled from admm_eps)
+    ipm_freeze_zmax: float  # divergence-freeze dual threshold (scaled space)
     band_kernel: str    # "auto" | "pallas" | "xla" | "cr" band factor/solve
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
@@ -456,6 +457,7 @@ class Engine:
                 band_kernel=self._band_kernel,
                 mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
                 x0=state.warm_x if p.ipm_warm else None,
+                freeze_zmax=p.ipm_freeze_zmax,
             )
             return sol, factor
         return admm_solve_qp_cached(
@@ -705,6 +707,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         ipm_tail_iters=int(tpu_cfg.get("ipm_tail_iters", 0)),
         ipm_warm=bool(tpu_cfg.get("ipm_warm_start", False)),
         ipm_eps=float(tpu_cfg.get("ipm_eps", 2e-4)),
+        ipm_freeze_zmax=float(tpu_cfg.get("ipm_freeze_zmax", 1e3)),
         band_kernel=str(tpu_cfg.get("band_kernel", "auto")),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),

@@ -1,0 +1,94 @@
+"""Shared single-step QP assembly for parity tests and measurement tools.
+
+One canonical recipe for "the community's t=0 QP exactly as the engine
+would assemble it" (seeded population, weather window, draw smoothing,
+water-mixed initial WH temperature, season gate), shared by
+tests/test_qp_parity.py and tools/milp_gap.py so the parity-tested
+matrices and the MILP-gap-measured matrices can never drift apart
+(advisor finding, round 4).
+
+The draw smoothing and initial-condition arithmetic mirror the engine's
+step preparation (dragg_tpu/engine.py) and the reference semantics at
+dragg/mpc_calc.py:193-204 (water draws) and :270-289 (WH mixing).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def assemble_community_qp(horizon_hours: int = 4, n_homes: int = 6,
+                          homes_pv: int = 1, homes_battery: int = 1,
+                          homes_pv_battery: int = 1,
+                          season: str = "heat"):
+    """Assemble the t=0 community QP for a seeded mixed community.
+
+    ``season``: "heat" pins the reference test fixture's heat-only gate;
+    "auto" applies the engine's gate (max OAT over the horizon <= 30 C ->
+    heat-only, else cool-only — dragg/mpc_calc.py:302-309).
+
+    Returns ``(qp, pattern, layout, s)`` where ``s`` is
+    ``sub_subhourly_steps`` (the duty-count cap).
+    """
+    import jax.numpy as jnp
+
+    from dragg_tpu.config import default_config
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+    from dragg_tpu.ops.qp import TAP_TEMP, assemble_qp_step
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = n_homes
+    cfg["community"]["homes_pv"] = homes_pv
+    cfg["community"]["homes_battery"] = homes_battery
+    cfg["community"]["homes_pv_battery"] = homes_pv_battery
+    cfg["home"]["hems"]["prediction_horizon"] = horizon_hours
+    seed = int(cfg["simulation"]["random_seed"])
+    env = load_environment(cfg)
+    dt = env.dt
+    waterdraw = load_waterdraw_profiles(None, seed=seed)
+    homes = create_homes(cfg, 24 * dt, dt, waterdraw)
+    hems = cfg["home"]["hems"]
+    batch = build_home_batch(homes, horizon_hours * dt, dt,
+                             int(hems["sub_subhourly_steps"]))
+    eng = make_engine(batch, env, cfg, env.start_index(env.data_start))
+    p, lay, b = eng.params, eng.layout, eng.batch
+    H, s, n = p.horizon, p.s, eng.n_homes
+
+    draws = np.asarray(eng._draws)[:, : H // dt + 1]
+    raw = np.repeat(draws, dt, axis=-1) / dt
+    draw_size = np.zeros((n, H + 1))
+    for i in range(H + 1):
+        if i < dt:
+            draw_size[:, i] = raw[:, i]
+        else:
+            draw_size[:, i] = raw[:, max(i - 1, 0): min(i + 2, raw.shape[1])].mean(axis=1)
+    tank = np.asarray(eng._tank)
+    twh0 = np.asarray(b.temp_wh_init)
+    twh_init = (twh0 * (tank - draw_size[:, 0]) + TAP_TEMP * draw_size[:, 0]) / tank
+
+    oat_w = np.asarray(eng._oat)[: H + 1]
+    ghi_w = np.asarray(eng._ghi)[: H + 1]
+    tou_w = np.asarray(eng._tou)[:H]
+    price = np.broadcast_to(tou_w[None], (n, H)).copy()
+    if season == "auto":
+        heat_season = float(np.max(oat_w)) <= 30.0
+    else:
+        heat_season = season == "heat"
+    heat_cap = np.full(n, float(s) if heat_season else 0.0)
+    cool_cap = np.full(n, 0.0 if heat_season else float(s))
+
+    qp = assemble_qp_step(
+        eng.static, lay, b,
+        oat_window=oat_w, ghi_window=ghi_w, price_total=jnp.asarray(price),
+        draw_frac=jnp.asarray(draw_size / tank[:, None]),
+        temp_in_init=jnp.asarray(b.temp_in_init, dtype=jnp.float32),
+        temp_wh_init=jnp.asarray(twh_init, dtype=jnp.float32),
+        e_batt_init=jnp.asarray(b.e_batt_init_frac * b.batt_capacity,
+                                dtype=jnp.float32),
+        cool_cap=jnp.asarray(cool_cap, dtype=jnp.float32),
+        heat_cap=jnp.asarray(heat_cap, dtype=jnp.float32),
+        wh_cap=s, discount=p.discount,
+    )
+    return qp, eng.static.pattern, lay, int(s)

@@ -12,7 +12,14 @@ Relaxation: the reference's integer duty-cycle variables
 box-constrained continuous duty fractions.  The reference itself divides the
 integer counts by ``sub_subhourly_steps`` to report duty fractions
 (dragg/mpc_calc.py:497-499), so the LP/QP relaxation is the parity target
-(SURVEY.md §2.2); its optimal cost lower-bounds the MILP's.
+(SURVEY.md §2.2); its optimal cost lower-bounds the MILP's.  MEASURED gap
+vs the true integer optimum (tools/milp_gap.py, HiGHS-MILP on these exact
+matrices, 20-home community): aggregate 2.7–2.8 % at H=8 / 3.4–3.6 % at
+H=6 (base-only / mixed), max 5.5 % per home — docs/perf_notes.md round 4.  First-action integerization
+(pin the three k=0 duty counts to rounded values, one extra batched
+re-solve) restores an implementable applied action with 0/20
+comfort-infeasibility; full-horizon rounding is NOT viable (15/20
+infeasible).
 
 Problem form (OSQP convention):  minimize (1/2) x'(eps I)x + q'x subject to
 l <= A x <= u, with A = [A_eq; I] — equality rows (dynamics + initial

@@ -30,15 +30,28 @@ def enable_compile_cache(config: dict | None = None) -> str | None:
     global _ENABLED_DIR
     tpu_cfg = (config or {}).get("tpu", {})
     if not tpu_cfg.get("compile_cache", True):
+        if _ENABLED_DIR is not None:
+            # The process-global JAX cache config cannot be un-set per
+            # Aggregator: a prior enable stays in effect (ADVICE round 3).
+            _log.warning(
+                "compile_cache=false requested but the persistent cache was "
+                "already enabled at %s earlier in this process; it stays "
+                "enabled (jax.config is process-global)", _ENABLED_DIR)
         return None
-    if _ENABLED_DIR is not None:
-        return _ENABLED_DIR
     cache_dir = (
         str(tpu_cfg.get("compile_cache_dir") or "")
         or os.environ.get("DRAGG_COMPILE_CACHE_DIR", "")
         or os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
         or os.path.join(os.path.expanduser("~"), ".cache", "dragg_tpu", "xla")
     )
+    if _ENABLED_DIR is not None:
+        if cache_dir != _ENABLED_DIR:
+            _log.warning(
+                "persistent compilation cache already enabled at %s; "
+                "ignoring later request for %s (jax.config is "
+                "process-global — first enable wins)",
+                _ENABLED_DIR, cache_dir)
+        return _ENABLED_DIR
     try:
         import jax
 

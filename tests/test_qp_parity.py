@@ -12,67 +12,20 @@ import numpy as np
 import pytest
 from scipy.optimize import linprog
 
-from dragg_tpu.config import default_config
-from dragg_tpu.data import load_environment
-from dragg_tpu.engine import make_engine
-from dragg_tpu.homes import build_home_batch, create_homes
-from dragg_tpu.data import load_waterdraw_profiles
+from dragg_tpu.fixtures import assemble_community_qp
 from dragg_tpu.ops.admm import admm_solve_qp
-from dragg_tpu.ops.qp import TAP_TEMP, assemble_qp_step, densify_A
+from dragg_tpu.ops.qp import densify_A
 
 import jax.numpy as jnp
 
 
 def _assemble_real_step(horizon_hours=4, n_homes=6):
-    """Assemble the t=0 QP for a real mixed community."""
-    cfg = default_config()
-    cfg["community"]["total_number_homes"] = n_homes
-    cfg["community"]["homes_pv"] = 1
-    cfg["community"]["homes_battery"] = 1
-    cfg["community"]["homes_pv_battery"] = 1
-    cfg["home"]["hems"]["prediction_horizon"] = horizon_hours
-    seed = int(cfg["simulation"]["random_seed"])
-    env = load_environment(cfg)
-    dt = env.dt
-    waterdraw = load_waterdraw_profiles(None, seed=seed)
-    homes = create_homes(cfg, 24 * dt, dt, waterdraw)
-    hems = cfg["home"]["hems"]
-    batch = build_home_batch(homes, horizon_hours * dt, dt, int(hems["sub_subhourly_steps"]))
-    eng = make_engine(batch, env, cfg, env.start_index(env.data_start))
-    p, lay, b = eng.params, eng.layout, eng.batch
-    H, s, n = p.horizon, p.s, eng.n_homes
-
-    draws = np.asarray(eng._draws)[:, : H // dt + 1]
-    raw = np.repeat(draws, dt, axis=-1) / dt
-    draw_size = np.zeros((n, H + 1))
-    for i in range(H + 1):
-        if i < dt:
-            draw_size[:, i] = raw[:, i]
-        else:
-            draw_size[:, i] = raw[:, max(i - 1, 0) : min(i + 2, raw.shape[1])].mean(axis=1)
-    tank = np.asarray(eng._tank)
-    twh0 = np.asarray(b.temp_wh_init)
-    twh_init = (twh0 * (tank - draw_size[:, 0]) + TAP_TEMP * draw_size[:, 0]) / tank
-
-    oat_w = np.asarray(eng._oat)[: H + 1]
-    ghi_w = np.asarray(eng._ghi)[: H + 1]
-    tou_w = np.asarray(eng._tou)[:H]
-    price = np.broadcast_to(tou_w[None], (n, H)).copy()
-    heat_cap = np.full(n, s)
-    cool_cap = np.zeros(n)
-
-    qp = assemble_qp_step(
-        eng.static, lay, b,
-        oat_window=oat_w, ghi_window=ghi_w, price_total=jnp.asarray(price),
-        draw_frac=jnp.asarray(draw_size / tank[:, None]),
-        temp_in_init=jnp.asarray(b.temp_in_init, dtype=jnp.float32),
-        temp_wh_init=jnp.asarray(twh_init, dtype=jnp.float32),
-        e_batt_init=jnp.asarray(b.e_batt_init_frac * b.batt_capacity, dtype=jnp.float32),
-        cool_cap=jnp.asarray(cool_cap, dtype=jnp.float32),
-        heat_cap=jnp.asarray(heat_cap, dtype=jnp.float32),
-        wh_cap=s, discount=p.discount,
-    )
-    return qp, eng.static.pattern
+    """Assemble the t=0 QP for a real mixed community (shared recipe —
+    dragg_tpu/fixtures.py — so the parity-tested matrices and the
+    MILP-gap-measured matrices cannot drift apart)."""
+    qp, pattern, _lay, _s = assemble_community_qp(
+        horizon_hours=horizon_hours, n_homes=n_homes, season="heat")
+    return qp, pattern
 
 
 def _linprog_reference(A_eq, b_eq, l, u, q):

@@ -1,0 +1,197 @@
+"""Quantify the MILP-relaxation gap (SURVEY.md §7 hard part a).
+
+The reference solves a per-home MIXED-INTEGER program: the duty-cycle
+variables are integer counts in [0, sub_subhourly_steps]
+(dragg/mpc_calc.py:171-173, constrained at :344-349) and GLPK_MI's integer
+optimum is what `cleanup_and_finish` reports (after dividing the counts by
+sub_subhourly_steps, dragg/mpc_calc.py:497-499).  This framework ships the
+LP relaxation (dragg_tpu/ops/qp.py:10-15) whose cost LOWER-bounds the MILP
+— but the gap between the two had never been measured (round-3 verdict,
+weak #7).
+
+This tool builds the exact shipped QP matrices for the BASELINE 20-home
+community and solves each home twice with the same trusted CPU solver
+family (HiGHS): once as the shipped LP relaxation, once with integrality
+restored on the cool/heat/wh duty-count columns (scipy.optimize.milp →
+HiGHS-MILP).  It prints one JSON line with per-home and aggregate gaps.
+
+Usage: python tools/milp_gap.py [--homes 20] [--horizon 8] [--mixed]
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def assemble_step(horizon_hours: int, n_homes: int, mixed: bool):
+    """Assemble the t=0 community QP via the SHARED recipe
+    (dragg_tpu/fixtures.py — same one tests/test_qp_parity.py pins), with
+    the engine's season gate.  Default mix is BASELINE semantics: all
+    base-type homes (HVAC+WH only — BASELINE.md's 20-home row);
+    ``--mixed`` adds PV/battery/PV+battery homes for the broader
+    community shape (reference shipped config has 4 PV of 10 homes)."""
+    from dragg_tpu.fixtures import assemble_community_qp
+
+    return assemble_community_qp(
+        horizon_hours=horizon_hours, n_homes=n_homes,
+        homes_pv=min(4, n_homes // 5) if mixed else 0,
+        homes_battery=min(2, n_homes // 10) if mixed else 0,
+        homes_pv_battery=min(2, n_homes // 10) if mixed else 0,
+        season="auto")
+
+
+def to_bounds(l: np.ndarray, u: np.ndarray) -> list:
+    """(l, u) arrays → linprog bounds list with infinities mapped to None.
+    One helper for every solve site so the handling cannot drift."""
+    return [(lo if np.isfinite(lo) else None, hi if np.isfinite(hi) else None)
+            for lo, hi in zip(l, u)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--homes", type=int, default=20)
+    ap.add_argument("--horizon", type=int, default=8)
+    ap.add_argument("--mixed", action="store_true",
+                    help="PV/battery mix instead of the all-base BASELINE")
+    args = ap.parse_args()
+
+    from scipy.optimize import Bounds, LinearConstraint, linprog, milp
+
+    from dragg_tpu.ops.qp import densify_A
+
+    qp, pat, lay, s = assemble_step(args.horizon, args.homes, args.mixed)
+    A = np.asarray(densify_A(pat, qp.vals), dtype=np.float64)
+    beq = np.asarray(qp.b_eq, dtype=np.float64)
+    l = np.asarray(qp.l_box, dtype=np.float64)
+    u = np.asarray(qp.u_box, dtype=np.float64)
+    q = np.asarray(qp.q, dtype=np.float64)
+    H = lay.H
+
+    # Integer columns: the duty-cycle counts (cool, heat, wh) —
+    # dragg/mpc_calc.py:171-173 declares them integer in [0, s].
+    integrality = np.zeros(pat.n)
+    integrality[lay.i_cool: lay.i_cool + H] = 1
+    integrality[lay.i_heat: lay.i_heat + H] = 1
+    integrality[lay.i_wh: lay.i_wh + H] = 1
+
+    int_cols = integrality > 0
+
+    gaps, rep_gaps, lp_objs, milp_objs, rep_objs = [], [], [], [], []
+    first_gaps, first_objs = [], []
+    n_inf_lp = n_inf_milp = n_inf_repair = n_inf_first = 0
+    for i in range(A.shape[0]):
+        lp = linprog(q[i], A_eq=A[i], b_eq=beq[i], bounds=to_bounds(l[i], u[i]),
+                     method="highs")
+        if not lp.success:
+            n_inf_lp += 1
+            continue
+        mi = milp(c=q[i],
+                  constraints=LinearConstraint(A[i], beq[i], beq[i]),
+                  bounds=Bounds(np.where(np.isfinite(l[i]), l[i], -np.inf),
+                                np.where(np.isfinite(u[i]), u[i], np.inf)),
+                  integrality=integrality)
+        if not mi.success:
+            # LP-feasible but integer-infeasible: the reference would route
+            # this home to its fallback controller; the relaxation solving
+            # it is a capability superset, but count it.
+            n_inf_milp += 1
+            continue
+        scale = max(abs(mi.fun), 1e-3)
+        gaps.append((mi.fun - lp.fun) / scale)
+        lp_objs.append(lp.fun)
+        milp_objs.append(mi.fun)
+
+        # Candidate TPU-native repair: round the LP duty counts to the
+        # nearest integer, PIN them (l = u = rounded), re-solve the LP for
+        # the continuous variables.  On TPU this is a second batched IPM
+        # solve with tightened boxes — no branch & bound.  Measures (a) how
+        # often naive rounding is comfort-infeasible, (b) the cost gap of
+        # the repaired integer solution vs the true MILP optimum.
+        xr = np.round(lp.x[int_cols])
+        lr, ur = l[i].copy(), u[i].copy()
+        lr[int_cols] = xr
+        ur[int_cols] = xr
+        rep = linprog(q[i], A_eq=A[i], b_eq=beq[i], bounds=to_bounds(lr, ur),
+                      method="highs")
+        if not rep.success:
+            n_inf_repair += 1
+        else:
+            rep_gaps.append((rep.fun - mi.fun) / scale)
+            rep_objs.append(rep.fun)
+
+        # Receding-horizon repair: only the FIRST-step duty counts are ever
+        # APPLIED to the plant (the rest re-plan next step), so integerizing
+        # k=0 alone reproduces the reference's implementable discretization
+        # with minimal restriction.  Try nearest; on infeasibility retry
+        # with the other rounding of each first-step count (2^3 worst case).
+        # NOTE on the reported number: the re-solved objective is a PARTIAL
+        # relaxation (k>0 duty columns stay continuous), so it sits BETWEEN
+        # the LP bound and the full-integer optimum — "first_plan_cost_
+        # vs_milp" below is typically negative and is NOT a suboptimality
+        # bound on the repair; the headline results here are the
+        # feasibility count and that the applied action is implementable.
+        # Closed-loop realized-cost comparison needs a full sim A/B.
+        first_cols = np.array([lay.i_cool, lay.i_heat, lay.i_wh])
+        x0 = lp.x[first_cols]
+        found = None
+        cands = sorted(itertools.product(*[
+            sorted({np.floor(v), np.ceil(v), np.round(v)}) for v in x0
+        ]), key=lambda c: np.sum(np.abs(np.asarray(c) - x0)))
+        for cand in cands:
+            lr, ur = l[i].copy(), u[i].copy()
+            cv = np.clip(np.asarray(cand), l[i][first_cols], u[i][first_cols])
+            lr[first_cols] = cv
+            ur[first_cols] = cv
+            r0 = linprog(q[i], A_eq=A[i], b_eq=beq[i],
+                         bounds=to_bounds(lr, ur), method="highs")
+            if r0.success:
+                found = r0
+                break
+        if found is None:
+            n_inf_first += 1
+        else:
+            first_gaps.append((found.fun - mi.fun) / scale)
+            first_objs.append(found.fun)
+
+    out = {
+        "tool": "milp_gap",
+        "homes": args.homes,
+        "horizon_h": args.horizon,
+        "sub_steps": s,
+        "n_compared": len(gaps),
+        "n_lp_infeasible": n_inf_lp,
+        "n_milp_only_infeasible": n_inf_milp,
+        "gap_mean": float(np.mean(gaps)) if gaps else None,
+        "gap_max": float(np.max(gaps)) if gaps else None,
+        "gap_median": float(np.median(gaps)) if gaps else None,
+        "lp_cost_total": float(np.sum(lp_objs)) if lp_objs else None,
+        "milp_cost_total": float(np.sum(milp_objs)) if milp_objs else None,
+        "aggregate_gap": (float((np.sum(milp_objs) - np.sum(lp_objs))
+                                / max(abs(np.sum(milp_objs)), 1e-3))
+                          if milp_objs else None),
+        # Rounding-repair candidate (see loop body): cost of the repaired
+        # integer-feasible solution vs the true MILP optimum.
+        "n_repair_infeasible": n_inf_repair,
+        "repair_gap_mean": float(np.mean(rep_gaps)) if rep_gaps else None,
+        "repair_gap_max": float(np.max(rep_gaps)) if rep_gaps else None,
+        "repair_cost_total": float(np.sum(rep_objs)) if rep_objs else None,
+        # First-action-only integerization (receding-horizon repair).  The
+        # cost-vs-MILP numbers are from a PARTIAL relaxation (see loop
+        # comment) — feasibility count is the headline result.
+        "n_first_infeasible": n_inf_first,
+        "first_plan_cost_vs_milp_mean": (float(np.mean(first_gaps))
+                                         if first_gaps else None),
+        "first_plan_cost_vs_milp_max": (float(np.max(first_gaps))
+                                        if first_gaps else None),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
