@@ -539,6 +539,13 @@ class Aggregator:
             # load_pytree's leaf-shape check.
             "n_home_slots": self.engine.n_homes if self.engine is not None
                             else None,
+            # The warm-start carry is zero-width unless a solver consumes
+            # it (engine.init_state), so a checkpoint written under
+            # solver=admm (or ipm_warm=true) has differently-shaped
+            # warm_x/warm_y_box leaves than the ipm default — another
+            # "invalidate, don't crash" dimension (advisor finding, r4).
+            "warm_cols": ((self.engine.layout.n if self.engine._carry_warm
+                           else 0) if self.engine is not None else None),
             "horizon": int(self.config["home"]["hems"]["prediction_horizon"]),
             # Shard files are per-process; a checkpoint from a different
             # process topology must start fresh, not mis-assemble.

@@ -22,25 +22,16 @@ OK, WARN, FAIL = "ok", "warn", "FAIL"
 
 
 def _check_backend(timeout_s: float = 60.0) -> dict:
-    """Probe jax backend init in a subprocess with a hard timeout."""
-    code = (
-        "import json, jax\n"
-        "ds = jax.devices()\n"
-        "print(json.dumps({'backend': jax.default_backend(),"
-        " 'devices': [str(d) for d in ds],"
-        " 'kind': getattr(ds[0], 'device_kind', '')}))\n"
-    )
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, text=True, timeout=timeout_s)
-        if proc.returncode == 0:
-            info = json.loads(proc.stdout.strip().splitlines()[-1])
-            return {"status": OK, **info}
-        return {"status": FAIL, "error": (proc.stderr or "")[-500:]}
-    except subprocess.TimeoutExpired:
-        return {"status": FAIL,
-                "error": f"backend init hung >{timeout_s:.0f}s (wedged "
-                         "accelerator tunnel? try JAX_PLATFORMS=cpu)"}
+    """Probe jax backend init via the SHARED subprocess probe
+    (dragg_tpu/utils/probe.py) so doctor and bench.py cannot disagree
+    about tunnel liveness."""
+    from dragg_tpu.utils.probe import probe_backend
+
+    r = probe_backend(timeout_s)
+    if r.pop("ok"):
+        r.pop("elapsed_s", None)
+        return {"status": OK, **r}
+    return {"status": FAIL, "error": r["error"]}
 
 
 def _check_cpu_fallback(timeout_s: float) -> dict:

@@ -219,6 +219,10 @@ class Engine:
         # FactorCarry; the CR "factor" is a pytree, so the ADMM path keeps
         # the scan kernels when cr is selected (the IPM uses cr fully).
         self._admm_band_kernel = "xla" if kern == "cr" else kern
+        # Whether CommunityState carries the receding-horizon warm start:
+        # only the ADMM solver and the (measured-pessimal, opt-in)
+        # ipm_warm_start consume it — see init_state.
+        self._carry_warm = params.solver != "ipm" or params.ipm_warm
         # ShardedEngine sets these before super().__init__; the base engine
         # runs unsharded.
         self._solver_mesh = getattr(self, "mesh", None) \
@@ -314,6 +318,17 @@ class Engine:
         n = self.n_homes
         H = self.params.horizon
         f32 = jnp.float32
+        # Warm-start carry is dead weight on the default IPM path
+        # (ipm_warm=False — a measured +55 % iteration PESSIMIZATION,
+        # docs/perf_notes.md round 3): two (n, nvar) f32 arrays threaded
+        # through every scan step, checkpoint, and resume (~35 MB at
+        # 10k×48h, ~350 MB at the 100k target).  Zero-width columns keep
+        # the pytree STRUCTURE (scan carries and shardings see the same
+        # leaves) while dropping the bytes (round-3 verdict, weak #4);
+        # leaf SHAPES do change with the solver config, which
+        # aggregator._run_shape records so a mismatched checkpoint is
+        # invalidated instead of crashing resume.
+        nw = self.layout.n if self._carry_warm else 0
         return CommunityState(
             temp_in=jnp.asarray(b.temp_in_init, dtype=f32),
             temp_wh=jnp.asarray(b.temp_wh_init, dtype=f32),
@@ -322,8 +337,8 @@ class Engine:
             plan_cool=jnp.zeros((n, H), dtype=f32),
             plan_heat=jnp.zeros((n, H), dtype=f32),
             plan_wh=jnp.zeros((n, H), dtype=f32),
-            warm_x=jnp.zeros((n, self.layout.n), dtype=f32),
-            warm_y_box=jnp.zeros((n, self.layout.n), dtype=f32),
+            warm_x=jnp.zeros((n, nw), dtype=f32),
+            warm_y_box=jnp.zeros((n, nw), dtype=f32),
             warm_rho=jnp.full((n,), self.params.admm_rho, dtype=f32),
             key=jax.random.PRNGKey(self.params.seed),
         )
@@ -553,8 +568,10 @@ class Engine:
             plan_cool=jnp.where(sel2, mpc.cool, state.plan_cool),
             plan_heat=jnp.where(sel2, mpc.heat, state.plan_heat),
             plan_wh=jnp.where(sel2, mpc.wh, state.plan_wh),
-            warm_x=shift_warm_start(sol.x, lay),
-            warm_y_box=shift_warm_start(sol.y_box, lay),
+            warm_x=(shift_warm_start(sol.x, lay) if self._carry_warm
+                    else state.warm_x),
+            warm_y_box=(shift_warm_start(sol.y_box, lay) if self._carry_warm
+                        else state.warm_y_box),
             warm_rho=sol.rho,
             key=state.key,
         )

@@ -24,8 +24,14 @@ def assemble_community_qp(horizon_hours: int = 4, n_homes: int = 6,
     """Assemble the t=0 community QP for a seeded mixed community.
 
     ``season``: "heat" pins the reference test fixture's heat-only gate;
-    "auto" applies the engine's gate (max OAT over the horizon <= 30 C ->
-    heat-only, else cool-only — dragg/mpc_calc.py:302-309).
+    "auto" applies the NOMINAL community-wide form of the season rule
+    (max window OAT <= 30 C -> heat-only, else cool-only — the threshold
+    of dragg/mpc_calc.py:302-309).  NOTE this is a simplification of the
+    engine's live gate, which is per-home and includes sampled forecast
+    noise (dragg_tpu/engine.py:421-424) — with the default deep-winter
+    t=0 window the two agree for every home (max OAT is far below 30 C),
+    but near-threshold windows could diverge; measurement tools relying
+    on "auto" should stick to windows away from the threshold.
 
     Returns ``(qp, pattern, layout, s)`` where ``s`` is
     ``sub_subhourly_steps`` (the duty-count cap).

@@ -1,0 +1,73 @@
+"""Subprocess jax-backend probe with a hard timeout — ONE implementation.
+
+CLAUDE.md gotcha: a wedged axon tunnel hangs ANY in-process jax backend
+init (the plugin registers at interpreter start), and the local proxy
+accepting TCP is not liveness — so the only safe probe runs jax.devices()
+in a SUBPROCESS under a hard timeout.  This module is the single home for
+that pattern; ``dragg_tpu doctor``, ``bench.py``'s tunnel-aware ladder,
+and ``tools/tpu_probe.py`` (the probe CLI / outage recorder) all call it
+so their liveness verdicts cannot drift apart (advisor finding, round 4).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+_PROBE_CODE = (
+    "import json, jax\n"
+    "ds = jax.devices()\n"
+    "print(json.dumps({'backend': jax.default_backend(),"
+    " 'devices': [str(d) for d in ds],"
+    " 'kind': getattr(ds[0], 'device_kind', '')}))\n"
+)
+
+
+def probe_backend(timeout_s: float = 60.0) -> dict:
+    """Probe default-backend init in a subprocess.
+
+    Returns ``{'ok': True, 'backend', 'devices', 'kind', 'elapsed_s'}`` on
+    success, else ``{'ok': False, 'error', 'elapsed_s', 'timeout': bool}``.
+    """
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        elapsed = round(time.monotonic() - t0, 1)
+        if proc.returncode == 0:
+            info = json.loads(proc.stdout.strip().splitlines()[-1])
+            return {"ok": True, "elapsed_s": elapsed, **info}
+        return {"ok": False, "elapsed_s": elapsed, "timeout": False,
+                "error": (proc.stderr or "")[-500:]}
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "elapsed_s": round(time.monotonic() - t0, 1),
+                "timeout": True,
+                "error": f"backend init hung >{timeout_s:.0f}s (wedged "
+                         "accelerator tunnel? try JAX_PLATFORMS=cpu)"}
+
+
+def probe_tpu(timeout_s: float = 60.0) -> tuple[bool, str]:
+    """(tpu_alive, one-line detail) — alive only when the default backend
+    actually resolves to a TPU within the timeout."""
+    r = probe_backend(timeout_s)
+    if r["ok"]:
+        alive = r.get("backend") == "tpu"
+        return alive, (f"{r.get('backend')} {r.get('kind', '')} "
+                       f"({r['elapsed_s']}s)").strip()
+    return False, f"{r['error'][:160]} ({r['elapsed_s']}s)".replace("\n", " ")
+
+
+def append_probe_log(path: str, alive: bool, detail: str) -> str:
+    """Append one timestamped verdict line to the probe transcript (the
+    committed outage/uptime record round 3 lacked); returns the line."""
+    stamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+    line = f"{stamp} {'LIVE' if alive else 'DOWN'} {detail}"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        f.write(line + "\n")
+    return line

