@@ -544,8 +544,8 @@ class Aggregator:
             # solver=admm (or ipm_warm=true) has differently-shaped
             # warm_x/warm_y_box leaves than the ipm default — another
             # "invalidate, don't crash" dimension (advisor finding, r4).
-            "warm_cols": ((self.engine.layout.n if self.engine._carry_warm
-                           else 0) if self.engine is not None else None),
+            "warm_cols": (self.engine.warm_cols
+                          if self.engine is not None else None),
             "horizon": int(self.config["home"]["hems"]["prediction_horizon"]),
             # Shard files are per-process; a checkpoint from a different
             # process topology must start fresh, not mis-assemble.

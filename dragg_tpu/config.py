@@ -214,6 +214,14 @@ _DEFAULT: dict[str, Any] = {
                                 # finish them alone (1.5-1.6x solver time,
                                 # equal-or-better solve rates); 0 disables
         "ipm_tail_iters": 0,  # tail-phase iteration cap (0 = ipm_iters)
+        "integer_first_action": False,  # MILP repair: pin the three k=0
+                                        # duty counts to rounded values and
+                                        # re-solve, so the APPLIED action is
+                                        # integer like the reference's
+                                        # (measured: relaxation sits 2.7-3.6%
+                                        # below the integer optimum; pinning
+                                        # k=0 is 20/20 feasible — perf notes
+                                        # round 4).  Costs a 2nd solve/step.
         "ipm_freeze_zmax": 1e3,  # divergence-freeze dual threshold (scaled
                                  # space): freeze a home when rp stalls AND
                                  # its box duals exceed this; feasible homes

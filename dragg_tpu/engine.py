@@ -154,6 +154,8 @@ class EngineParams(NamedTuple):
     ipm_warm: bool      # seed the IPM from the receding-horizon shift
     ipm_eps: float      # IPM stopping tolerance (decoupled from admm_eps)
     ipm_freeze_zmax: float  # divergence-freeze dual threshold (scaled space)
+    integer_first_action: bool  # MILP repair: pin rounded k=0 duty counts
+                                # and re-solve (one extra IPM solve/step)
     band_kernel: str    # "auto" | "pallas" | "xla" | "cr" band factor/solve
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
@@ -221,7 +223,7 @@ class Engine:
         self._admm_band_kernel = "xla" if kern == "cr" else kern
         # Whether CommunityState carries the receding-horizon warm start:
         # only the ADMM solver and the (measured-pessimal, opt-in)
-        # ipm_warm_start consume it — see init_state.
+        # ipm_warm_start consume it — see init_state / warm_cols.
         self._carry_warm = params.solver != "ipm" or params.ipm_warm
         # ShardedEngine sets these before super().__init__; the base engine
         # runs unsharded.
@@ -311,6 +313,14 @@ class Engine:
         like a cr measurement."""
         return self._admm_band_kernel
 
+    @property
+    def warm_cols(self) -> int:
+        """Width of the warm-start carry columns in CommunityState — the
+        ONE place this is decided (init_state sizes the leaves by it and
+        aggregator._run_shape keys checkpoint invalidation on it; deriving
+        it twice is how the two silently disagree)."""
+        return self.layout.n if self._carry_warm else 0
+
     # ---------------------------------------------------------------- state
     def init_state(self) -> CommunityState:
         """t=0 initial conditions (dragg/mpc_calc.py:267-277)."""
@@ -328,7 +338,7 @@ class Engine:
         # leaf SHAPES do change with the solver config, which
         # aggregator._run_shape records so a mismatched checkpoint is
         # invalidated instead of crashing resume.
-        nw = self.layout.n if self._carry_warm else 0
+        nw = self.warm_cols
         return CommunityState(
             temp_in=jnp.asarray(b.temp_in_init, dtype=f32),
             temp_wh=jnp.asarray(b.temp_wh_init, dtype=f32),
@@ -464,16 +474,21 @@ class Engine:
             # better solve counts, docs/perf_notes.md): the budget split
             # and its eligibility conditions live inside ipm_solve_qp —
             # the engine just forwards the cap and the knobs.
-            sol = ipm_solve_qp(
-                self.static.pattern, qp.vals, qp.b_eq, qp.l_box, qp.u_box,
-                qp.q, reg=p.admm_reg, iters=p.ipm_iters,
-                tail_frac=p.ipm_tail_frac, tail_iters=p.ipm_tail_iters,
-                eps_abs=p.ipm_eps, eps_rel=p.ipm_eps,
-                band_kernel=self._band_kernel,
-                mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
-                x0=state.warm_x if p.ipm_warm else None,
-                freeze_zmax=p.ipm_freeze_zmax,
-            )
+            def run_ipm(l_box, u_box):
+                return ipm_solve_qp(
+                    self.static.pattern, qp.vals, qp.b_eq, l_box, u_box,
+                    qp.q, reg=p.admm_reg, iters=p.ipm_iters,
+                    tail_frac=p.ipm_tail_frac, tail_iters=p.ipm_tail_iters,
+                    eps_abs=p.ipm_eps, eps_rel=p.ipm_eps,
+                    band_kernel=self._band_kernel,
+                    mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
+                    x0=state.warm_x if p.ipm_warm else None,
+                    freeze_zmax=p.ipm_freeze_zmax,
+                )
+
+            sol = run_ipm(qp.l_box, qp.u_box)
+            if p.integer_first_action:
+                sol = self._integerize_first_action(qp, sol, run_ipm)
             return sol, factor
         return admm_solve_qp_cached(
             self.static.pattern, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
@@ -493,6 +508,104 @@ class Engine:
             mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
             x0=state.warm_x, y_box0=state.warm_y_box,
             rho0=state.warm_rho,
+        )
+
+    def _integerize_first_action(self, qp, sol, run_ipm):
+        """Opt-in MILP repair (``tpu.integer_first_action``): pin the three
+        k=0 duty counts to their rounded values and re-solve, so the
+        APPLIED action matches the reference's integer duty-cycle
+        discretization (dragg/mpc_calc.py:171-173 — integer counts in
+        [0, s]; only k=0 ever reaches the plant in the receding horizon).
+
+        Measured basis (tools/milp_gap.py, docs/perf_notes.md round 4):
+        the shipped relaxation sits 2.7-3.6 % below the true integer
+        optimum; full-horizon rounding is comfort-infeasible for 15/20
+        homes, while first-action pinning (with a rounding-direction
+        retry) is feasible for 20/20.  NEAREST rounding alone is not
+        enough — rounding the active duty DOWN can push the k=1
+        temperature out of its comfort band (measured: 4/8 homes
+        infeasible at H=6) — so the pin is bumped one count in the
+        comfort-safe direction using the QP's own row arithmetic: the
+        k=1 temperatures are affine in the k=0 duty counts
+        (rows r_tind+0 / r_twhd+0, build_qp_static), so the band check
+        is closed-form and costs no extra solve.  Homes whose pinned
+        re-solve nevertheless fails KEEP the relaxed solution (graceful
+        degradation — no new fallback routes).  Cost: one extra batched
+        IPM solve per step.
+        """
+        lay = self.layout
+        st, b = self.static, self.batch
+        f32 = jnp.float32
+        pc = jnp.asarray(b.hvac_p_c, f32)
+        ph = jnp.asarray(b.hvac_p_h, f32)
+        pwh = jnp.asarray(b.wh_p, f32)
+        a_in = jnp.asarray(st.a_in, f32)
+        awr = jnp.asarray(st.awr, f32)
+        a_wh = jnp.asarray(st.a_wh, f32)
+
+        def col(a, c):
+            return a[:, c]
+
+        lo = lambda c: col(qp.l_box, c)
+        hi = lambda c: col(qp.u_box, c)
+        cool_r, heat_r, wh_r = (col(sol.x, lay.i_cool), col(sol.x, lay.i_heat),
+                                col(sol.x, lay.i_wh))
+        pin_c = jnp.clip(jnp.round(cool_r), lo(lay.i_cool), hi(lay.i_cool))
+        pin_h = jnp.clip(jnp.round(heat_r), lo(lay.i_heat), hi(lay.i_heat))
+        pin_w = jnp.clip(jnp.round(wh_r), lo(lay.i_wh), hi(lay.i_wh))
+
+        # k=1 indoor temp under the pin (row r_tind+0: T1 = b + kin*T0
+        # - a_in*pc*cool0 + a_in*ph*heat0, T0 pinned -> affine delta).
+        def t1_of(pc_pin, ph_pin):
+            return col(sol.x, lay.i_tin + 1) + a_in * (
+                ph * (ph_pin - heat_r) - pc * (pc_pin - cool_r))
+
+        heat_active = hi(lay.i_heat) > 0.5  # season gate (cool_cap/heat_cap)
+        t1 = t1_of(pin_c, pin_h)
+        need_up = t1 < lo(lay.i_tin + 1)    # too cold: +heat / -cool
+        need_dn = t1 > hi(lay.i_tin + 1)    # too hot: -heat / +cool
+        pin_h = jnp.where(need_up & heat_active,
+                          jnp.minimum(pin_h + 1, hi(lay.i_heat)), pin_h)
+        pin_c = jnp.where(need_up & ~heat_active,
+                          jnp.maximum(pin_c - 1, lo(lay.i_cool)), pin_c)
+        pin_h = jnp.where(need_dn & heat_active,
+                          jnp.maximum(pin_h - 1, lo(lay.i_heat)), pin_h)
+        pin_c = jnp.where(need_dn & ~heat_active,
+                          jnp.minimum(pin_c + 1, hi(lay.i_cool)), pin_c)
+        # k=1 WH temp (row r_twhd+0) sees the FINAL indoor delta + wh0.
+        dt1 = t1_of(pin_c, pin_h) - col(sol.x, lay.i_tin + 1)
+        twh1 = (col(sol.x, lay.i_twh + 1) + awr * dt1
+                + a_wh * pwh * (pin_w - wh_r))
+        pin_w = jnp.where(twh1 < lo(lay.i_twh + 1),
+                          jnp.minimum(pin_w + 1, hi(lay.i_wh)),
+                          jnp.where(twh1 > hi(lay.i_twh + 1),
+                                    jnp.maximum(pin_w - 1, lo(lay.i_wh)),
+                                    pin_w))
+
+        cols = jnp.asarray([lay.i_cool, lay.i_heat, lay.i_wh])
+        pinned = jnp.stack([pin_c, pin_h, pin_w], axis=1)
+        l2 = qp.l_box.at[:, cols].set(pinned)
+        u2 = qp.u_box.at[:, cols].set(pinned)
+        sol2 = run_ipm(l2, u2)
+        # Adopt the repaired iterate only where BOTH solves succeeded;
+        # solvedness itself stays the relaxation's verdict.
+        keep = sol2.solved & sol.solved
+
+        def pick(b, a):
+            k = keep.reshape(keep.shape + (1,) * (a.ndim - 1)) \
+                if a.ndim else keep  # iters is a scalar — handled below
+            return jnp.where(k, b, a)
+
+        return type(sol)(
+            x=pick(sol2.x, sol.x),
+            y_eq=pick(sol2.y_eq, sol.y_eq),
+            y_box=pick(sol2.y_box, sol.y_box),
+            r_prim=pick(sol2.r_prim, sol.r_prim),
+            r_dual=pick(sol2.r_dual, sol.r_dual),
+            solved=sol.solved,
+            infeasible=sol.infeasible,
+            iters=sol.iters + sol2.iters,
+            rho=pick(sol2.rho, sol.rho),
         )
 
     def _finish(self, state: CommunityState, t, sol, aux: StepAux):
@@ -725,6 +838,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         ipm_warm=bool(tpu_cfg.get("ipm_warm_start", False)),
         ipm_eps=float(tpu_cfg.get("ipm_eps", 2e-4)),
         ipm_freeze_zmax=float(tpu_cfg.get("ipm_freeze_zmax", 1e3)),
+        integer_first_action=bool(tpu_cfg.get("integer_first_action", False)),
         band_kernel=str(tpu_cfg.get("band_kernel", "auto")),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),

@@ -214,3 +214,64 @@ def test_reference_solver_names_map(tiny_config):
 
     with pytest.raises(ValueError, match="solver"):
         engine_params(cfg, 0)
+
+
+def test_integer_first_action_repair(tmp_path):
+    """MILP repair (tpu.integer_first_action): on solved steps the APPLIED
+    duty fractions must be integer counts / s (the reference's implementable
+    discretization, dragg/mpc_calc.py:171-173,497-499), solve rate must not
+    collapse vs the relaxation, and comfort bands must still hold."""
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = 8
+    cfg["community"]["homes_pv"] = 1
+    cfg["community"]["homes_battery"] = 1
+    cfg["community"]["homes_pv_battery"] = 1
+    cfg["simulation"]["end_datetime"] = "2015-01-02 00"
+    cfg["home"]["hems"]["prediction_horizon"] = 6
+    s = int(cfg["home"]["hems"]["sub_subhourly_steps"])
+
+    def run(flag, sub):
+        import copy
+
+        c = copy.deepcopy(cfg)
+        c["tpu"]["integer_first_action"] = flag
+        agg = Aggregator(config=c, outputs_dir=str(tmp_path / sub))
+        agg.run()
+        with open(os.path.join(agg.run_dir, "baseline", "results.json")) as f:
+            return json.load(f)
+
+    base = run(False, "relaxed")
+    rep = run(True, "repaired")
+
+    def stats(data):
+        solved_frac = []
+        n_integral = n_counts = n_solved = 0
+        for name, d in data.items():
+            if name == "Summary":
+                continue
+            cs = np.asarray(d["correct_solve"], dtype=bool)
+            n_solved += int(cs.sum())
+            for key in ("hvac_cool_on_opt", "hvac_heat_on_opt", "wh_heat_on_opt"):
+                counts = np.asarray(d[key])[cs] * s
+                n_integral += int(np.sum(np.abs(counts - np.round(counts)) < 1e-3))
+                n_counts += counts.size
+            solved_frac.append(cs.mean())
+        return (float(np.mean(solved_frac)),
+                n_integral / max(n_counts, 1), n_solved)
+
+    rate_base, int_base, _ = stats(base)
+    rate_rep, int_rep, n_solved = stats(rep)
+    assert n_solved > 0
+    # Repaired applied actions are integer counts for the overwhelming
+    # majority of solved steps — NOT all: the documented graceful
+    # degradation keeps the relaxed (fractional) solution for homes whose
+    # pinned re-solve fails, so a strict max-residual bound would fail by
+    # design the first time one home's repair does (advisor finding, r4).
+    # Measured coverage is 99.9 % (docs/perf_notes.md round 4).
+    assert int_rep >= 0.9, f"repair coverage too low: {int_rep:.3f}"
+    # The relaxation genuinely uses fractional cycles (else the repair
+    # would be vacuous and the MILP gap unexplained).
+    assert int_base < 0.9, f"relaxation unexpectedly integral: {int_base:.3f}"
+    # No solve-rate collapse (repair failures keep the relaxed solution,
+    # so the rate cannot drop below solved∩solved homes by much).
+    assert rate_rep >= rate_base - 0.05, (rate_rep, rate_base)

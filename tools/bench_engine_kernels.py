@@ -1,0 +1,91 @@
+"""Engine-level band-kernel A/B: time warm engine steps for each
+``tpu.band_kernel`` family on whatever backend is up.
+
+The round-4 microbench (docs/onchip_r4/band_kernel_24h.json) showed the
+pallas refined solve 0.73x vs the XLA scan on real Mosaic while the
+factor is 1.41x the other way — so the engine-level winner is not
+decidable from kernel timings alone.  This tool gives the end-to-end
+verdict that sets the ``auto`` policy.
+
+Prints one JSON line: {kernel: s/step} + the winner.
+
+Usage: python tools/bench_engine_kernels.py [--homes 1000]
+       [--horizon-hours 24] [--steps 6] [--kernels pallas,xla,cr]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--homes", type=int, default=1000)
+    ap.add_argument("--horizon-hours", type=int, default=24)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--kernels", default="pallas,xla,cr")
+    args = ap.parse_args()
+
+    import jax
+
+    import bench as bench_mod
+    from dragg_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    dev = jax.devices()[0]
+    res = {
+        "tool": "bench_engine_kernels",
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "homes": args.homes, "horizon_h": args.horizon_hours,
+        "steps": args.steps,
+    }
+
+    timings = {}
+    for kern in args.kernels.split(","):
+        kern = kern.strip()
+        try:
+            # THE benchmark community (bench.build — same population mix
+            # and sim window as the headline bench, one definition).
+            eng, _np = bench_mod.build(args.homes, args.horizon_hours,
+                                       1000, solver="ipm",
+                                       band_kernel=kern)
+            eng = eng if eng.band_kernel == kern else None
+            if eng is None:
+                timings[kern] = None
+                res[f"{kern}_err"] = "kernel did not resolve as requested"
+                continue
+            st = eng.init_state()
+            rp0 = np.zeros(eng.params.horizon, dtype=np.float32)
+            t_c0 = time.perf_counter()
+            st, out = eng.step(st, 0, rp0)          # compile + cold step
+            jax.block_until_ready(out.agg_load)
+            res[f"{kern}_compile_s"] = round(time.perf_counter() - t_c0, 1)
+            t0 = time.perf_counter()
+            done = 0
+            for i in range(1, args.steps + 1):
+                st, out = eng.step(st, i, rp0)
+                jax.block_until_ready(out.agg_load)
+                done = i
+                if time.perf_counter() - t0 > 120:
+                    break
+            timings[kern] = round((time.perf_counter() - t0) / done, 4)
+        except Exception as e:
+            timings[kern] = None
+            res[f"{kern}_err"] = repr(e)[:300]
+
+    res["s_per_step"] = timings
+    alive = {k: v for k, v in timings.items() if v}
+    if alive:
+        res["winner"] = min(alive, key=alive.get)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,15 +1,31 @@
 #!/bin/bash
 # On-chip measurement runbook — run the moment the TPU tunnel is alive.
-# Captures every round-3 measurement in priority order (CLAUDE.md "First
-# actions"), each under its own timeout so a mid-run tunnel flap still
-# leaves the earlier results on disk.  Output: docs/onchip_r3/*.json|log.
+# Round-4 revision, incorporating the first live window's lessons
+# (docs/onchip_r4/, docs/perf_notes.md round 4):
+#   * probe BETWEEN steps (a hung compile WEDGES the tunnel for every
+#     later backend init — bail instead of burning timeouts);
+#   * staged engine sizes (1k before 10k: the 10k attempt hung between
+#     engine build and first compile; 1k localizes scale-dependence);
+#   * engine-level band-kernel A/B (the microbench says pallas solve is
+#     0.73x vs the XLA scan on real Mosaic — the engine default needs an
+#     end-to-end verdict);
+#   * DRAGG_LANE_BLOCK=256 fallback at m=149 (512 scoped-VMEM OOMs).
+# Each step runs under its own timeout so a mid-run flap still leaves
+# earlier results on disk.  Output: docs/onchip_r*/ *.json|log.
 #
 #   bash tools/onchip_runbook.sh [outdir]
 set -u
 cd "$(dirname "$0")/.."
-OUT=${1:-docs/onchip_r3}
+OUT=${1:-docs/onchip_r4}
 mkdir -p "$OUT"
+export DRAGG_PROBE_LOG="$OUT/probe_log.txt"
 stamp() { date +%H:%M:%S; }
+probe() { # probe <label> — returns 1 (and logs) when the tunnel is down
+  python tools/tpu_probe.py --log "$DRAGG_PROBE_LOG" >/dev/null 2>&1
+  local rc=$?
+  echo "[$(stamp)] probe($1) rc=$rc" | tee -a "$OUT/runbook.log"
+  return $rc
+}
 run() { # run <name> <timeout_s> <cmd...>
   local name=$1 t=$2; shift 2
   echo "[$(stamp)] >>> $name ($*)" | tee -a "$OUT/runbook.log"
@@ -21,25 +37,47 @@ run() { # run <name> <timeout_s> <cmd...>
 }
 
 # 0. Is the chip actually reachable? (hard timeout; a wedged tunnel hangs)
-timeout 60 python -c "import jax; d=jax.devices()[0]; print(d.platform, d.device_kind)" \
-  > "$OUT/probe.txt" 2>&1 || { echo "TPU unreachable; aborting" | tee -a "$OUT/runbook.log"; exit 1; }
-cat "$OUT/probe.txt" | tee -a "$OUT/runbook.log"
+probe start || { echo "TPU unreachable; aborting" | tee -a "$OUT/runbook.log"; exit 1; }
 
-# 1. Band-kernel microbench: first-ever Mosaic timing of the pallas kernels,
-#    the fused factor+solve variant, and the LANE_BLOCK sweep.
+# 1. Band-kernel microbench (failure-isolated per timing).  48h runs at
+#    LANE_BLOCK=256 first — 512 scoped-VMEM OOMs at m=149 — plus the
+#    default for the A/B once the OOM is understood.
 run band_kernel_24h 600 python tools/bench_band_kernel.py --homes 10000 --horizon 24
-run band_kernel_48h 600 python tools/bench_band_kernel.py --homes 25000 --horizon 48
+probe after_micro24 || exit 1
+run band_kernel_48h_lb256 600 env DRAGG_LANE_BLOCK=256 \
+  python tools/bench_band_kernel.py --homes 25000 --horizon 48
+probe after_micro48 || exit 1
 
-# 2. Headline bench at the BASELINE row-3 config (24h) — phase timers,
-#    hbm_util, band_kernel field.  --solver ipm skips the ADMM race: the
-#    default is settled (docs/perf_notes.md "Solver default decision") and
-#    racing would burn ~half the live-tunnel window recompiling ADMM.
-run bench_10k_24h 1800 python bench.py --homes 10000 --horizon-hours 24 --solver ipm
+# 2. STAGED engine benches: 1k first (localizes the 10k hang), then the
+#    BASELINE row-3 config.  bench.py itself probe-gates its TPU attempts
+#    and falls back to a full-size CPU run, so a wedge mid-step still
+#    yields a usable artifact.  IMPORTANT: bench.py's internal ladder
+#    budget (probe 60 + BENCH_TPU_TIMEOUT + probe + retry/2 + CPU
+#    fallback) must FIT inside the outer `run` timeout, or the outer
+#    kill eats the fallback JSON — size both explicitly per step.
+run bench_1k_24h 900 env BENCH_TPU_TIMEOUT=300 BENCH_CPU_TIMEOUT=300 \
+  python bench.py --homes 1000 --horizon-hours 24 --solver ipm
+probe after_1k || exit 1
 
-# 3. The row-5 per-chip slice: 25k homes x 48h.
-run bench_25k_48h 2400 python bench.py --homes 25000 --horizon-hours 48 --steps 8 --solver ipm
+# 3. Engine-level band-kernel A/B at 1k (cheap): auto resolves to pallas;
+#    xla and cr need explicit config — use the sweep tool.
+run band_ab_1k 900 python tools/bench_engine_kernels.py --homes 1000 --horizon-hours 24
+probe after_ab || exit 1
 
-# 4. Scale validation at 10k x 48h x 2 days (solve rate + comfort).
+# 4. Headline bench at the BASELINE row-3 config (24h).
+#    Internal budget: 60 + 600 + 60 + 300 + 600 = 1620 < 1800.
+run bench_10k_24h 1800 env BENCH_TPU_TIMEOUT=600 BENCH_CPU_TIMEOUT=600 \
+  python bench.py --homes 10000 --horizon-hours 24 --solver ipm
+probe after_10k || exit 1
+
+# 5. The row-5 per-chip slice: 25k homes x 48h (lane block 256 until the
+#    m=149 VMEM OOM is resolved).  Internal: 60+600+60+300+1200 = 2220.
+run bench_25k_48h 2400 env DRAGG_LANE_BLOCK=256 \
+  BENCH_TPU_TIMEOUT=600 BENCH_CPU_TIMEOUT=1200 \
+  python bench.py --homes 25000 --horizon-hours 48 --steps 8 --solver ipm
+probe after_25k || exit 1
+
+# 6. Scale validation at 10k x 48h x 2 days (solve rate + comfort).
 run validate_10k_48h 2400 python tools/validate_scale.py \
   --homes 10000 --horizon-hours 48 --days 2 --solver ipm
 

@@ -36,6 +36,10 @@ def main():
                     help="cap the simulated timesteps (0 = days*24*dt); "
                          "lets the 100k-home community run ONE chunk "
                          "without a multi-hour CPU sim")
+    ap.add_argument("--data-dir", default=os.environ.get("DATA_DIR") or None,
+                    help="directory with nsrdb.csv + waterdraw_profiles.csv "
+                         "(e.g. the reference's real assets); default: "
+                         "$DATA_DIR, else synthetic weather/draws")
     args = ap.parse_args()
 
     import jax
@@ -55,9 +59,11 @@ def main():
     cfg["home"]["hems"]["prediction_horizon"] = args.horizon_hours
     cfg["home"]["hems"]["solver"] = args.solver
 
-    env = load_environment(cfg, data_dir=None)
+    env = load_environment(cfg, data_dir=args.data_dir)
     dt = int(cfg["agg"]["subhourly_steps"])
-    wd = load_waterdraw_profiles(None, seed=12)
+    wd_path = (os.path.join(args.data_dir, "waterdraw_profiles.csv")
+               if args.data_dir else None)
+    wd = load_waterdraw_profiles(wd_path, seed=12)
     num_ts = args.days * 24 * dt
     homes = create_homes(cfg, num_ts, dt, wd)
     hems = cfg["home"]["hems"]
