@@ -106,8 +106,16 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
         homes, max(1, int(hems["prediction_horizon"]) * dt), dt,
         int(hems["sub_subhourly_steps"]),
     )
-    _log(f"home batch built ({batch.n_homes} homes); constructing engine "
-         f"(pallas self-test + device commit)...")
+    _log(f"home batch built ({batch.n_homes} homes)")
+    # Run the pallas compile self-test BEFORE the engine constructor so a
+    # hang between here and "engine ready" is attributable: self-test
+    # (first TPU compile in this process) vs device commit of the batch
+    # constants vs jit wrapping.
+    from dragg_tpu.ops import pallas_band
+
+    _log("pallas self-test (first TPU kernel compile)...")
+    _log(f"pallas self-test: {pallas_band.available()}")
+    _log("constructing engine (device commit + jit wrap)...")
     engine = make_engine(batch, env, cfg, 0)
     _log(f"engine ready: band_kernel={engine.band_kernel} "
          f"bw={engine.band_bw}")

@@ -165,6 +165,24 @@ def load_pytree_local(path: str, template, expect_timestep: int | None = None):
         new_leaves = []
         for key, tmpl in zip(keys, leaves):
             arr = data[key]
+            if (isinstance(tmpl, jax.Array) and tmpl.size == 0
+                    and arr.size == 0):
+                # Zero-size leaves (e.g. the zero-width warm-start carry)
+                # are content-free, and their SHARDING is not stable across
+                # save/load: XLA canonicalizes empty outputs to replicated,
+                # so the saved block can be the (n, 0) global while the
+                # fresh template expects an (n/p, 0) local block.  Rebuild
+                # from the template alone.
+                if tmpl.is_fully_addressable:
+                    leaf = jax.device_put(
+                        np.zeros(tmpl.shape, tmpl.dtype), tmpl.sharding)
+                else:
+                    leaf = jax.make_array_from_process_local_data(
+                        tmpl.sharding,
+                        np.zeros(_local_block(tmpl).shape, tmpl.dtype),
+                        tmpl.shape)
+                new_leaves.append(leaf)
+                continue
             if isinstance(tmpl, jax.Array) and not tmpl.is_fully_addressable:
                 want = _local_block(tmpl).shape
                 if tuple(arr.shape) != tuple(want):

@@ -25,6 +25,7 @@ verified element-wise in tests/test_pallas_band.py via interpret mode.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -412,10 +413,26 @@ def make_band_ops(plan, band_kernel: str, mesh=None, mesh_axis: str = "homes"):
             return Sb.at[:, 0, :].add(
                 rel * jnp.max(Sb[:, 0, :], axis=0, keepdims=True))
 
-        def factor_solve_fn(Sb, rp, refine):
-            Lb, x = factor_refined_solve_t(
-                Sb, jnp.swapaxes(rp, 0, 1), bw, refine=refine)
-            return Lb, jnp.swapaxes(x, 0, 1)
+        # Fused factor+solve vs split chol→solve: MEASURED opposite ways
+        # on the two backends (docs/perf_notes.md round 4) — real Mosaic
+        # runs the fused kernel 0.73× (larger VMEM residency hurts
+        # pipelining), interpret/CPU runs it 1.38×.  "auto" follows the
+        # measurement; DRAGG_PALLAS_FUSED=0/1 overrides for on-chip A/Bs
+        # without code edits.
+        fused_env = os.environ.get("DRAGG_PALLAS_FUSED", "auto")
+        use_fused = (_interpret() if fused_env == "auto"
+                     else fused_env not in ("0", "false"))
+
+        if use_fused:
+            def factor_solve_fn(Sb, rp, refine):
+                Lb, x = factor_refined_solve_t(
+                    Sb, jnp.swapaxes(rp, 0, 1), bw, refine=refine)
+                return Lb, jnp.swapaxes(x, 0, 1)
+        else:
+            def factor_solve_fn(Sb, rp, refine):
+                Lb = banded_cholesky_t(Sb, bw)
+                return Lb, jnp.swapaxes(refined_banded_solve_t(
+                    Lb, Sb, jnp.swapaxes(rp, 0, 1), bw, refine=refine), 0, 1)
 
         if mesh is not None:
             from functools import partial

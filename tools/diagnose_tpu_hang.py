@@ -1,0 +1,142 @@
+"""Staged TPU-hang localizer — run FIRST in a live window if the engine
+bench ever hangs again.
+
+Round-4 evidence (docs/onchip_r4/bench_10k_24h.json): the 10k-home bench
+hung for 900 s somewhere between "building engine" and the first step,
+while microbench-scale kernels compiled fine the same minute — and the
+abandoned compile then WEDGED the tunnel for every later backend init.
+This tool bisects that interval: each stage runs in its OWN subprocess
+under its own hard timeout (a hung stage cannot wedge the parent, and
+the tunnel state is re-probed between stages), printing one JSON line
+with per-stage verdicts.
+
+Stages:
+  probe          jax.devices() (backend init)
+  selftest       pallas compile self-test (first Mosaic kernel compile)
+  device_put     commit 10k-home-sized constants to HBM + tiny jnp op
+  jit_big        compile one big fused elementwise jit (engine-glue scale)
+  engine_small   build + 1 step at 256 homes
+  engine_build   build ONLY at --homes (no step)
+  engine_step    build + 1 step at --homes
+
+Usage: python tools/diagnose_tpu_hang.py [--homes 10000] [--horizon 24]
+       [--timeout 240]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+STAGES = {
+    "probe": """
+import jax
+d = jax.devices()[0]
+print("STAGE_OK", d.platform, d.device_kind)
+""",
+    "selftest": """
+from dragg_tpu.ops import pallas_band
+print("STAGE_OK", pallas_band.available())
+""",
+    "device_put": """
+import numpy as np, jax, jax.numpy as jnp
+arrs = [jax.device_put(np.random.default_rng(0).standard_normal(
+    ({homes}, 64)).astype(np.float32)) for _ in range(8)]
+s = jnp.asarray(0.0)
+for a in arrs:
+    s = s + jnp.sum(a)
+print("STAGE_OK", float(s) == float(s))
+""",
+    "jit_big": """
+import numpy as np, jax, jax.numpy as jnp
+n_var = 9 * {horizon} + 5
+x = jax.device_put(np.ones(({homes}, n_var), np.float32))
+@jax.jit
+def f(x):
+    for _ in range(20):
+        x = jnp.tanh(x) * 1.01 + 0.1
+    return x.sum()
+print("STAGE_OK", float(f(x)) != 0.0)
+""",
+    "engine_small": """
+import numpy as np
+import bench
+eng, np_ = bench.build(256, {horizon}, 1000, solver="ipm")
+st = eng.init_state()
+st, out = eng.step(st, 0, np_.zeros(eng.params.horizon, np_.float32))
+import jax; jax.block_until_ready(out.agg_load)
+print("STAGE_OK", float(out.agg_load) == float(out.agg_load))
+""",
+    "engine_build": """
+import bench
+eng, np_ = bench.build({homes}, {horizon}, 1000, solver="ipm")
+print("STAGE_OK", eng.band_kernel)
+""",
+    "engine_step": """
+import numpy as np
+import bench
+eng, np_ = bench.build({homes}, {horizon}, 1000, solver="ipm")
+st = eng.init_state()
+st, out = eng.step(st, 0, np_.zeros(eng.params.horizon, np_.float32))
+import jax; jax.block_until_ready(out.agg_load)
+print("STAGE_OK", float(out.agg_load) == float(out.agg_load))
+""",
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--homes", type=int, default=10_000)
+    ap.add_argument("--horizon", type=int, default=24)
+    ap.add_argument("--timeout", type=float, default=240.0,
+                    help="per-stage hard timeout, seconds")
+    ap.add_argument("--stages", default=",".join(STAGES),
+                    help="comma list to run (default: all, in order)")
+    args = ap.parse_args()
+
+    from dragg_tpu.utils.probe import probe_tpu
+
+    results = {"tool": "diagnose_tpu_hang", "homes": args.homes,
+               "horizon": args.horizon, "stages": {}}
+    for name in args.stages.split(","):
+        name = name.strip()
+        code = STAGES[name].format(homes=args.homes, horizon=args.horizon)
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], cwd=ROOT,
+                capture_output=True, text=True, timeout=args.timeout)
+            dt = round(time.monotonic() - t0, 1)
+            ok = proc.returncode == 0 and "STAGE_OK" in (proc.stdout or "")
+            results["stages"][name] = {
+                "ok": ok, "s": dt,
+                **({} if ok else
+                   {"err": ((proc.stderr or "")[-400:]).replace("\n", " ")}),
+            }
+        except subprocess.TimeoutExpired:
+            results["stages"][name] = {
+                "ok": False, "s": round(time.monotonic() - t0, 1),
+                "err": f"HUNG >{args.timeout:.0f}s"}
+        print(f"[{name}] {results['stages'][name]}", file=sys.stderr,
+              flush=True)
+        if not results["stages"][name]["ok"]:
+            # A hung stage very likely wedged the tunnel — verify and stop
+            # rather than stacking more hung compiles onto it.
+            alive, detail = probe_tpu(60.0)
+            results["post_failure_probe"] = {"alive": alive, "detail": detail}
+            if not alive:
+                results["verdict"] = (
+                    f"stage '{name}' failed AND the tunnel is now wedged — "
+                    "the failure is the wedge trigger; restart the tunnel "
+                    "before retrying")
+                break
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

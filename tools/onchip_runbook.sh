@@ -57,6 +57,12 @@ probe after_micro48 || exit 1
 #    kill eats the fallback JSON — size both explicitly per step.
 run bench_1k_24h 900 env BENCH_TPU_TIMEOUT=300 BENCH_CPU_TIMEOUT=300 \
   python bench.py --homes 1000 --horizon-hours 24 --solver ipm
+if grep -q '"platform": "cpu"' "$OUT/bench_1k_24h.json" 2>/dev/null; then
+  # The 1k TPU attempt fell back — bisect the hang while the window is
+  # (possibly) still open: per-stage subprocess timeouts, probe between.
+  run diagnose 1800 python tools/diagnose_tpu_hang.py \
+    --homes 10000 --horizon 24 --timeout 240
+fi
 probe after_1k || exit 1
 
 # 3. Engine-level band-kernel A/B at 1k (cheap): auto resolves to pallas;

