@@ -63,6 +63,42 @@ def _lane_block_from_env() -> int:
 LANE_BLOCK = _lane_block_from_env()
 
 
+def _bchunk_from_env() -> int:
+    """DRAGG_PALLAS_BCHUNK: split the home axis into slices of this size,
+    one pallas_call per slice (0 = off).  Prepared for the m=149 scoped-
+    VMEM OOM seen on the axon AOT compiler (docs/onchip_r4/): the OOM'd
+    allocation was the FULL (m, B) kernel output, which a smaller
+    LANE_BLOCK cannot shrink — bounding B per call can.  Parity: each
+    home is independent, so chunked == unchunked bitwise (pinned in
+    tests/test_pallas_band.py)."""
+    import logging
+    import os
+
+    raw = os.environ.get("DRAGG_PALLAS_BCHUNK", "")
+    try:
+        v = int(raw) if raw else 0
+    except ValueError:
+        logging.getLogger("dragg_tpu.pallas").warning(
+            "DRAGG_PALLAS_BCHUNK=%r is not an integer; disabling", raw)
+        return 0
+    return max(0, v)
+
+
+B_CHUNK = _bchunk_from_env()
+
+
+def _chunked(fn, n_out: int, ck: int, *arrays):
+    """Apply ``fn(*arrays)`` in ``ck``-sized slices of the trailing (home)
+    axis and concatenate."""
+    B = arrays[0].shape[-1]
+    outs = [fn(*(a[..., i:i + ck] for a in arrays))
+            for i in range(0, B, ck)]
+    if n_out == 1:
+        return jnp.concatenate(outs, axis=-1)
+    return tuple(jnp.concatenate([o[j] for o in outs], axis=-1)
+                 for j in range(n_out))
+
+
 _SELFTEST: bool | None = None
 
 
@@ -185,13 +221,20 @@ def _chol_kernel(s_ref, l_ref, *, m: int, bw: int):
     _chol_body(s_ref, l_ref, m=m, bw=bw)
 
 
-@functools.partial(jax.jit, static_argnames=("bw", "lane_block"))
+@functools.partial(jax.jit, static_argnames=("bw", "lane_block", "b_chunk"))
 def banded_cholesky_t(Sb_t: jnp.ndarray, bw: int,
-                      lane_block: int | None = None) -> jnp.ndarray:
+                      lane_block: int | None = None,
+                      b_chunk: int | None = None) -> jnp.ndarray:
     """Batched band Cholesky in transposed storage: (m, bw+1, B) → L same
-    layout, one kernel per ``lane_block`` (default LANE_BLOCK) homes."""
+    layout, one kernel per ``lane_block`` (default LANE_BLOCK) homes.
+    ``b_chunk`` (default: $DRAGG_PALLAS_BCHUNK) bounds homes per
+    pallas_call — see _bchunk_from_env."""
     from jax.experimental import pallas as pl
 
+    ck = B_CHUNK if b_chunk is None else b_chunk
+    if ck and Sb_t.shape[-1] > ck:
+        return _chunked(lambda s: banded_cholesky_t(s, bw, lane_block),
+                        1, ck, Sb_t)
     lb = lane_block or LANE_BLOCK
     m, bwp1, B = Sb_t.shape
     Bp = -(-B // lb) * lb
@@ -272,11 +315,13 @@ def _refined_solve_kernel(l_ref, s_ref, r_ref, out_ref, y_ref, t_ref, *,
         out_ref[:] = out_ref[:] + t_ref[:]
 
 
-@functools.partial(jax.jit, static_argnames=("bw", "refine", "lane_block"))
+@functools.partial(jax.jit, static_argnames=("bw", "refine", "lane_block",
+                                             "b_chunk"))
 def refined_banded_solve_t(Lb_t: jnp.ndarray, Sb_t: jnp.ndarray,
                            r_t: jnp.ndarray, bw: int,
                            refine: int = 1,
-                           lane_block: int | None = None) -> jnp.ndarray:
+                           lane_block: int | None = None,
+                           b_chunk: int | None = None) -> jnp.ndarray:
     """x ≈ S⁻¹ r via band factor + ``refine`` iterative-refinement passes,
     fused into ONE kernel (the XLA path runs 2(1+refine) scans + a matvec).
 
@@ -284,6 +329,13 @@ def refined_banded_solve_t(Lb_t: jnp.ndarray, Sb_t: jnp.ndarray,
     """
     from jax.experimental import pallas as pl
 
+    ck = B_CHUNK if b_chunk is None else b_chunk
+    if ck and Lb_t.shape[-1] > ck:
+        return _chunked(
+            lambda L, S, r: refined_banded_solve_t(L, S, r, bw,
+                                                   refine=refine,
+                                                   lane_block=lane_block),
+            1, ck, Lb_t, Sb_t, r_t)
     lb = lane_block or LANE_BLOCK
     m, bwp1, B = Lb_t.shape
     Bp = -(-B // lb) * lb
@@ -329,9 +381,11 @@ def _factor_solve_kernel(s_ref, r_ref, l_ref, out_ref, y_ref, t_ref, *,
         out_ref[:] = out_ref[:] + t_ref[:]
 
 
-@functools.partial(jax.jit, static_argnames=("bw", "refine", "lane_block"))
+@functools.partial(jax.jit, static_argnames=("bw", "refine", "lane_block",
+                                             "b_chunk"))
 def factor_refined_solve_t(Sb_t: jnp.ndarray, r_t: jnp.ndarray, bw: int,
                            refine: int = 0, lane_block: int | None = None,
+                           b_chunk: int | None = None,
                            ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(L, x) with x ≈ S⁻¹ r — factor + first solve fused into ONE kernel.
 
@@ -343,6 +397,12 @@ def factor_refined_solve_t(Sb_t: jnp.ndarray, r_t: jnp.ndarray, bw: int,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    ck = B_CHUNK if b_chunk is None else b_chunk
+    if ck and Sb_t.shape[-1] > ck:
+        return _chunked(
+            lambda S, r: factor_refined_solve_t(S, r, bw, refine=refine,
+                                                lane_block=lane_block),
+            2, ck, Sb_t, r_t)
     lb = lane_block or LANE_BLOCK
     m, bwp1, B = Sb_t.shape
     Bp = -(-B // lb) * lb

@@ -242,3 +242,27 @@ def test_ipm_tail_with_pallas_matches_xla():
     fx = (q * np.asarray(sol_x.x)).sum(axis=1)
     fp = (q * np.asarray(sol_p.x)).sum(axis=1)
     np.testing.assert_allclose(fp[both], fx[both], rtol=1e-3, atol=1e-2)
+
+
+def test_bchunk_is_bitwise_identical(band_problem):
+    """b_chunk / DRAGG_PALLAS_BCHUNK (one pallas_call per home-axis slice
+    — the fallback for the m=149 scoped-VMEM OOM, docs/onchip_r4/) must
+    be bitwise identical to the unchunked call: homes are independent and
+    each slice runs the same kernel.  b_chunk is a STATIC jit argument
+    precisely so this path retraces (a module-global toggle would hit the
+    unchunked cached executable and silently test nothing)."""
+    B, m, bw, Sb, r = band_problem
+    St = jnp.transpose(Sb, (1, 2, 0))
+    rt = jnp.swapaxes(r, 0, 1)
+    L0 = pb.banded_cholesky_t(St, bw)
+    x0 = pb.refined_banded_solve_t(L0, St, rt, bw, refine=1)
+    Lf0, xf0 = pb.factor_refined_solve_t(St, rt, bw, refine=0)
+
+    L1 = pb.banded_cholesky_t(St, bw, b_chunk=2)  # B=5 → slices 2, 2, 1
+    x1 = pb.refined_banded_solve_t(L1, St, rt, bw, refine=1, b_chunk=2)
+    Lf1, xf1 = pb.factor_refined_solve_t(St, rt, bw, refine=0, b_chunk=2)
+
+    np.testing.assert_array_equal(np.asarray(L0), np.asarray(L1))
+    np.testing.assert_array_equal(np.asarray(x0), np.asarray(x1))
+    np.testing.assert_array_equal(np.asarray(Lf0), np.asarray(Lf1))
+    np.testing.assert_array_equal(np.asarray(xf0), np.asarray(xf1))

@@ -47,6 +47,12 @@ probe after_micro24 || exit 1
 run band_kernel_48h_lb256 600 env DRAGG_LANE_BLOCK=256 \
   python tools/bench_band_kernel.py --homes 25000 --horizon 48
 probe after_micro48 || exit 1
+#    ...and the B-chunked fallback: if the OOM'd allocation really is the
+#    FULL (m, B) output, lane block can't fix it but bounding B per
+#    pallas_call can (bitwise-identical, tests/test_pallas_band.py).
+run band_kernel_48h_bchunk 600 env DRAGG_PALLAS_BCHUNK=8192 \
+  python tools/bench_band_kernel.py --homes 25000 --horizon 48
+probe after_micro48b || exit 1
 
 # 2. STAGED engine benches: 1k first (localizes the 10k hang), then the
 #    BASELINE row-3 config.  bench.py itself probe-gates its TPU attempts
@@ -57,9 +63,10 @@ probe after_micro48 || exit 1
 #    kill eats the fallback JSON — size both explicitly per step.
 run bench_1k_24h 900 env BENCH_TPU_TIMEOUT=300 BENCH_CPU_TIMEOUT=300 \
   python bench.py --homes 1000 --horizon-hours 24 --solver ipm
-if grep -q '"platform": "cpu"' "$OUT/bench_1k_24h.json" 2>/dev/null; then
-  # The 1k TPU attempt fell back — bisect the hang while the window is
-  # (possibly) still open: per-stage subprocess timeouts, probe between.
+if ! grep -q '"platform": "tpu"' "$OUT/bench_1k_24h.json" 2>/dev/null; then
+  # No TPU-platform result — fell back to CPU, OR the bench hung and the
+  # outer timeout killed it before any JSON (empty file): either way,
+  # bisect the hang while the window is (possibly) still open.
   run diagnose 1800 python tools/diagnose_tpu_hang.py \
     --homes 10000 --horizon 24 --timeout 240
 fi

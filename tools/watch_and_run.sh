@@ -21,13 +21,26 @@ while true; do
     # compiles are themselves the documented wedge trigger (CLAUDE.md).
     if [ "$prev" = down ]; then
       n=$((n + 1))
-      dir="$OUT"
-      [ $n -gt 1 ] && dir="${OUT}_w$n"
+      # Always a FRESH suffix dir: the base OUT holds committed artifacts
+      # from earlier passes/rounds, and the runbook's > redirections would
+      # silently truncate them (advisor finding, r4).
+      dir="${OUT}_w$n"
       echo "[$(date +%H:%M:%S)] tunnel LIVE — running runbook into $dir"
       bash tools/onchip_runbook.sh "$dir"
-      echo "[$(date +%H:%M:%S)] runbook pass $n finished rc=$?"
+      rc=$?
+      echo "[$(date +%H:%M:%S)] runbook pass $n finished rc=$rc"
+      if [ $rc -eq 0 ]; then
+        prev=live
+      else
+        # A failed runbook (e.g. its own start probe lost a transient
+        # flap) must NOT latch prev=live — that would suppress the edge
+        # for the rest of a real window.  Treat as still-down and retry
+        # on the next probe.
+        prev=down
+      fi
+    else
+      prev=live
     fi
-    prev=live
   else
     prev=down
   fi
