@@ -233,7 +233,11 @@ def banded_cholesky_t(Sb_t: jnp.ndarray, bw: int,
 
     ck = B_CHUNK if b_chunk is None else b_chunk
     if ck and Sb_t.shape[-1] > ck:
-        return _chunked(lambda s: banded_cholesky_t(s, bw, lane_block),
+        # b_chunk=0 in the recursion: the outer level did the chunking —
+        # letting the env default re-apply would silently re-chunk every
+        # slice to B_CHUNK and corrupt explicit chunk-size sweeps.
+        return _chunked(lambda s: banded_cholesky_t(s, bw, lane_block,
+                                                    b_chunk=0),
                         1, ck, Sb_t)
     lb = lane_block or LANE_BLOCK
     m, bwp1, B = Sb_t.shape
@@ -334,7 +338,8 @@ def refined_banded_solve_t(Lb_t: jnp.ndarray, Sb_t: jnp.ndarray,
         return _chunked(
             lambda L, S, r: refined_banded_solve_t(L, S, r, bw,
                                                    refine=refine,
-                                                   lane_block=lane_block),
+                                                   lane_block=lane_block,
+                                                   b_chunk=0),
             1, ck, Lb_t, Sb_t, r_t)
     lb = lane_block or LANE_BLOCK
     m, bwp1, B = Lb_t.shape
@@ -401,7 +406,8 @@ def factor_refined_solve_t(Sb_t: jnp.ndarray, r_t: jnp.ndarray, bw: int,
     if ck and Sb_t.shape[-1] > ck:
         return _chunked(
             lambda S, r: factor_refined_solve_t(S, r, bw, refine=refine,
-                                                lane_block=lane_block),
+                                                lane_block=lane_block,
+                                                b_chunk=0),
             2, ck, Sb_t, r_t)
     lb = lane_block or LANE_BLOCK
     m, bwp1, B = Sb_t.shape

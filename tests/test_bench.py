@@ -35,6 +35,36 @@ def test_bench_smoke_contract():
     assert result["pallas_selftest"] is None
 
 
+def test_bench_probe_gated_ladder(tmp_path):
+    """The DRIVER path (no --smoke): every TPU attempt is gated on a
+    hard-timeout tunnel probe, the fallback is a FULL-size CPU run
+    labelled ``fallback: true`` with the attempt ladder recorded, and the
+    probe verdict lands in $DRAGG_PROBE_LOG (round-4 hardening — a wedged
+    tunnel burned 22 min of the round-3 driver run)."""
+    probe_log = str(tmp_path / "probe_log.txt")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DRAGG_PROBE_LOG=probe_log)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel here
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--homes", "40",
+         "--horizon-hours", "2", "--steps", "2", "--chunks", "1"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected ONE json line, got: {proc.stdout!r}"
+    result = json.loads(lines[0])
+    # Probe failed (CPU-only env) → no TPU attempt, full-size CPU fallback.
+    assert result["fallback"] is True
+    assert result["n_homes"] == 40  # FULL requested size, not a reduced one
+    assert result["value"] > 0
+    attempts = result["attempts"]
+    assert all(a.get("platform") != "tpu" for a in attempts), attempts
+    # The probe verdict is a committed-able artifact, not just a log line.
+    with open(probe_log) as f:
+        content = f.read()
+    assert "DOWN" in content
+
+
 def test_validate_scale_smoke():
     """The scale-validation tool runs end-to-end at a tiny config and emits
     its one-line JSON verdict with ok=true."""

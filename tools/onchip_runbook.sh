@@ -67,8 +67,14 @@ if ! grep -q '"platform": "tpu"' "$OUT/bench_1k_24h.json" 2>/dev/null; then
   # No TPU-platform result — fell back to CPU, OR the bench hung and the
   # outer timeout killed it before any JSON (empty file): either way,
   # bisect the hang while the window is (possibly) still open.
-  run diagnose 1800 python tools/diagnose_tpu_hang.py \
-    --homes 10000 --horizon 24 --timeout 240
+  # 420 s/stage: if the "hang" is actually a legitimately-slow remote AOT
+  # compile of the big engine program, a 240 s stage budget would
+  # misdiagnose it as hung — give the engine stages headroom.  Outer
+  # budget sized for the worst case (7 stages x 420 + probe): the
+  # per-stage verdicts are the whole point, so the outer kill must never
+  # eat the final JSON.
+  run diagnose 3600 python tools/diagnose_tpu_hang.py \
+    --homes 10000 --horizon 24 --timeout 420
 fi
 probe after_1k || exit 1
 
