@@ -837,7 +837,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         ipm_tail_iters=int(tpu_cfg.get("ipm_tail_iters", 0)),
         ipm_warm=bool(tpu_cfg.get("ipm_warm_start", False)),
         ipm_eps=float(tpu_cfg.get("ipm_eps", 2e-4)),
-        ipm_freeze_zmax=float(tpu_cfg.get("ipm_freeze_zmax", 1e3)),
+        ipm_freeze_zmax=float(tpu_cfg.get("ipm_freeze_zmax", 300.0)),
         integer_first_action=bool(tpu_cfg.get("integer_first_action", False)),
         band_kernel=str(tpu_cfg.get("band_kernel", "auto")),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
