@@ -65,3 +65,22 @@ def test_engine_invariants_across_config_corners(h, dt, s, n, pv, bat, pvb, seed
     assert (tw > 0).all() and (tw < 90).all()
     # At least the bulk of home-steps solve at every corner.
     assert solved.mean() > 0.5, f"solve rate {solved.mean():.2f} at {h,dt,s}"
+
+
+def test_shipped_example_config_matches_defaults():
+    """data/config.example.toml (the reference ships an editable
+    config.toml — dragg/data/config.toml — so we ship a starting-point
+    example) must parse to EXACTLY default_config(): the example a user
+    copies can never drift from the shipped defaults.  Named .example so
+    the live default-config resolution ($DATA_DIR/config.toml, default
+    data/) never silently picks it up — a user's edited copy must not be
+    able to fail the suite or change repo-root run behavior (advisor
+    finding, r4)."""
+    import os
+
+    from dragg_tpu.config import load_config
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "data", "config.example.toml")
+    loaded = load_config(path)
+    assert loaded == default_config()
