@@ -10,7 +10,10 @@ win.
 
 Enabled by default (``tpu.compile_cache = true``); the directory resolves
 from ``tpu.compile_cache_dir`` → ``$DRAGG_COMPILE_CACHE_DIR`` →
-``$JAX_COMPILATION_CACHE_DIR`` → ``~/.cache/dragg_tpu/xla``.
+``$JAX_COMPILATION_CACHE_DIR`` → ``~/.cache/dragg_tpu/xla``, ALWAYS with
+a per-host CPU fingerprint subdir appended (a cache written on a
+differently-featured host must not be loaded — observed XLA:CPU AOT
+SIGILL hazard; see :func:`_host_fingerprint`).
 """
 
 from __future__ import annotations
@@ -20,6 +23,36 @@ import os
 
 _log = logging.getLogger("dragg_tpu.compile_cache")
 _ENABLED_DIR: str | None = None
+
+
+def _host_fingerprint() -> str:
+    """Short best-effort id of this host's CPU (see cache-dir segregation
+    below).  Hashes the cpuinfo feature line (x86 ``flags`` / ARM
+    ``Features``) AND the model-name line — the observed AOT mismatch was
+    on ``+prefer-no-gather``, an LLVM tuning feature derived from the CPU
+    MODEL that never appears in the flags line, so the model must be part
+    of the key.  Falls back to the machine arch when cpuinfo is
+    unreadable; best-effort, not a guarantee (two hosts with identical
+    model + features strings share a subdir — which is also when sharing
+    is safe)."""
+    import hashlib
+    import platform
+
+    parts = []
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                key = line.split(":", 1)[0].strip().lower()
+                if key in ("flags", "features", "model name", "cpu part",
+                           "cpu implementer"):
+                    parts.append(line.strip())
+                    if len(parts) >= 3:
+                        break
+    except OSError:
+        pass
+    if parts:
+        return hashlib.sha256("|".join(sorted(parts)).encode()).hexdigest()[:12]
+    return platform.machine() or "unknown"
 
 
 def enable_compile_cache(config: dict | None = None) -> str | None:
@@ -44,6 +77,25 @@ def enable_compile_cache(config: dict | None = None) -> str | None:
         or os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
         or os.path.join(os.path.expanduser("~"), ".cache", "dragg_tpu", "xla")
     )
+    # Segregate by host CPU fingerprint: the cache directory lives in the
+    # home volume and SURVIVES across differently-featured hosts (observed:
+    # XLA:CPU loading an AOT result compiled with +prefer-no-gather on a
+    # host without it, warning "could lead to execution errors such as
+    # SIGILL").  A per-fingerprint subdir prevents cross-machine loads
+    # (best-effort — see _host_fingerprint) while keeping the warm-cache
+    # win on a stable host.
+    base_dir = cache_dir
+    cache_dir = os.path.join(cache_dir, _host_fingerprint())
+    # Pre-fingerprint entries at the base level are dead weight no code
+    # path reads anymore (JAX's 2 GiB LRU only manages the subdir) —
+    # sweep plain files, leave subdirectories (other hosts' caches).
+    try:
+        for entry in os.listdir(base_dir):
+            p = os.path.join(base_dir, entry)
+            if os.path.isfile(p):
+                os.remove(p)
+    except OSError:
+        pass
     if _ENABLED_DIR is not None:
         if cache_dir != _ENABLED_DIR:
             _log.warning(
