@@ -217,10 +217,15 @@ def test_reference_solver_names_map(tiny_config):
 
 
 def test_integer_first_action_repair(tmp_path):
-    """MILP repair (tpu.integer_first_action): on solved steps the APPLIED
-    duty fractions must be integer counts / s (the reference's implementable
-    discretization, dragg/mpc_calc.py:171-173,497-499), solve rate must not
-    collapse vs the relaxation, and comfort bands must still hold."""
+    """MILP repair (tpu.integer_first_action, IPM path): on solved steps
+    the APPLIED duty fractions must be integer counts / s (the
+    reference's implementable discretization,
+    dragg/mpc_calc.py:171-173,497-499), solve rate must not collapse vs
+    the relaxation, and comfort bands must still hold.  IPM-only by
+    measurement: wiring the same repair into the ADMM path degraded the
+    DOWNSTREAM solve rate 0.76 → 0.44 at this config (the repaired
+    trajectories jam ADMM's receding-horizon warm starts) — perf notes
+    round 4."""
     cfg = default_config()
     cfg["community"]["total_number_homes"] = 8
     cfg["community"]["homes_pv"] = 1
