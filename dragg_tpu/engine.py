@@ -486,31 +486,54 @@ class Engine:
                     freeze_zmax=p.ipm_freeze_zmax,
                 )
 
-            sol = run_ipm(qp.l_box, qp.u_box)
+            relaxed = run_ipm(qp.l_box, qp.u_box)
+            sol = relaxed
             if p.integer_first_action:
-                sol = self._integerize_first_action(qp, sol, run_ipm)
-            return sol, factor
-        return admm_solve_qp_cached(
-            self.static.pattern, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
-            factor, refresh,
-            rho=p.admm_rho, sigma=p.admm_sigma, alpha=p.admm_alpha,
-            eps_abs=p.admm_eps, eps_rel=p.admm_eps,
-            reg=p.admm_reg,
-            iters=p.admm_iters,
-            patience=p.admm_patience,
-            rho_update_every=p.admm_rho_update_every,
-            matvec_dtype=p.admm_matvec_dtype,
-            refine=p.admm_refine,
-            anderson=p.admm_anderson,
-            banded_factor=p.admm_banded_factor,
-            solve_backend=self._solve_backend,
-            band_kernel=self._admm_band_kernel,
-            mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
-            x0=state.warm_x, y_box0=state.warm_y_box,
-            rho0=state.warm_rho,
-        )
+                sol = self._integerize_first_action(qp, relaxed, run_ipm)
+            # Warm starts always shift the RELAXED solution: the repaired
+            # iterate sits on pinned boxes that move every step, and
+            # seeding the next solve from it measurably jams warm-start-
+            # dependent solvers (ADMM: downstream solve rate 0.755→0.44
+            # before this split — docs/perf_notes.md round 4).
+            return sol, factor, relaxed
 
-    def _integerize_first_action(self, qp, sol, run_ipm):
+        def run_admm(l_box, u_box, fac, ref, x0, y0, rho0):
+            return admm_solve_qp_cached(
+                self.static.pattern, qp.vals, qp.b_eq, l_box, u_box, qp.q,
+                fac, ref,
+                rho=p.admm_rho, sigma=p.admm_sigma, alpha=p.admm_alpha,
+                eps_abs=p.admm_eps, eps_rel=p.admm_eps,
+                reg=p.admm_reg,
+                iters=p.admm_iters,
+                patience=p.admm_patience,
+                rho_update_every=p.admm_rho_update_every,
+                matvec_dtype=p.admm_matvec_dtype,
+                refine=p.admm_refine,
+                anderson=p.admm_anderson,
+                banded_factor=p.admm_banded_factor,
+                solve_backend=self._solve_backend,
+                band_kernel=self._admm_band_kernel,
+                mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
+                x0=x0, y_box0=y0, rho0=rho0,
+            )
+
+        relaxed, fcarry = run_admm(qp.l_box, qp.u_box, factor, refresh,
+                                   state.warm_x, state.warm_y_box,
+                                   state.warm_rho)
+        sol = relaxed
+        if p.integer_first_action:
+            # Pinned re-solve warm-starts from the relaxed solution and
+            # reuses the just-built factor; the NEXT step's warm start
+            # comes from `relaxed` (third return), which is what makes
+            # the repair safe on this warm-start-dependent family.
+            sol = self._integerize_first_action(
+                qp, relaxed,
+                lambda l2, u2: run_admm(l2, u2, fcarry, False,
+                                        relaxed.x, relaxed.y_box,
+                                        relaxed.rho)[0])
+        return sol, fcarry, relaxed
+
+    def _integerize_first_action(self, qp, sol, run_solver):
         """Opt-in MILP repair (``tpu.integer_first_action``): pin the three
         k=0 duty counts to their rounded values and re-solve, so the
         APPLIED action matches the reference's integer duty-cycle
@@ -531,7 +554,12 @@ class Engine:
         is closed-form and costs no extra solve.  Homes whose pinned
         re-solve nevertheless fails KEEP the relaxed solution (graceful
         degradation — no new fallback routes).  Cost: one extra batched
-        IPM solve per step.
+        solve per step (``run_solver`` is either family's pinned-box
+        re-solve; the ADMM one warm-starts from the relaxed solution and
+        reuses the factor).  The NEXT step's warm start must come from
+        the RELAXED solution, not the merged one — see _solve/_finish
+        (measured: repaired warm shifts collapse ADMM's downstream solve
+        rate 0.755 → 0.44, perf notes round 4).
         """
         lay = self.layout
         st, b = self.static, self.batch
@@ -586,7 +614,7 @@ class Engine:
         pinned = jnp.stack([pin_c, pin_h, pin_w], axis=1)
         l2 = qp.l_box.at[:, cols].set(pinned)
         u2 = qp.u_box.at[:, cols].set(pinned)
-        sol2 = run_ipm(l2, u2)
+        sol2 = run_solver(l2, u2)
         # Adopt the repaired iterate only where BOTH solves succeeded;
         # solvedness itself stays the relaxation's verdict.
         keep = sol2.solved & sol.solved
@@ -608,7 +636,8 @@ class Engine:
             rho=pick(sol2.rho, sol.rho),
         )
 
-    def _finish(self, state: CommunityState, t, sol, aux: StepAux):
+    def _finish(self, state: CommunityState, t, sol, aux: StepAux,
+                warm_sol):
         """Merge/collect phase: recover physical series, route unsolved homes
         through the fallback controller, emit observables, advance state."""
         p = self.params
@@ -623,6 +652,11 @@ class Engine:
 
         mpc = recover_solution(sol.x, lay, b, aux.ghi_w, price_total, s)
         solved = sol.solved
+        # Warm-start source: the RELAXED solution (never the repaired one
+        # — see _solve; the parameter is required so an omitted argument
+        # fails loudly instead of silently regressing the measured ADMM
+        # collapse).
+        wsol = warm_sol
 
         # --- Fallback for unsolved homes (dragg/mpc_calc.py:527-596).
         counter_inc = jnp.where(solved, 0, state.counter + 1)
@@ -681,11 +715,11 @@ class Engine:
             plan_cool=jnp.where(sel2, mpc.cool, state.plan_cool),
             plan_heat=jnp.where(sel2, mpc.heat, state.plan_heat),
             plan_wh=jnp.where(sel2, mpc.wh, state.plan_wh),
-            warm_x=(shift_warm_start(sol.x, lay) if self._carry_warm
+            warm_x=(shift_warm_start(wsol.x, lay) if self._carry_warm
                     else state.warm_x),
-            warm_y_box=(shift_warm_start(sol.y_box, lay) if self._carry_warm
+            warm_y_box=(shift_warm_start(wsol.y_box, lay) if self._carry_warm
                         else state.warm_y_box),
-            warm_rho=sol.rho,
+            warm_rho=wsol.rho,
             key=state.key,
         )
         out = StepOutputs(
@@ -718,8 +752,8 @@ class Engine:
         threaded separately from CommunityState so it never reaches
         checkpoints (see :meth:`init_factor`)."""
         qp, aux = self._prepare(state, t, rp)
-        sol, fcarry = self._solve(state, qp, factor, refresh)
-        new_state, out = self._finish(state, t, sol, aux)
+        sol, fcarry, warm_sol = self._solve(state, qp, factor, refresh)
+        new_state, out = self._finish(state, t, sol, aux, warm_sol)
         return new_state, fcarry, out
 
     def _chunk(self, state: CommunityState, t0, rps):

@@ -216,16 +216,17 @@ def test_reference_solver_names_map(tiny_config):
         engine_params(cfg, 0)
 
 
-def test_integer_first_action_repair(tmp_path):
-    """MILP repair (tpu.integer_first_action, IPM path): on solved steps
-    the APPLIED duty fractions must be integer counts / s (the
-    reference's implementable discretization,
+@pytest.mark.parametrize("solver", ["ipm", "admm"])
+def test_integer_first_action_repair(tmp_path, solver):
+    """MILP repair (tpu.integer_first_action, both solver families): on
+    solved steps the APPLIED duty fractions must be integer counts / s
+    (the reference's implementable discretization,
     dragg/mpc_calc.py:171-173,497-499), solve rate must not collapse vs
-    the relaxation, and comfort bands must still hold.  IPM-only by
-    measurement: wiring the same repair into the ADMM path degraded the
-    DOWNSTREAM solve rate 0.76 → 0.44 at this config (the repaired
-    trajectories jam ADMM's receding-horizon warm starts) — perf notes
-    round 4."""
+    the relaxation, and comfort bands must still hold.  The ADMM variant
+    is the regression guard for the warm-start split: shifting warm
+    starts from the REPAIRED solution measured a downstream solve-rate
+    collapse 0.76 → 0.44 at this config (perf notes round 4); warm
+    starts now always shift the relaxed solution."""
     cfg = default_config()
     cfg["community"]["total_number_homes"] = 8
     cfg["community"]["homes_pv"] = 1
@@ -233,6 +234,7 @@ def test_integer_first_action_repair(tmp_path):
     cfg["community"]["homes_pv_battery"] = 1
     cfg["simulation"]["end_datetime"] = "2015-01-02 00"
     cfg["home"]["hems"]["prediction_horizon"] = 6
+    cfg["home"]["hems"]["solver"] = solver
     s = int(cfg["home"]["hems"]["sub_subhourly_steps"])
 
     def run(flag, sub):
