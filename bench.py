@@ -66,11 +66,15 @@ def _log(msg: str) -> None:
 
 
 def build(n_homes: int, horizon_hours: int, admm_iters: int,
-          solver: str = "admm", band_kernel: str | None = None):
+          solver: str = "admm", band_kernel: str | None = None,
+          data_dir: str | None = None):
     """Build THE benchmark community engine (population mix, sim window,
     solver config).  This is the one definition of the measured community —
     tools/bench_engine_kernels.py reuses it so kernel A/B verdicts are
-    measured on the same population as the headline bench."""
+    measured on the same population as the headline bench.  ``data_dir``
+    points at real nsrdb.csv/waterdraw_profiles.csv assets (default:
+    synthetic — real January weather measures ~1.1 % more fallback steps
+    and ~26 % more wall, docs/perf_notes.md round 4)."""
     import numpy as np
 
     from dragg_tpu.config import default_config
@@ -97,9 +101,11 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
     # hanging somewhere between "building engine" and the first step with
     # no further output for 900 s — these narrow the next such hang to a
     # stage (host synthesis / pallas self-test+device commit / jit wrap).
-    env = load_environment(cfg, data_dir=None)
+    from dragg_tpu.data import waterdraw_path
+
+    env = load_environment(cfg, data_dir=data_dir)
     dt = int(cfg["agg"]["subhourly_steps"])
-    waterdraw = load_waterdraw_profiles(None, seed=12)
+    waterdraw = load_waterdraw_profiles(waterdraw_path(cfg, data_dir), seed=12)
     homes = create_homes(cfg, 24 * 7 * dt, dt, waterdraw)
     hems = cfg["home"]["hems"]
     batch = build_home_batch(
@@ -142,7 +148,8 @@ def run_measured(args) -> dict:
 
     _log(f"building engine: {args.homes} homes, {args.horizon_hours}h horizon")
     engine, np = build(args.homes, args.horizon_hours, args.admm_iters,
-                       solver="admm" if args.solver == "auto" else args.solver)
+                       solver="admm" if args.solver == "auto" else args.solver,
+                       data_dir=args.data_dir)
     solver_used = engine.params.solver
     if args.solver == "auto":
         # Race the two solver families over SEVERAL sequential steps and
@@ -154,7 +161,8 @@ def run_measured(args) -> dict:
         # the timed chunks, 4x slower than the IPM it beat in the race.
         try:
             engine_ipm, _ = build(args.homes, args.horizon_hours,
-                                  args.admm_iters, solver="ipm")
+                                  args.admm_iters, solver="ipm",
+                                  data_dir=args.data_dir)
 
             def steps_time(eng, k=6, budget_s=60.0):
                 """Mean warm-step time over up to k steps, stopping early
@@ -377,6 +385,8 @@ def run_child(platform: str, homes: int, steps: int, chunks: int,
         "--solver", args.solver,
         "--out", out_path,
     ]
+    if args.data_dir:
+        cmd += ["--data-dir", args.data_dir]
     diag = {"platform": platform, "homes": homes, "timeout_s": timeout}
     t0 = time.perf_counter()
     try:
@@ -426,6 +436,9 @@ def main() -> None:
                          "saves half a constrained TPU window; auto: race "
                          "both over several warm steps and keep the winner")
     ap.add_argument("--platform", choices=["auto", "tpu", "cpu"], default="auto")
+    ap.add_argument("--data-dir", default=None,
+                    help="directory with nsrdb.csv + waterdraw_profiles.csv "
+                         "(real assets; default: synthetic)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny inline CPU run (50 homes, 4h horizon) for verification")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)

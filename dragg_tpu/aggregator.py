@@ -149,10 +149,9 @@ class Aggregator:
         self.write_home_configs()
 
     def _waterdraw_path(self) -> str | None:
-        if self.data_dir is None:
-            return None
-        fname = self.config["home"]["wh"].get("waterdraw_file", "waterdraw_profiles.csv")
-        return os.path.join(self.data_dir, fname)
+        from dragg_tpu.data import waterdraw_path
+
+        return waterdraw_path(self.config, self.data_dir)
 
     def write_home_configs(self) -> None:
         """Persist the population (dragg/aggregator.py:846-854)."""

@@ -325,6 +325,19 @@ def synth_waterdraw_profiles(
     return pd.DataFrame(cols, index=idx)
 
 
+def waterdraw_path(config: dict, data_dir: str | None) -> str | None:
+    """Resolve the water-draw csv path from a data dir + the documented
+    ``home.wh.waterdraw_file`` config key (reference semantics,
+    dragg/data/config.toml) — THE one resolution, shared by the
+    Aggregator, bench.py, and tools/validate_scale.py so a custom
+    filename cannot be silently ignored by one of them (advisor
+    finding, round 4).  None (→ synthetic draws) when no data dir."""
+    if data_dir is None:
+        return None
+    fname = config["home"]["wh"].get("waterdraw_file", "waterdraw_profiles.csv")
+    return os.path.join(data_dir, fname)
+
+
 def load_waterdraw_profiles(path: str | None, seed: int = 0) -> pd.DataFrame:
     """Load the minutely water-draw profile csv, or synthesize one."""
     if path is not None and os.path.exists(path):

@@ -59,11 +59,11 @@ def main():
     cfg["home"]["hems"]["prediction_horizon"] = args.horizon_hours
     cfg["home"]["hems"]["solver"] = args.solver
 
+    from dragg_tpu.data import waterdraw_path
+
     env = load_environment(cfg, data_dir=args.data_dir)
     dt = int(cfg["agg"]["subhourly_steps"])
-    wd_path = (os.path.join(args.data_dir, "waterdraw_profiles.csv")
-               if args.data_dir else None)
-    wd = load_waterdraw_profiles(wd_path, seed=12)
+    wd = load_waterdraw_profiles(waterdraw_path(cfg, args.data_dir), seed=12)
     num_ts = args.days * 24 * dt
     homes = create_homes(cfg, num_ts, dt, wd)
     hems = cfg["home"]["hems"]
