@@ -51,6 +51,39 @@ def probe_backend(timeout_s: float = 60.0) -> dict:
                          "accelerator tunnel? try JAX_PLATFORMS=cpu)"}
 
 
+def _wedge_signature() -> str:
+    """One-word-per-endpoint HTTP corroboration for a HUNG probe (the
+    round-4 wedge signature: proxy answers 403 in ms while the remote-
+    compile helper port stops listening — CLAUDE.md; round 3 separately
+    saw the proxy ACCEPT and then hang, which gets its own "hang" label).
+    Diagnostic color only; the jax probe stays authoritative."""
+    import socket
+    import urllib.error
+    import urllib.request
+
+    # Direct connection: urlopen honors $http_proxy by default, which in
+    # a tunneled environment would peek at the WRONG endpoint.
+    opener = urllib.request.build_opener(
+        urllib.request.ProxyHandler({}))
+
+    def peek(port: int) -> str:
+        try:
+            opener.open(f"http://127.0.0.1:{port}/", timeout=1.5)
+            return "http-ok"
+        except urllib.error.HTTPError as e:
+            return f"http-{e.code}"
+        except (TimeoutError, socket.timeout):
+            return "hang"  # accepted the connection, never answered
+        except urllib.error.URLError as e:
+            if isinstance(e.reason, (TimeoutError, socket.timeout)):
+                return "hang"
+            return "no-listen"
+        except Exception:
+            return "no-listen"
+
+    return f"[proxy:{peek(48271)} compile:{peek(8093)}]"
+
+
 def probe_tpu(timeout_s: float = 60.0) -> tuple[bool, str]:
     """(tpu_alive, one-line detail) — alive only when the default backend
     actually resolves to a TPU within the timeout."""
@@ -59,7 +92,11 @@ def probe_tpu(timeout_s: float = 60.0) -> tuple[bool, str]:
         alive = r.get("backend") == "tpu"
         return alive, (f"{r.get('backend')} {r.get('kind', '')} "
                        f"({r['elapsed_s']}s)").strip()
-    return False, f"{r['error'][:160]} ({r['elapsed_s']}s)".replace("\n", " ")
+    # The HTTP corroboration only means something for a HUNG backend init
+    # (the wedge); a fast failure (ImportError, CPU-only env) gets none.
+    sig = f" {_wedge_signature()}" if r.get("timeout") else ""
+    return False, (f"{r['error'][:160]} ({r['elapsed_s']}s){sig}"
+                   ).replace("\n", " ").strip()
 
 
 def append_probe_log(path: str, alive: bool, detail: str) -> str:
