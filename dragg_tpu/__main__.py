@@ -114,6 +114,12 @@ def run_sweep(args) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # CLI processes filter XLA:CPU's spurious warm-cache AOT mismatch
+    # ERROR lines (tuning prefs only; real ISA mismatches pass through —
+    # see utils/stderr_filter.py).  Never installed under pytest.
+    from dragg_tpu.utils.stderr_filter import install_aot_mismatch_filter
+
+    install_aot_mismatch_filter()
     if args.cmd == "run":
         # Multi-host pod slices: every worker runs this same command and the
         # coordinator handshake merges them into ONE JAX program whose

@@ -172,7 +172,19 @@ def load_pytree_local(path: str, template, expect_timestep: int | None = None):
                 # save/load: XLA canonicalizes empty outputs to replicated,
                 # so the saved block can be the (n, 0) global while the
                 # fresh template expects an (n/p, 0) local block.  Rebuild
-                # from the template alone.
+                # from the template alone — but still require the saved
+                # shape to be the template's global or local-block shape:
+                # accepting ANY zero-size array would mask torn/mismatched-
+                # layout checkpoints that every other leaf path rejects
+                # loudly (ADVICE round 4).
+                ok_shapes = {tuple(tmpl.shape)}
+                if not tmpl.is_fully_addressable:
+                    ok_shapes.add(tuple(_local_block(tmpl).shape))
+                if tuple(arr.shape) not in ok_shapes:
+                    raise ValueError(
+                        f"Checkpoint zero-size leaf {key} shape {arr.shape} "
+                        f"matches neither the template's global nor "
+                        f"local-block shape ({sorted(ok_shapes)})")
                 if tmpl.is_fully_addressable:
                     leaf = jax.device_put(
                         np.zeros(tmpl.shape, tmpl.dtype), tmpl.sharding)

@@ -14,6 +14,12 @@ from ``tpu.compile_cache_dir`` → ``$DRAGG_COMPILE_CACHE_DIR`` →
 a per-host CPU fingerprint subdir appended (a cache written on a
 differently-featured host must not be loaded — observed XLA:CPU AOT
 SIGILL hazard; see :func:`_host_fingerprint`).
+
+Note the fingerprint does NOT silence the ``cpu_aot_loader`` mismatch
+ERRORs on warm caches: those are structural same-host noise (XLA embeds
+LLVM tuning prefs the host-feature check never contains — root-caused
+round 5, docs/perf_notes.md) and are handled by the precision filter in
+:mod:`dragg_tpu.utils.stderr_filter`.
 """
 
 from __future__ import annotations
@@ -77,6 +83,15 @@ def enable_compile_cache(config: dict | None = None) -> str | None:
         or os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
         or os.path.join(os.path.expanduser("~"), ".cache", "dragg_tpu", "xla")
     )
+    # Dragg owns the dir only when it came from a dragg-specific source;
+    # $JAX_COMPILATION_CACHE_DIR is a standard JAX env var plausibly shared
+    # with other JAX programs on this host, and sweeping there would delete
+    # cache entries dragg did not create (ADVICE round 4).
+    dragg_owned = bool(
+        str(tpu_cfg.get("compile_cache_dir") or "")
+        or os.environ.get("DRAGG_COMPILE_CACHE_DIR", "")
+        or not os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
+    )
     # Segregate by host CPU fingerprint: the cache directory lives in the
     # home volume and SURVIVES across differently-featured hosts (observed:
     # XLA:CPU loading an AOT result compiled with +prefer-no-gather on a
@@ -86,16 +101,6 @@ def enable_compile_cache(config: dict | None = None) -> str | None:
     # win on a stable host.
     base_dir = cache_dir
     cache_dir = os.path.join(cache_dir, _host_fingerprint())
-    # Pre-fingerprint entries at the base level are dead weight no code
-    # path reads anymore (JAX's 2 GiB LRU only manages the subdir) —
-    # sweep plain files, leave subdirectories (other hosts' caches).
-    try:
-        for entry in os.listdir(base_dir):
-            p = os.path.join(base_dir, entry)
-            if os.path.isfile(p):
-                os.remove(p)
-    except OSError:
-        pass
     if _ENABLED_DIR is not None:
         if cache_dir != _ENABLED_DIR:
             _log.warning(
@@ -104,6 +109,20 @@ def enable_compile_cache(config: dict | None = None) -> str | None:
                 "process-global — first enable wins)",
                 _ENABLED_DIR, cache_dir)
         return _ENABLED_DIR
+    # Pre-fingerprint entries at the base level are dead weight no code
+    # path reads anymore (JAX's 2 GiB LRU only manages the subdir) —
+    # sweep plain files, leave subdirectories (other hosts' caches).
+    # Only in dragg-owned dirs, and only once per process (we are past the
+    # _ENABLED_DIR short-circuit here), never in a shared
+    # $JAX_COMPILATION_CACHE_DIR (ADVICE round 4).
+    if dragg_owned:
+        try:
+            for entry in os.listdir(base_dir):
+                p = os.path.join(base_dir, entry)
+                if os.path.isfile(p):
+                    os.remove(p)
+        except OSError:
+            pass
     try:
         import jax
 
