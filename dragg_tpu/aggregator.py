@@ -287,6 +287,17 @@ class Aggregator:
                 f"mean ADMM iters={host['admm_iters'].mean():.0f}, "
                 f"agg_load range=[{agg_loads.min():.1f}, {agg_loads.max():.1f}] kW"
             )
+        # Integer-repair coverage: homes whose pinned re-solve failed keep
+        # the relaxed fractional action (engine._integerize_first_action).
+        # Measured 99.9 % coverage on CPU (docs/perf_notes.md round 4);
+        # surface any regression so on-chip configs can detect it (ADVICE
+        # round 4).
+        n_repair_failed = float(np.sum(host["repair_failed"]))
+        if n_repair_failed > 0:
+            self.log.logger.progress(
+                f"chunk t={self.timestep}..{self.timestep + n_steps}: "
+                f"{int(n_repair_failed)} pinned re-solves failed "
+                f"(homes kept the relaxed fractional action)")
         self._log_home_failures(host["correct_solve"])
         # Per-step setpoint tracking.  Ordering parity: the reference
         # increments the timestep in run_iteration BEFORE collect_data calls

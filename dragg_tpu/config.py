@@ -214,14 +214,30 @@ _DEFAULT: dict[str, Any] = {
                                 # finish them alone (1.5-1.6x solver time,
                                 # equal-or-better solve rates); 0 disables
         "ipm_tail_iters": 0,  # tail-phase iteration cap (0 = ipm_iters)
-        "integer_first_action": False,  # MILP repair: pin the three k=0
-                                        # duty counts to rounded values and
-                                        # re-solve, so the APPLIED action is
-                                        # integer like the reference's
-                                        # (measured: relaxation sits 2.7-3.6%
-                                        # below the integer optimum; pinning
-                                        # k=0 is 20/20 feasible — perf notes
-                                        # round 4).  Costs a 2nd solve/step.
+        "integer_first_action": True,  # MILP repair ON by default (round-5:
+                                       # integer parity is the SHIPPED story
+                                       # — the reference's GLPK_MI applies
+                                       # integer duty counts,
+                                       # dragg/mpc_calc.py:171-173): pin the
+                                       # three k=0 duty counts to rounded
+                                       # values and re-solve so the APPLIED
+                                       # action is integer (measured: the
+                                       # bare relaxation sits 2.7-3.6% below
+                                       # the integer optimum; pinning k=0 is
+                                       # 20/20 feasible — perf notes round
+                                       # 4).  Costs a 2nd (warm) solve/step;
+                                       # set false for relaxation-only runs.
+        "integer_repair": "project",  # how the repair lands the pin:
+                                      # "project" = closed-form k=1 state
+                                      # update, NO second solve (everything
+                                      # the plant applies is affine in the
+                                      # pinned counts; measured drift vs
+                                      # re-solving: see perf notes round 5);
+                                      # "resolve" = pinned-box re-solve.
+        "repair_eps": 1e-3,  # IPM tolerance for the "resolve" re-solve —
+                             # loose on purpose: 8-9 iters vs 25-39 at the
+                             # production 2e-4, cost drift 1.5e-4 (perf
+                             # notes round 5).  Unused under "project".
         "ipm_freeze_zmax": 300.0,  # divergence-freeze dual threshold (scaled
                                    # space): freeze a home when rp stalls AND
                                    # its box duals exceed this.  Feasible

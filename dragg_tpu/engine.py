@@ -107,6 +107,11 @@ class StepOutputs(NamedTuple):
     forecast_load: jnp.ndarray    # ()
     agg_cost: jnp.ndarray         # ()
     admm_iters: jnp.ndarray       # () iterations the solver ran this step
+    repair_failed: jnp.ndarray    # () homes whose integer_first_action
+                                  # pinned re-solve failed and kept the
+                                  # relaxed action (0 when repair is off);
+                                  # surfaces the measured-99.9% coverage
+                                  # regressing on chip (ADVICE round 4)
 
 
 class StepAux(NamedTuple):
@@ -155,7 +160,12 @@ class EngineParams(NamedTuple):
     ipm_eps: float      # IPM stopping tolerance (decoupled from admm_eps)
     ipm_freeze_zmax: float  # divergence-freeze dual threshold (scaled space)
     integer_first_action: bool  # MILP repair: pin rounded k=0 duty counts
-                                # and re-solve (one extra IPM solve/step)
+    integer_repair: str  # "project" (closed-form k=1 update, no 2nd solve)
+                         # | "resolve" (pinned-box re-solve)
+    repair_eps: float    # IPM tolerance for the "resolve" re-solve (loose:
+                         # its applied outputs are the pins themselves —
+                         # measured 8-9 iters at 1e-3 vs 25-39 at 2e-4 with
+                         # 1.5e-4 cost drift, perf notes round 5)
     band_kernel: str    # "auto" | "pallas" | "xla" | "cr" band factor/solve
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
     seed: int
@@ -474,12 +484,12 @@ class Engine:
             # better solve counts, docs/perf_notes.md): the budget split
             # and its eligibility conditions live inside ipm_solve_qp —
             # the engine just forwards the cap and the knobs.
-            def run_ipm(l_box, u_box):
+            def run_ipm(l_box, u_box, eps=p.ipm_eps):
                 return ipm_solve_qp(
                     self.static.pattern, qp.vals, qp.b_eq, l_box, u_box,
                     qp.q, reg=p.admm_reg, iters=p.ipm_iters,
                     tail_frac=p.ipm_tail_frac, tail_iters=p.ipm_tail_iters,
-                    eps_abs=p.ipm_eps, eps_rel=p.ipm_eps,
+                    eps_abs=eps, eps_rel=eps,
                     band_kernel=self._band_kernel,
                     mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
                     x0=state.warm_x if p.ipm_warm else None,
@@ -487,15 +497,23 @@ class Engine:
                 )
 
             relaxed = run_ipm(qp.l_box, qp.u_box)
-            sol = relaxed
+            sol, repair_failed = relaxed, jnp.float32(0.0)
             if p.integer_first_action:
-                sol = self._integerize_first_action(qp, relaxed, run_ipm)
+                # The "resolve" re-solve runs at the LOOSE repair_eps: its
+                # applied outputs are the pinned counts themselves, and
+                # 1e-3 measured 8-9 iterations vs 25-39 at the production
+                # 2e-4 with 1.5e-4 cost drift (perf notes round 5).  COLD
+                # start — x0 from the relaxed iterate measured SLOWER
+                # (20-29 iters, warm-start jamming; same measurement).
+                sol, repair_failed = self._integerize_first_action(
+                    qp, relaxed,
+                    lambda l2, u2: run_ipm(l2, u2, eps=p.repair_eps))
             # Warm starts always shift the RELAXED solution: the repaired
             # iterate sits on pinned boxes that move every step, and
             # seeding the next solve from it measurably jams warm-start-
             # dependent solvers (ADMM: downstream solve rate 0.755→0.44
             # before this split — docs/perf_notes.md round 4).
-            return sol, factor, relaxed
+            return sol, factor, relaxed, repair_failed
 
         def run_admm(l_box, u_box, fac, ref, x0, y0, rho0):
             return admm_solve_qp_cached(
@@ -520,21 +538,21 @@ class Engine:
         relaxed, fcarry = run_admm(qp.l_box, qp.u_box, factor, refresh,
                                    state.warm_x, state.warm_y_box,
                                    state.warm_rho)
-        sol = relaxed
+        sol, repair_failed = relaxed, jnp.float32(0.0)
         if p.integer_first_action:
             # Pinned re-solve warm-starts from the relaxed solution and
             # reuses the just-built factor; the NEXT step's warm start
             # comes from `relaxed` (third return), which is what makes
             # the repair safe on this warm-start-dependent family.
-            sol = self._integerize_first_action(
+            sol, repair_failed = self._integerize_first_action(
                 qp, relaxed,
                 lambda l2, u2: run_admm(l2, u2, fcarry, False,
                                         relaxed.x, relaxed.y_box,
                                         relaxed.rho)[0])
-        return sol, fcarry, relaxed
+        return sol, fcarry, relaxed, repair_failed
 
     def _integerize_first_action(self, qp, sol, run_solver):
-        """Opt-in MILP repair (``tpu.integer_first_action``): pin the three
+        """Default-on MILP repair (``tpu.integer_first_action``): pin the three
         k=0 duty counts to their rounded values and re-solve, so the
         APPLIED action matches the reference's integer duty-cycle
         discretization (dragg/mpc_calc.py:171-173 — integer counts in
@@ -612,12 +630,63 @@ class Engine:
 
         cols = jnp.asarray([lay.i_cool, lay.i_heat, lay.i_wh])
         pinned = jnp.stack([pin_c, pin_h, pin_w], axis=1)
+
+        if self.params.integer_repair == "project":
+            # PROJECT mode (round 5): no second solve.  Everything the
+            # receding-horizon loop actually APPLIES from the repaired
+            # solution is affine in the pinned k=0 counts — the applied
+            # duties are the pins themselves, and the k=1 temperatures /
+            # battery energy are pinned by equality rows (build_qp_static
+            # r_tind+0 / r_twhd+0 / r_tin1 / r_twh1 share the same duty
+            # coefficients, and e_batt[1] depends only on the untouched
+            # k=0 battery action).  The plan BEYOND k=1 is discarded next
+            # step, so re-optimizing it (the "resolve" mode's 2nd solve,
+            # measured 25-39 IPM iterations vs the relaxation's 8-10 —
+            # docs/perf_notes.md round 5) buys nothing the plant ever
+            # sees.  Repair-failed = the bump could not restore the k=1
+            # comfort bands (closed form), same graceful degradation.
+            dwh1 = awr * dt1 + a_wh * pwh * (pin_w - wh_r)
+            t1f = col(sol.x, lay.i_tin + 1) + dt1
+            twh1f = col(sol.x, lay.i_twh + 1) + dwh1
+            tol = jnp.asarray(1e-3, f32)  # fp32 row-arithmetic slack
+            in_band = (
+                (t1f >= lo(lay.i_tin + 1) - tol)
+                & (t1f <= hi(lay.i_tin + 1) + tol)
+                & (twh1f >= lo(lay.i_twh + 1) - tol)
+                & (twh1f <= hi(lay.i_twh + 1) + tol)
+            )
+            keep = in_band & sol.solved
+            repair_failed = jnp.sum(
+                jnp.where(sol.solved & ~in_band, self._check_mask, 0.0))
+            x2 = sol.x.at[:, cols].set(pinned)
+            # k=1 entries move by the same affine delta in the EV and the
+            # applied (true-OAT) rows — the duty coefficients coincide;
+            # the windows differ only in the constant term.
+            x2 = x2.at[:, lay.i_tin + 1].add(dt1)
+            x2 = x2.at[:, lay.i_tin1].add(dt1)
+            x2 = x2.at[:, lay.i_twh + 1].add(dwh1)
+            x2 = x2.at[:, lay.i_twh1].add(dwh1)
+            k2 = keep[:, None]
+            return type(sol)(
+                x=jnp.where(k2, x2, sol.x),
+                y_eq=sol.y_eq, y_box=sol.y_box,
+                r_prim=sol.r_prim, r_dual=sol.r_dual,
+                solved=sol.solved, infeasible=sol.infeasible,
+                iters=sol.iters, rho=sol.rho,
+            ), repair_failed
+
         l2 = qp.l_box.at[:, cols].set(pinned)
         u2 = qp.u_box.at[:, cols].set(pinned)
         sol2 = run_solver(l2, u2)
         # Adopt the repaired iterate only where BOTH solves succeeded;
         # solvedness itself stays the relaxation's verdict.
         keep = sol2.solved & sol.solved
+        # Homes whose pinned re-solve failed keep the relaxed (fractional)
+        # action; count them (masked — padded replica homes excluded) so
+        # chunk telemetry can detect repair coverage regressing below the
+        # measured 99.9 % (ADVICE round 4).
+        repair_failed = jnp.sum(
+            jnp.where(sol.solved & ~sol2.solved, self._check_mask, 0.0))
 
         def pick(b, a):
             k = keep.reshape(keep.shape + (1,) * (a.ndim - 1)) \
@@ -634,10 +703,10 @@ class Engine:
             infeasible=sol.infeasible,
             iters=sol.iters + sol2.iters,
             rho=pick(sol2.rho, sol.rho),
-        )
+        ), repair_failed
 
     def _finish(self, state: CommunityState, t, sol, aux: StepAux,
-                warm_sol):
+                warm_sol, repair_failed=0.0):
         """Merge/collect phase: recover physical series, route unsolved homes
         through the fallback controller, emit observables, advance state."""
         p = self.params
@@ -743,6 +812,7 @@ class Engine:
             forecast_load=jnp.sum(fore * self._check_mask),
             agg_cost=jnp.sum(cost0 * self._check_mask),
             admm_iters=sol.iters,
+            repair_failed=jnp.asarray(repair_failed, f32),
         )
         return new_state, out
 
@@ -752,8 +822,10 @@ class Engine:
         threaded separately from CommunityState so it never reaches
         checkpoints (see :meth:`init_factor`)."""
         qp, aux = self._prepare(state, t, rp)
-        sol, fcarry, warm_sol = self._solve(state, qp, factor, refresh)
-        new_state, out = self._finish(state, t, sol, aux, warm_sol)
+        sol, fcarry, warm_sol, repair_failed = self._solve(
+            state, qp, factor, refresh)
+        new_state, out = self._finish(state, t, sol, aux, warm_sol,
+                                      repair_failed)
         return new_state, fcarry, out
 
     def _chunk(self, state: CommunityState, t0, rps):
@@ -842,6 +914,10 @@ def engine_params(config, start_index: int) -> EngineParams:
         raise ValueError(
             f"home.hems.solver must be ipm|admm (or a reference solver name "
             f"GLPK_MI|ECOS|GUROBI), got {hems.get('solver')!r}")
+    repair_mode = str(tpu_cfg.get("integer_repair", "project"))
+    if repair_mode not in ("project", "resolve"):
+        raise ValueError(
+            f"tpu.integer_repair must be project|resolve, got {repair_mode!r}")
     return EngineParams(
         solver=solver,
         horizon=horizon,
@@ -872,7 +948,9 @@ def engine_params(config, start_index: int) -> EngineParams:
         ipm_warm=bool(tpu_cfg.get("ipm_warm_start", False)),
         ipm_eps=float(tpu_cfg.get("ipm_eps", 2e-4)),
         ipm_freeze_zmax=float(tpu_cfg.get("ipm_freeze_zmax", 300.0)),
-        integer_first_action=bool(tpu_cfg.get("integer_first_action", False)),
+        integer_first_action=bool(tpu_cfg.get("integer_first_action", True)),
+        integer_repair=repair_mode,
+        repair_eps=float(tpu_cfg.get("repair_eps", 1e-3)),
         band_kernel=str(tpu_cfg.get("band_kernel", "auto")),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         seed=int(config["simulation"]["random_seed"]),

@@ -24,8 +24,11 @@ def test_bench_smoke_contract():
     result = json.loads(lines[0])
     for key in ("metric", "value", "unit", "vs_baseline", "solver",
                 "solve_rate", "phase_s_per_step", "admm_iters_per_step",
-                "band_kernel", "pallas_selftest"):
+                "band_kernel", "pallas_selftest", "semantics"):
         assert key in result, key
+    # The shipped default is integer semantics (round 5) and the artifact
+    # must say so.
+    assert result["semantics"] == "integer"
     assert result["unit"] == "timesteps/s"
     assert result["value"] > 0
     assert 0.5 <= result["solve_rate"] <= 1.0
@@ -58,7 +61,13 @@ def test_bench_probe_gated_ladder(tmp_path):
     assert result["n_homes"] == 40  # FULL requested size, not a reduced one
     assert result["value"] > 0
     attempts = result["attempts"]
-    assert all(a.get("platform") != "tpu" for a in attempts), attempts
+    # No tpu attempt may have EXECUTED; the probe-down verdict itself is
+    # recorded as a skipped entry so the artifact explains why nothing ran
+    # (ADVICE round 4).
+    assert all(a.get("skipped") for a in attempts
+               if a.get("platform") == "tpu"), attempts
+    assert any(a == {"platform": "tpu", "skipped": "probe_down"}
+               for a in attempts), attempts
     # The probe verdict is a committed-able artifact, not just a log line.
     with open(probe_log) as f:
         content = f.read()

@@ -1,0 +1,148 @@
+"""Closed-loop integer parity vs the HiGHS MILP optimum (VERDICT r4 #4).
+
+The reference applies the first action of a per-home MIXED-INTEGER
+program every step (GLPK_MI; integer duty counts in [0, s] —
+dragg/mpc_calc.py:171-173,344-349).  Round 4 measured the LP
+relaxation's single-step gap at 2.7-3.6 % and shipped the
+``integer_first_action`` pin-and-re-solve repair; round 5 makes the
+repair the DEFAULT.  This test closes the remaining evidence gap: it
+bounds the **closed-loop cost** of the shipped default against a true
+MILP oracle rolled forward through the same receding-horizon loop.
+
+Both arms share the engine's own assembly (``_prepare`` is a pure
+function of (state, t), and the per-step forecast-noise streams depend
+only on (seed, t, home) — not on the trajectory), so the comparison
+isolates solver semantics.  The oracle arm solves every home's step
+MILP exactly (scipy.optimize.milp → HiGHS, integrality on all 3H duty
+columns) and advances state through the engine's own
+``recover_solution`` post-processing; the shipped arm is the public
+``Engine.step`` with its defaults.
+
+Budget: ≤1 % community cost gap over the day (SURVEY §4b's parity
+budget, applied to the integer optimum rather than the LP relaxation).
+"""
+
+import numpy as np
+import pytest
+from scipy.optimize import Bounds, LinearConstraint, milp
+
+import jax.numpy as jnp
+
+from dragg_tpu.config import default_config
+from dragg_tpu.data import load_environment, load_waterdraw_profiles
+from dragg_tpu.engine import make_engine
+from dragg_tpu.homes import build_home_batch, create_homes
+from dragg_tpu.ops.qp import densify_A
+
+H_HOURS = 8
+N_HOMES = 6
+N_STEPS = 24  # one simulated day
+
+
+def _make_engine():
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = N_HOMES
+    cfg["community"]["homes_pv"] = 1
+    cfg["community"]["homes_battery"] = 1
+    cfg["community"]["homes_pv_battery"] = 1
+    cfg["simulation"]["end_datetime"] = "2015-01-02 00"
+    cfg["home"]["hems"]["prediction_horizon"] = H_HOURS
+    assert cfg["tpu"]["integer_first_action"] is True  # the shipped default
+    env = load_environment(cfg)
+    dt = env.dt
+    waterdraw = load_waterdraw_profiles(None, seed=int(cfg["simulation"]["random_seed"]))
+    homes = create_homes(cfg, 24 * dt, dt, waterdraw)
+    hems = cfg["home"]["hems"]
+    batch = build_home_batch(homes, H_HOURS * dt, dt,
+                             int(hems["sub_subhourly_steps"]))
+    return make_engine(batch, env, cfg, env.start_index(env.data_start))
+
+
+def _milp_home(A, beq, l, u, q, int_cols):
+    integrality = np.zeros(q.shape[0])
+    integrality[int_cols] = 1
+    res = milp(c=q,
+               constraints=LinearConstraint(A, beq, beq),
+               bounds=Bounds(np.where(np.isfinite(l), l, -np.inf),
+                             np.where(np.isfinite(u), u, np.inf)),
+               integrality=integrality)
+    return res
+
+
+@pytest.mark.slow
+def test_closed_loop_cost_within_1pct_of_milp_oracle():
+    eng = _make_engine()
+    lay, p = eng.layout, eng.params
+    H, s = p.horizon, p.s
+    n = eng.n_homes
+    # All 3H duty-count columns are integer in the reference's program.
+    int_cols = np.concatenate([
+        np.arange(lay.i_cool, lay.i_cool + H),
+        np.arange(lay.i_heat, lay.i_heat + H),
+        np.arange(lay.i_wh, lay.i_wh + H),
+    ])
+
+    # --- Shipped arm: the public engine step with default (integer) semantics.
+    state = eng.init_state()
+    cost_ours = 0.0
+    solved_ours = []
+    for t in range(N_STEPS):
+        state, out = eng.step(state, t, np.zeros((H,), np.float32))
+        cost_ours += float(np.sum(np.asarray(out.cost)))
+        solved_ours.append(np.asarray(out.correct_solve) == 1.0)
+
+    # --- Oracle arm: exact per-home MILP each step; infeasible homes ride
+    # the engine's OWN fallback controller (the reference does the same
+    # when GLPK fails, dragg/mpc_calc.py:527-596) — the oracle solution is
+    # packed into the solver's solution type and handed to ``_finish`` so
+    # merge/fallback/state-advance are byte-identical to the shipped path.
+    from dragg_tpu.ops.admm import ADMMSolution
+
+    ostate = eng.init_state()
+    cost_oracle = 0.0
+    solved_oracle = []
+    for t in range(N_STEPS):
+        qp, aux = eng._prepare(ostate, jnp.asarray(t),
+                               jnp.zeros((H,), jnp.float32))
+        A = np.asarray(densify_A(eng.static.pattern, qp.vals), np.float64)
+        beq = np.asarray(qp.b_eq, np.float64)
+        l = np.asarray(qp.l_box, np.float64)
+        u = np.asarray(qp.u_box, np.float64)
+        q = np.asarray(qp.q, np.float64)
+        xs, ok = [], []
+        for i in range(n):
+            res = _milp_home(A[i], beq[i], l[i], u[i], q[i], int_cols)
+            feasible = res.status == 0
+            ok.append(feasible)
+            xs.append(res.x if feasible
+                      else np.clip(np.zeros(l[i].shape[0]),
+                                   np.where(np.isfinite(l[i]), l[i], 0.0),
+                                   np.where(np.isfinite(u[i]), u[i], 0.0)))
+        x = jnp.asarray(np.stack(xs), jnp.float32)
+        okv = jnp.asarray(np.array(ok))
+        zeros = jnp.zeros((n,), jnp.float32)
+        sol = ADMMSolution(
+            x=x, y_eq=jnp.zeros_like(qp.b_eq), y_box=jnp.zeros_like(x),
+            r_prim=zeros, r_dual=zeros, solved=okv, infeasible=~okv,
+            iters=jnp.asarray(0), rho=jnp.ones((n,), jnp.float32))
+        ostate, out = eng._finish(ostate, jnp.asarray(t), sol, aux, sol)
+        cost_oracle += float(np.sum(np.asarray(out.cost)))
+        solved_oracle.append(np.asarray(out.correct_solve) == 1.0)
+
+    # Apples-to-apples check: the shipped solver's solvedness verdict must
+    # track HiGHS feasibility step-by-step (the single-step guarantee of
+    # tests/test_qp_parity.py, here verified along the closed loop).
+    mismatches = sum(int(np.sum(a != b))
+                     for a, b in zip(solved_ours, solved_oracle))
+    assert mismatches <= 2, (
+        f"{mismatches} home-step solvedness mismatches vs HiGHS along the loop")
+
+    gap = (cost_ours - cost_oracle) / max(abs(cost_oracle), 1e-6)
+    # ≤1 % closed-loop budget vs the INTEGER optimum (not the LP bound).
+    # Ours may land slightly below the oracle's total: the repair pins
+    # rounded counts against a fractional future plan, so individual
+    # steps can trade differently than the exact MILP policy — bound the
+    # magnitude both ways.
+    assert abs(gap) <= 0.01, (
+        f"closed-loop cost gap vs MILP oracle {gap:+.4%} "
+        f"(ours {cost_ours:.3f} vs oracle {cost_oracle:.3f})")
