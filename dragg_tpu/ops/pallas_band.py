@@ -32,26 +32,31 @@ import jax.numpy as jnp
 from jax import lax
 
 # Homes per kernel program (lane tiles of 128).  Env-tunable for on-chip
-# block-size experiments without code edits; 512 measured as the default.
-def _lane_block_from_env() -> int:
+# block-size experiments without code edits; 512 measured as the default,
+# and block sizes now AUTO-shrink from a scoped-VMEM model when the env
+# var is unset (round 5 — see _auto_blocks).
+def _lane_block_from_env() -> int | None:
     """Parse DRAGG_LANE_BLOCK defensively: a bad value must not make every
     dragg_tpu import raise, and a non-multiple of 128 (the TPU lane width)
     would break Mosaic lowering in a way the self-test only catches on
-    TPU — round it up and warn instead."""
+    TPU — round it up and warn instead.  Returns None when UNSET: block
+    sizes are then chosen per call shape by _auto_blocks."""
     import logging
     import os
 
     raw = os.environ.get("DRAGG_LANE_BLOCK", "")
+    if not raw:
+        return None
     try:
-        v = int(raw) if raw else 512
+        v = int(raw)
     except ValueError:
         logging.getLogger("dragg_tpu.pallas").warning(
-            "DRAGG_LANE_BLOCK=%r is not an integer; using 512", raw)
-        return 512
+            "DRAGG_LANE_BLOCK=%r is not an integer; using auto policy", raw)
+        return None
     if v <= 0:
         logging.getLogger("dragg_tpu.pallas").warning(
-            "DRAGG_LANE_BLOCK=%d must be positive; using 512", v)
-        return 512
+            "DRAGG_LANE_BLOCK=%d must be positive; using auto policy", v)
+        return None
     rounded = -(-v // 128) * 128
     if rounded != v:
         logging.getLogger("dragg_tpu.pallas").warning(
@@ -60,31 +65,124 @@ def _lane_block_from_env() -> int:
     return rounded
 
 
-LANE_BLOCK = _lane_block_from_env()
+_ENV_LANE_BLOCK = _lane_block_from_env()
+# Back-compat constant (self-test block size, tools' sweeps): the measured
+# default when no override/auto applies.
+LANE_BLOCK = _ENV_LANE_BLOCK or 512
+
+# Scoped-VMEM budget for the auto policy.  v5e/v4 cores have 16 MiB of
+# VMEM; Mosaic double-buffers pipelined blocks and (observed round 4,
+# docs/onchip_r4/) the FULL (m, B) kernel output participates in the
+# scoped budget — so we model both and keep headroom.  Tunable for
+# on-chip A/B without code edits.
+def _vmem_budget_from_env() -> int:
+    """Defensive like the sibling parsers: a malformed value must not
+    make every dragg_tpu import raise — fall back to the 10 MiB default."""
+    import logging
+
+    raw = os.environ.get("DRAGG_VMEM_BUDGET_MB", "")
+    try:
+        mb = float(raw) if raw else 10.0
+    except ValueError:
+        logging.getLogger("dragg_tpu.pallas").warning(
+            "DRAGG_VMEM_BUDGET_MB=%r is not a number; using 10", raw)
+        mb = 10.0
+    if mb <= 0:
+        logging.getLogger("dragg_tpu.pallas").warning(
+            "DRAGG_VMEM_BUDGET_MB=%r must be positive; using 10", raw)
+        mb = 10.0
+    return int(mb * (1 << 20))
 
 
-def _bchunk_from_env() -> int:
+_VMEM_BUDGET = _vmem_budget_from_env()
+
+
+def _auto_blocks(m: int, bwp1: int, n_band_bufs: int, n_vec_bufs: int,
+                 itemsize: int, B: int) -> tuple[int, int]:
+    """Choose (lane_block, b_chunk) from the call shape so the kernel fits
+    the scoped-VMEM budget with no env overrides (VERDICT r4 next-3: the
+    flagship H=48 shape must not OOM out of the box).
+
+    Model (per kernel program, double-buffered for grid pipelining):
+    ``2·(n_band_bufs·m·bwp1 + n_vec_bufs·m)·lane_block·itemsize`` — plus
+    the full ``(m, B_call)`` output, which the round-4 OOM showed lives
+    in the SAME scoped budget and which only chunking the home axis
+    (b_chunk) can shrink.  Each half gets half the budget.
+
+    Measured anchors: m=77 (H=24) fits at lane_block=512 (band kernels
+    15-38 us, docs/onchip_r4/band_kernel_24h.json); m=149 (H=48) OOMs at
+    512 and was staged at 256 (CLAUDE.md) — this policy reproduces both
+    with the default 10 MiB budget.
+    """
+    half = _VMEM_BUDGET // 2
+    per_home = 2 * (n_band_bufs * m * bwp1 + n_vec_bufs * m) * itemsize
+    lb = 512
+    while lb > 128 and per_home * lb > half:
+        lb -= 128
+    # Full-output half: bound homes per pallas_call to a lane_block
+    # multiple; 0 = no chunking needed.  When even lb homes' output
+    # exceeds the half-budget (tiny DRAGG_VMEM_BUDGET_MB A/Bs), chunk at
+    # the minimum possible (lb) rather than not at all — disabling the
+    # guard exactly when pressure is worst would guarantee the OOM the
+    # policy exists to prevent (round-5 review finding).
+    cap = half // max(m * itemsize, 1)
+    cap = (cap // lb) * lb
+    if cap >= B:
+        b_chunk = 0
+    else:
+        b_chunk = max(cap, lb)
+    return lb, b_chunk
+
+
+def _blocks_for(m: int, bwp1: int, n_band_bufs: int, n_vec_bufs: int,
+                itemsize: int, B: int,
+                lane_block: int | None, b_chunk: int | None) -> tuple[int, int]:
+    """Resolve (lane_block, b_chunk): explicit args win, then env
+    overrides, then the auto policy for whichever remains unset."""
+    auto_lb, auto_ck = _auto_blocks(m, bwp1, n_band_bufs, n_vec_bufs,
+                                    itemsize, B)
+    lb = lane_block or _ENV_LANE_BLOCK or auto_lb
+    if b_chunk is None:
+        ck = auto_ck if _ENV_B_CHUNK is None else _ENV_B_CHUNK
+    else:
+        ck = b_chunk
+    return lb, ck
+
+
+def _bchunk_from_env() -> int | None:
     """DRAGG_PALLAS_BCHUNK: split the home axis into slices of this size,
-    one pallas_call per slice (0 = off).  Prepared for the m=149 scoped-
-    VMEM OOM seen on the axon AOT compiler (docs/onchip_r4/): the OOM'd
+    one pallas_call per slice (an explicit 0 = chunking OFF — the round-4
+    OOM repro configuration).  Prepared for the m=149 scoped-VMEM OOM
+    seen on the axon AOT compiler (docs/onchip_r4/): the OOM'd
     allocation was the FULL (m, B) kernel output, which a smaller
     LANE_BLOCK cannot shrink — bounding B per call can.  Parity: each
     home is independent, so chunked == unchunked bitwise (pinned in
-    tests/test_pallas_band.py)."""
+    tests/test_pallas_band.py).  Returns None when UNSET or malformed —
+    the auto policy then chooses (a typo must not silently disable the
+    OOM guard; round-5 review finding)."""
     import logging
     import os
 
     raw = os.environ.get("DRAGG_PALLAS_BCHUNK", "")
+    if not raw:
+        return None
     try:
-        v = int(raw) if raw else 0
+        v = int(raw)
     except ValueError:
         logging.getLogger("dragg_tpu.pallas").warning(
-            "DRAGG_PALLAS_BCHUNK=%r is not an integer; disabling", raw)
-        return 0
-    return max(0, v)
+            "DRAGG_PALLAS_BCHUNK=%r is not an integer; using auto policy",
+            raw)
+        return None
+    if v < 0:
+        logging.getLogger("dragg_tpu.pallas").warning(
+            "DRAGG_PALLAS_BCHUNK=%d must be >= 0; using auto policy", v)
+        return None
+    return v
 
 
-B_CHUNK = _bchunk_from_env()
+_ENV_B_CHUNK = _bchunk_from_env()
+# Back-compat constant for tools' sweeps: 0 when unset (no forced chunk).
+B_CHUNK = _ENV_B_CHUNK or 0
 
 
 def _chunked(fn, n_out: int, ck: int, *arrays):
@@ -231,16 +329,17 @@ def banded_cholesky_t(Sb_t: jnp.ndarray, bw: int,
     pallas_call — see _bchunk_from_env."""
     from jax.experimental import pallas as pl
 
-    ck = B_CHUNK if b_chunk is None else b_chunk
-    if ck and Sb_t.shape[-1] > ck:
-        # b_chunk=0 in the recursion: the outer level did the chunking —
-        # letting the env default re-apply would silently re-chunk every
-        # slice to B_CHUNK and corrupt explicit chunk-size sweeps.
-        return _chunked(lambda s: banded_cholesky_t(s, bw, lane_block,
-                                                    b_chunk=0),
-                        1, ck, Sb_t)
-    lb = lane_block or LANE_BLOCK
     m, bwp1, B = Sb_t.shape
+    # S in + L out = 2 band buffers, no vector buffers.
+    lb, ck = _blocks_for(m, bwp1, 2, 0, Sb_t.dtype.itemsize, B,
+                         lane_block, b_chunk)
+    if ck and B > ck:
+        # b_chunk=0 in the recursion: the outer level did the chunking —
+        # letting the default re-apply would silently re-chunk every
+        # slice and corrupt explicit chunk-size sweeps.  lane_block is
+        # pinned so every slice uses the block the policy chose here.
+        return _chunked(lambda s: banded_cholesky_t(s, bw, lb, b_chunk=0),
+                        1, ck, Sb_t)
     Bp = -(-B // lb) * lb
     if Bp != B:
         pad = jnp.zeros((m, bwp1, Bp - B), Sb_t.dtype).at[:, 0, :].set(1.0)
@@ -333,16 +432,17 @@ def refined_banded_solve_t(Lb_t: jnp.ndarray, Sb_t: jnp.ndarray,
     """
     from jax.experimental import pallas as pl
 
-    ck = B_CHUNK if b_chunk is None else b_chunk
-    if ck and Lb_t.shape[-1] > ck:
+    m, bwp1, B = Lb_t.shape
+    # L + S band inputs = 2 band buffers; r/out/y/t = 4 vector buffers.
+    lb, ck = _blocks_for(m, bwp1, 2, 4, Lb_t.dtype.itemsize, B,
+                         lane_block, b_chunk)
+    if ck and B > ck:
         return _chunked(
             lambda L, S, r: refined_banded_solve_t(L, S, r, bw,
                                                    refine=refine,
-                                                   lane_block=lane_block,
+                                                   lane_block=lb,
                                                    b_chunk=0),
             1, ck, Lb_t, Sb_t, r_t)
-    lb = lane_block or LANE_BLOCK
-    m, bwp1, B = Lb_t.shape
     Bp = -(-B // lb) * lb
     if Bp != B:
         padL = jnp.zeros((m, bwp1, Bp - B), Lb_t.dtype).at[:, 0, :].set(1.0)
@@ -402,15 +502,16 @@ def factor_refined_solve_t(Sb_t: jnp.ndarray, r_t: jnp.ndarray, bw: int,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    ck = B_CHUNK if b_chunk is None else b_chunk
-    if ck and Sb_t.shape[-1] > ck:
+    m, bwp1, B = Sb_t.shape
+    # S in + L out = 2 band buffers; r/x/y/t = 4 vector buffers.
+    lb, ck = _blocks_for(m, bwp1, 2, 4, Sb_t.dtype.itemsize, B,
+                         lane_block, b_chunk)
+    if ck and B > ck:
         return _chunked(
             lambda S, r: factor_refined_solve_t(S, r, bw, refine=refine,
-                                                lane_block=lane_block,
+                                                lane_block=lb,
                                                 b_chunk=0),
             2, ck, Sb_t, r_t)
-    lb = lane_block or LANE_BLOCK
-    m, bwp1, B = Sb_t.shape
     Bp = -(-B // lb) * lb
     if Bp != B:
         pad = jnp.zeros((m, bwp1, Bp - B), Sb_t.dtype).at[:, 0, :].set(1.0)

@@ -266,3 +266,47 @@ def test_bchunk_is_bitwise_identical(band_problem):
     np.testing.assert_array_equal(np.asarray(x0), np.asarray(x1))
     np.testing.assert_array_equal(np.asarray(Lf0), np.asarray(Lf1))
     np.testing.assert_array_equal(np.asarray(xf0), np.asarray(xf1))
+
+
+def test_auto_block_policy_measured_anchors():
+    """The scoped-VMEM auto policy (round 5, VERDICT r4 next-3) must
+    reproduce the two on-chip anchors with the default 10 MiB budget:
+    m=77 (H=24) ran at lane_block=512 (docs/onchip_r4/band_kernel_24h),
+    m=149 (H=48) scoped-VMEM OOM'd at 512 and was staged at 256
+    (CLAUDE.md gotcha) — no env overrides."""
+    from dragg_tpu.ops.pallas_band import _auto_blocks
+
+    # Refined-solve shape: 2 band + 4 vector buffers, f32.
+    lb24, _ = _auto_blocks(77, 5, 2, 4, 4, 512)
+    lb48, _ = _auto_blocks(149, 5, 2, 4, 4, 512)
+    assert lb24 == 512
+    assert lb48 == 256
+    # The full (m, B) output participates in the scoped budget (observed
+    # round 4): at 25k homes x m=149 the policy must chunk the home axis
+    # to a lane-block multiple; at 512 homes it must not chunk.
+    _, ck_small = _auto_blocks(149, 5, 2, 4, 4, 512)
+    _, ck_big = _auto_blocks(149, 5, 2, 4, 4, 25088)
+    assert ck_small == 0
+    assert ck_big > 0 and ck_big % lb48 == 0 and ck_big < 25088
+    assert ck_big * 149 * 4 <= 5 * (1 << 20)
+
+
+def test_auto_chunked_refined_solve_matches_unchunked(band_problem):
+    """When the auto policy decides to chunk (forced here via a tiny
+    DRAGG_VMEM_BUDGET through explicit b_chunk), results stay bitwise
+    identical to the unchunked call — same guarantee the env-var path
+    pins in test_bchunk_is_bitwise_identical, now for policy-chosen
+    chunks."""
+    import numpy as np
+
+    from dragg_tpu.ops import banded as bd
+    from dragg_tpu.ops.pallas_band import refined_banded_solve_t
+
+    B, m, bw, Sb, r = band_problem
+    Lb = bd.banded_cholesky(Sb, bw)
+    Lt, St = jnp.transpose(Lb, (1, 2, 0)), jnp.transpose(Sb, (1, 2, 0))
+    rt = jnp.swapaxes(r, 0, 1)
+    full = refined_banded_solve_t(Lt, St, rt, bw, refine=1)
+    chunked = refined_banded_solve_t(Lt, St, rt, bw, refine=1,
+                                     lane_block=128, b_chunk=2)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(chunked))
