@@ -72,7 +72,17 @@ def _check_native() -> dict:
 def _check_data(cfg: dict | None) -> dict:
     data_dir = os.environ.get("DATA_DIR")
     if not data_dir:
-        return {"status": OK, "note": "no DATA_DIR — synthetic weather/draws/prices"}
+        from dragg_tpu.data import bundled_data_dir
+
+        bundled = bundled_data_dir()
+        if bundled is not None:
+            # Round 5: no DATA_DIR resolves to the repo's bundled assets
+            # (reference-default file-ingestion path), not synthetic.
+            data_dir = bundled
+        else:
+            return {"status": OK,
+                    "note": "no DATA_DIR and no bundled data/ — synthetic "
+                            "weather/draws/prices"}
     # The exact file names the runtime resolves (dragg_tpu/data.py), env
     # overrides included.
     wanted = [os.environ.get("SOLAR_TEMPERATURE_DATA_FILE", "nsrdb.csv")]
