@@ -20,7 +20,8 @@ import numpy as np
 def assemble_community_qp(horizon_hours: int = 4, n_homes: int = 6,
                           homes_pv: int = 1, homes_battery: int = 1,
                           homes_pv_battery: int = 1,
-                          season: str = "heat"):
+                          season: str = "heat",
+                          return_inputs: bool = False):
     """Assemble the t=0 community QP for a seeded mixed community.
 
     ``season``: "heat" pins the reference test fixture's heat-only gate;
@@ -97,4 +98,20 @@ def assemble_community_qp(horizon_hours: int = 4, n_homes: int = 6,
         heat_cap=jnp.asarray(heat_cap, dtype=jnp.float32),
         wh_cap=s, discount=p.discount,
     )
+    if return_inputs:
+        # Raw model inputs for INDEPENDENT re-derivations of the program
+        # (tests/test_model_parity.py transcribes the reference's cvxpy
+        # constraints directly from these — bypassing ops/qp.py — to
+        # check the canonicalized matrices encode the same model).
+        inputs = dict(
+            batch=b, dt=dt, s=int(s), discount=float(p.discount),
+            oat_window=np.asarray(oat_w), ghi_window=np.asarray(ghi_w),
+            price=price, draw_size=draw_size, tank=tank,
+            temp_in_init=np.asarray(b.temp_in_init, dtype=np.float64),
+            temp_wh_init=np.asarray(twh_init, dtype=np.float64),
+            e_batt_init=np.asarray(b.e_batt_init_frac * b.batt_capacity,
+                                   dtype=np.float64),
+            cool_cap=cool_cap, heat_cap=heat_cap,
+        )
+        return qp, eng.static.pattern, lay, int(s), inputs
     return qp, eng.static.pattern, lay, int(s)

@@ -1,0 +1,68 @@
+"""The home-by-home diff harness (tools/compare_reference.py) — CI
+exercise of the alignment + statistics logic so it cannot rot while the
+literal-reference run waits for a dockerized environment
+(docs/reference_comparison.md layer 3)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dragg_tpu.aggregator import Aggregator
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cfg, outdir):
+    agg = Aggregator(config=cfg, outputs_dir=str(outdir))
+    agg.run()
+    return os.path.join(agg.run_dir, "baseline", "results.json")
+
+
+@pytest.mark.slow
+def test_compare_tool_identical_and_perturbed(tiny_config, tmp_path):
+    import copy
+
+    cfg = copy.deepcopy(tiny_config)
+    res_a = _run(cfg, tmp_path / "a")
+
+    # Same seed/config → bit-identical series → all-zero diffs, bounded.
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "compare_reference.py"),
+         res_a, res_a],
+        capture_output=True, text=True, timeout=120, check=True)
+    d = json.loads(out.stdout)
+    assert d["n_shared"] == d["n_homes_ref"] == d["n_homes_ours"] > 0
+    assert d["bounded"] is True
+    assert all(s["max_abs"] == 0.0 for s in d["series"].values())
+    # Every compared series must actually exist in the results schema —
+    # a renamed/missing key must surface as missing_homes, and the
+    # shipped schema must have none (caught the cost/cost_opt drift,
+    # round-5 verify).
+    assert all("missing_homes" not in s for s in d["series"].values()), d["series"]
+
+    # Same seed (names align) but a different horizon → different plans →
+    # nonzero divergence must be reported, not masked by the alignment.
+    cfg2 = copy.deepcopy(tiny_config)
+    cfg2["home"]["hems"]["prediction_horizon"] = 2
+    res_b = _run(cfg2, tmp_path / "b")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "compare_reference.py"),
+         res_a, res_b],
+        capture_output=True, text=True, timeout=120, check=True)
+    d = json.loads(out.stdout)
+    assert d["n_shared"] > 0  # names coincide (same count, same order)
+    assert max(s["max_abs"] for s in d["series"].values()) > 0.0
+
+
+def test_run_reference_refuses_without_stack():
+    """--run-reference must fail fast with the Docker pointer when the
+    reference stack is absent (it is, in this image)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "compare_reference.py"),
+         "--run-reference"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0
+    assert "reference stack unavailable" in (out.stderr + out.stdout)

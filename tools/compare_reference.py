@@ -1,0 +1,123 @@
+"""Home-by-home diff of a REAL reference run vs a dragg_tpu run (VERDICT
+r4 next-5).
+
+The reference stack (cvxpy + GLPK_MI + a redis server) is not in this
+build image and cannot be installed here; the repo's Docker image ships
+it precisely for this harness (see docs/reference_comparison.md for the
+recipe).  This tool therefore has two modes:
+
+* diff mode (runs anywhere): given the two runs' results.json files —
+  the reference's layout and ours are schema-identical by construction
+  (dragg_tpu/aggregator.py results writer, parity cites therein) — align
+  homes by name and report per-series divergence statistics as one JSON
+  line.
+* --run-reference: execute the reference's own main loop in-process
+  (needs cvxpy/glpk/redis importable AND a redis server); refuses with a
+  clear message when the stack is absent.
+
+Series compared per home (the reference's result hash fields,
+dragg/mpc_calc.py:482-524): temp_in_opt, temp_wh_opt, p_grid_opt, cost,
+hvac_cool_on_opt, hvac_heat_on_opt, wh_heat_on_opt, correct_solve.
+
+Usage:
+  python tools/compare_reference.py REF_RESULTS.json OURS_RESULTS.json
+  python tools/compare_reference.py --run-reference --config C --data-dir D
+"""
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+SERIES = ("temp_in_opt", "temp_wh_opt", "p_grid_opt", "cost_opt",
+          "hvac_cool_on_opt", "hvac_heat_on_opt", "wh_heat_on_opt",
+          "correct_solve")
+
+
+def load_homes(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    return {k: v for k, v in data.items() if k != "Summary"}
+
+
+def diff(ref_path: str, ours_path: str) -> dict:
+    ref, ours = load_homes(ref_path), load_homes(ours_path)
+    shared = sorted(set(ref) & set(ours))
+    out = {
+        "n_homes_ref": len(ref), "n_homes_ours": len(ours),
+        "n_shared": len(shared), "series": {},
+    }
+    worst = 0.0
+    for s in SERIES:
+        maxabs, rmse, n = 0.0, 0.0, 0
+        per_home_max = {}
+        missing = 0
+        for h in shared:
+            a = np.asarray(ref[h].get(s, []), dtype=float)
+            b = np.asarray(ours[h].get(s, []), dtype=float)
+            m = min(len(a), len(b))
+            if m == 0:
+                # A series absent from every home must be visible in the
+                # verdict, not silently reported as zero divergence.
+                missing += 1
+                continue
+            d = np.abs(a[:m] - b[:m])
+            per_home_max[h] = float(d.max())
+            maxabs = max(maxabs, float(d.max()))
+            rmse += float(np.sum((a[:m] - b[:m]) ** 2))
+            n += m
+        top = sorted(per_home_max.items(), key=lambda kv: -kv[1])[:3]
+        out["series"][s] = {
+            "max_abs": round(maxabs, 6),
+            "rmse": round((rmse / max(n, 1)) ** 0.5, 6),
+            "worst_homes": [h for h, _ in top],
+            **({"missing_homes": missing} if missing else {}),
+        }
+        if s in ("temp_in_opt", "temp_wh_opt"):
+            worst = max(worst, maxabs)
+    out["bounded"] = bool(worst <= 1.0)  # ≤1 °C trajectory divergence
+    return out
+
+
+def run_reference(config: str, data_dir: str) -> None:
+    missing = []
+    for mod in ("cvxpy", "redis", "pathos"):
+        try:
+            __import__(mod)
+        except ImportError:
+            missing.append(mod)
+    if missing:
+        sys.exit(
+            f"reference stack unavailable: {', '.join(missing)} not "
+            f"importable.  Build and run the repo's Docker image "
+            f"(docs/reference_comparison.md) — it installs cvxpy+glpk+"
+            f"redis and starts redis-server — then rerun with "
+            f"--run-reference inside it.")
+    sys.path.insert(0, "/root/reference")
+    import os
+
+    os.environ.setdefault("CONFIG_FILE", config)
+    os.environ.setdefault("DATA_DIR", data_dir)
+    from dragg.aggregator import Aggregator  # the real reference
+
+    Aggregator().run()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("paths", nargs="*", help="REF_RESULTS.json OURS_RESULTS.json")
+    ap.add_argument("--run-reference", action="store_true")
+    ap.add_argument("--config", default="config.toml")
+    ap.add_argument("--data-dir", default="/root/reference/dragg/data")
+    args = ap.parse_args()
+    if args.run_reference:
+        run_reference(args.config, args.data_dir)
+        return
+    if len(args.paths) != 2:
+        ap.error("need REF_RESULTS.json and OURS_RESULTS.json (or --run-reference)")
+    print(json.dumps(diff(*args.paths)))
+
+
+if __name__ == "__main__":
+    main()
