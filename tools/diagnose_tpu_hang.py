@@ -135,6 +135,7 @@ def main():
                     "the failure is the wedge trigger; restart the tunnel "
                     "before retrying")
                 break
+    results["all_ok"] = all(s["ok"] for s in results["stages"].values())
     print(json.dumps(results))
 
 

@@ -1,0 +1,138 @@
+"""Decompose the fallback population at headline scale (VERDICT r4 #6).
+
+BENCH_r04 measured solve_rate 0.882 at 10k homes x 24 h — ~1,180
+home-steps/day riding the bang-bang fallback controller — but the
+infeasibility forensics that blamed the WH comfort band were done at 512
+homes.  This tool grounds the story AT SCALE: it steps the real engine
+eagerly, and for every home-step the solver gave up on it re-solves that
+home's exact matrices with HiGHS (the trusted oracle) and classifies:
+
+* ``infeasible``       — HiGHS agrees no feasible point exists (the
+                         reference's GLPK would fail identically and ride
+                         its own fallback, dragg/mpc_calc.py:527-596);
+* ``under_converged``  — HiGHS finds a feasible optimum our solver
+                         missed: a REAL behavioral delta from the
+                         reference, the fraction worth tuning away.
+
+Also cross-checks the converse at a sample: homes we SOLVED where HiGHS
+agrees feasible (sanity against false positives).
+
+Emits one JSON line; paste the table into docs/perf_notes.md.
+
+Usage: python tools/fallback_forensics.py [--homes 10000] [--steps 24]
+         [--horizon-hours 24] [--solver ipm]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--homes", type=int, default=10000)
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--horizon-hours", type=int, default=24)
+    ap.add_argument("--solver", default="ipm")
+    ap.add_argument("--sample-solved", type=int, default=64,
+                    help="solved homes per step to cross-check vs HiGHS")
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--data-dir", default=None,
+                    help='weather assets dir; "" forces synthetic (the '
+                         "rounds-2..4 bench environment)")
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from scipy.optimize import linprog
+
+    import bench
+    from dragg_tpu.ops.qp import densify_A
+
+    engine, _np = bench.build(args.homes, args.horizon_hours,
+                              admm_iters=1500, solver=args.solver,
+                              data_dir=args.data_dir)
+    pat = engine.static.pattern
+    H = engine.params.horizon
+    state = engine.init_state()
+    rng = np.random.RandomState(7)
+
+    counts = {"infeasible": 0, "under_converged": 0}
+    per_step = []
+    solved_checked = solved_mismatch = 0
+    t0 = time.time()
+    for t in range(args.steps):
+        import jax.numpy as jnp
+
+        qp, _aux = engine._prepare(state, jnp.asarray(t),
+                                   jnp.zeros((H,), jnp.float32))
+        state, out = engine.step(state, t, np.zeros((H,), np.float32))
+        cs = np.asarray(out.correct_solve)
+        fail_idx = np.where(cs == 0.0)[0]
+        vals = np.asarray(qp.vals)
+        beq = np.asarray(qp.b_eq, np.float64)
+        l = np.asarray(qp.l_box, np.float64)
+        u = np.asarray(qp.u_box, np.float64)
+        q = np.asarray(qp.q, np.float64)
+
+        def classify(i) -> bool:
+            """True = HiGHS feasible."""
+            A = np.asarray(densify_A(pat, vals[i:i + 1]), np.float64)[0]
+            bounds = [(lo if np.isfinite(lo) else None,
+                       hi if np.isfinite(hi) else None)
+                      for lo, hi in zip(l[i], u[i])]
+            res = linprog(q[i], A_eq=A, b_eq=beq[i], bounds=bounds,
+                          method="highs")
+            return bool(res.success)
+
+        step_inf = step_uc = 0
+        for i in fail_idx:
+            if classify(int(i)):
+                counts["under_converged"] += 1
+                step_uc += 1
+            else:
+                counts["infeasible"] += 1
+                step_inf += 1
+        ok_idx = np.where(cs == 1.0)[0]
+        if len(ok_idx) and args.sample_solved:
+            for i in rng.choice(ok_idx,
+                                size=min(args.sample_solved, len(ok_idx)),
+                                replace=False):
+                solved_checked += 1
+                if not classify(int(i)):
+                    solved_mismatch += 1
+        per_step.append({"t": t, "failed": int(len(fail_idx)),
+                         "infeasible": step_inf, "under_converged": step_uc})
+        print(f"[forensics] t={t}: failed={len(fail_idx)} "
+              f"(infeasible={step_inf}, under-converged={step_uc})",
+              file=sys.stderr, flush=True)
+
+    total_failed = counts["infeasible"] + counts["under_converged"]
+    result = {
+        "data": "synthetic" if args.data_dir == "" else "bundled",
+        "homes": args.homes, "steps": args.steps,
+        "horizon_hours": args.horizon_hours, "solver": args.solver,
+        "total_home_steps": args.homes * args.steps,
+        "failed_home_steps": total_failed,
+        "solve_rate": round(1 - total_failed / (args.homes * args.steps), 4),
+        **counts,
+        "under_converged_frac_of_failures": round(
+            counts["under_converged"] / max(total_failed, 1), 4),
+        "solved_cross_checked": solved_checked,
+        "solved_but_highs_infeasible": solved_mismatch,
+        "per_step": per_step,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
