@@ -618,13 +618,24 @@ class Engine:
                           jnp.maximum(pin_h - 1, lo(lay.i_heat)), pin_h)
         pin_c = jnp.where(need_dn & ~heat_active,
                           jnp.minimum(pin_c + 1, hi(lay.i_cool)), pin_c)
-        # k=1 WH temp (row r_twhd+0) sees the FINAL indoor delta + wh0.
+        # k=1 WH temp under the pin — BOTH rows: the EV entry (r_twhd+0,
+        # draw-mixed) and the APPLIED entry (r_twh1, no mixing — this is
+        # the value _finish propagates as temp_wh_next).  The two differ
+        # in constants, so a pin can leave one in band and not the other
+        # (measured: 0.124 degC applied-row excursion at 1000 homes when
+        # only the EV row was checked — round-5 fix).  The duty/indoor
+        # deltas are identical for both rows; bump toward whichever bound
+        # the WORSE row violates.
         dt1 = t1_of(pin_c, pin_h) - col(sol.x, lay.i_tin + 1)
-        twh1 = (col(sol.x, lay.i_twh + 1) + awr * dt1
-                + a_wh * pwh * (pin_w - wh_r))
-        pin_w = jnp.where(twh1 < lo(lay.i_twh + 1),
+        dwh = lambda w: awr * dt1 + a_wh * pwh * (w - wh_r)
+        twh_rows = lambda w: (col(sol.x, lay.i_twh + 1) + dwh(w),
+                              col(sol.x, lay.i_twh1) + dwh(w))
+        ev0, ap0 = twh_rows(pin_w)
+        low = jnp.minimum(ev0 - lo(lay.i_twh + 1), ap0 - lo(lay.i_twh1))
+        high = jnp.maximum(ev0 - hi(lay.i_twh + 1), ap0 - hi(lay.i_twh1))
+        pin_w = jnp.where(low < 0,
                           jnp.minimum(pin_w + 1, hi(lay.i_wh)),
-                          jnp.where(twh1 > hi(lay.i_twh + 1),
+                          jnp.where(high > 0,
                                     jnp.maximum(pin_w - 1, lo(lay.i_wh)),
                                     pin_w))
 
@@ -645,15 +656,24 @@ class Engine:
             # docs/perf_notes.md round 5) buys nothing the plant ever
             # sees.  Repair-failed = the bump could not restore the k=1
             # comfort bands (closed form), same graceful degradation.
-            dwh1 = awr * dt1 + a_wh * pwh * (pin_w - wh_r)
+            dwh1 = dwh(pin_w)
             t1f = col(sol.x, lay.i_tin + 1) + dt1
-            twh1f = col(sol.x, lay.i_twh + 1) + dwh1
+            t1a = col(sol.x, lay.i_tin1) + dt1
+            twh1f, twh1a = twh_rows(pin_w)
             tol = jnp.asarray(1e-3, f32)  # fp32 row-arithmetic slack
+            # Check BOTH the EV and the APPLIED entries of each k=1
+            # temperature: the applied ones are what _finish propagates
+            # (the resolve re-solve enforces all four bounds; the
+            # projection must too — round-5 fix, 0.124 degC excursion).
             in_band = (
                 (t1f >= lo(lay.i_tin + 1) - tol)
                 & (t1f <= hi(lay.i_tin + 1) + tol)
+                & (t1a >= lo(lay.i_tin1) - tol)
+                & (t1a <= hi(lay.i_tin1) + tol)
                 & (twh1f >= lo(lay.i_twh + 1) - tol)
                 & (twh1f <= hi(lay.i_twh + 1) + tol)
+                & (twh1a >= lo(lay.i_twh1) - tol)
+                & (twh1a <= hi(lay.i_twh1) + tol)
             )
             keep = in_band & sol.solved
             repair_failed = jnp.sum(

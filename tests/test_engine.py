@@ -282,3 +282,64 @@ def test_integer_first_action_repair(tmp_path, solver):
     # No solve-rate collapse (repair failures keep the relaxed solution,
     # so the rate cannot drop below solved∩solved homes by much).
     assert rate_rep >= rate_base - 0.05, (rate_rep, rate_base)
+
+
+def test_project_repair_checks_applied_wh_row():
+    """The projection's comfort gate must bound BOTH k=1 WH entries: the
+    EV row (i_twh+1, draw-mixed) and the APPLIED row (i_twh1, unmixed —
+    what _finish propagates).  Round-5 regression: checking only the EV
+    entry let a pinned action push the applied WH temp 0.124 degC out of
+    band at 1000 homes (validate_scale).  Tampers a real relaxed
+    solution so the two rows straddle the band edge and asserts the
+    merged outcome is in-band-or-relaxed on the APPLIED row."""
+    import jax.numpy as jnp
+
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = 4
+    cfg["community"]["homes_pv"] = 0
+    cfg["community"]["homes_battery"] = 0
+    cfg["community"]["homes_pv_battery"] = 0
+    cfg["simulation"]["end_datetime"] = "2015-01-02 00"
+    cfg["home"]["hems"]["prediction_horizon"] = 4
+    assert cfg["tpu"]["integer_repair"] == "project"
+    env = load_environment(cfg)
+    dt = env.dt
+    wd = load_waterdraw_profiles(None, seed=12)
+    homes = create_homes(cfg, 24 * dt, dt, wd)
+    batch = build_home_batch(homes, 4 * dt, dt,
+                             int(cfg["home"]["hems"]["sub_subhourly_steps"]))
+    eng = make_engine(batch, env, cfg, 0)
+    lay = eng.layout
+    state = eng.init_state()
+    qp, _aux = eng._prepare(state, jnp.asarray(0),
+                            jnp.zeros((eng.params.horizon,), jnp.float32))
+    from dragg_tpu.ops.ipm import ipm_solve_qp
+
+    relaxed = ipm_solve_qp(eng.static.pattern, qp.vals, qp.b_eq,
+                           qp.l_box, qp.u_box, qp.q, iters=30)
+    assert bool(np.all(np.asarray(relaxed.solved)))
+    # Tamper: push every home's APPLIED k=1 WH entry to the upper band
+    # edge while the EV entry sits comfortably inside — a pin whose
+    # positive delta is fine for the EV row now violates the applied row.
+    hi_ap = np.asarray(qp.u_box)[:, lay.i_twh1]
+    x = np.asarray(relaxed.x).copy()
+    x[:, lay.i_twh1] = hi_ap - 1e-4
+    x[:, lay.i_twh + 1] = hi_ap - 2.0
+    # Force a +1 WH bump: make the rounded wh count exceed the relaxed.
+    x[:, lay.i_wh] = np.clip(np.floor(x[:, lay.i_wh]) + 0.6, 0,
+                             np.asarray(qp.u_box)[:, lay.i_wh])
+    tampered = relaxed._replace(x=jnp.asarray(x, jnp.float32))
+
+    def no_solver(l2, u2):  # project mode must never call it
+        raise AssertionError("project mode called the solver")
+
+    merged, _rf = eng._integerize_first_action(qp, tampered, no_solver)
+    out_ap = np.asarray(merged.x)[:, lay.i_twh1]
+    # Every home must end in-band on the APPLIED row (within the fp32
+    # gate tolerance) — either via a comfort-safe pin or by keeping the
+    # tampered relaxed value (which was in-band by construction).
+    assert np.all(out_ap <= hi_ap + 2e-3), (out_ap, hi_ap)
