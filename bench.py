@@ -7,20 +7,32 @@ north-star rate of 50 sim-timesteps/s (BASELINE.md: 100k homes over a 4-chip
 v4-8 slice → 25k homes/chip; we report the per-chip rate, so ≥1.0 means the
 single-chip engine is on pace for the pod-slice target).
 
-Robustness (the round-1 run died in TPU backend init with a bare traceback):
+Robustness (the round-1 run died in TPU backend init with a bare traceback;
+since round 6 the survival logic lives in dragg_tpu/resilience):
 
-* the measured run executes in a CHILD process with a hard timeout, so a
-  hanging TPU/backend init can never hang the harness;
+* the measured run executes in a SUPERVISED child process
+  (resilience.supervisor): hard deadline, heartbeat-stall detection on
+  TPU attempts (a child that stops logging progress is killed before its
+  abandoned compile can wedge the tunnel — $BENCH_STALL_TIMEOUT, default
+  900 s, 0 disables; CPU attempts run deadline-only, since a big CPU
+  chunk legitimately computes longer than any beat cadence), and
+  classified failures (taxonomy kinds in ``attempts``);
 * every TPU attempt is gated on a hard-timeout jax-level tunnel probe
-  (a wedged tunnel hangs backend init; the proxy accepting TCP is not
-  liveness — CLAUDE.md), with each verdict appended to $DRAGG_PROBE_LOG;
-* platform ladder: probe → TPU attempt → probe → TPU retry → CPU fallback
-  at the FULL requested config (clearly labelled ``fallback: true`` — so
-  outage-round artifacts still carry a BASELINE-scale number; budget via
-  $BENCH_CPU_TIMEOUT, default 1800 s); every attempt's outcome is recorded
-  in the ``attempts`` diagnostic field;
+  (resilience.liveness; a wedged tunnel hangs backend init; the proxy
+  accepting TCP is not liveness — CLAUDE.md), with each verdict appended
+  to $DRAGG_PROBE_LOG; retries use probe-gated backoff;
+* platform ladder: probe → TPU attempt → backoff+probe → TPU retry
+  (shorter chunks) → CPU fallback at the FULL requested config (clearly
+  labelled ``fallback: true`` — so outage-round artifacts still carry a
+  BASELINE-scale number; budget via $BENCH_CPU_TIMEOUT, default 1800 s);
 * any failure path still emits the one-line JSON (value 0.0 + error info)
   instead of a traceback.
+
+Every line carries a ``data`` field naming the environment it measured
+("bundled" = the shipped first-party assets, "synthetic" = the rounds-2..4
+generators); ``--dual-report`` emits BOTH lines in one invocation so
+round artifacts always cover the shipped default AND the cross-round
+comparison environment (VERDICT r5 weak #3).
 
 Besides the headline rate the JSON carries per-phase timers
 (assemble / solve / merge+collect), the solver iteration count, XLA's FLOP
@@ -36,9 +48,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
-import tempfile
 import time
 
 TARGET_TS_PER_S = 50.0  # BASELINE.md north star
@@ -63,6 +73,12 @@ PEAK_HBM_BW = [
 
 def _log(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+    # Every log line is a progress beat: under supervision
+    # ($DRAGG_HEARTBEAT_FILE exported by resilience.supervisor) the stall
+    # detector reads the beat age; unsupervised it is a no-op.
+    from dragg_tpu.resilience.heartbeat import beat
+
+    beat({"stage": msg[:120]})
 
 
 def build(n_homes: int, horizon_hours: int, admm_iters: int,
@@ -134,7 +150,10 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
 
 
 def run_measured(args) -> dict:
-    """The actual measurement (runs inside the child process)."""
+    """The actual measurement (runs inside the supervised child)."""
+    from dragg_tpu.resilience.faults import fault_hook
+
+    fault_hook("bench_build")
     import jax
 
     if args.platform == "cpu":
@@ -149,7 +168,7 @@ def run_measured(args) -> dict:
     cache_dir = enable_compile_cache()
     _log(f"compile cache: {cache_dir}")
     _log(f"initializing backend (platform={args.platform})...")
-    dev = jax.devices()[0]
+    dev = jax.devices()[0]  # device-call-ok: supervised child
     platform = dev.platform
     device_kind = getattr(dev, "device_kind", platform)
     _log(f"backend up: {platform} / {device_kind}")
@@ -238,6 +257,7 @@ def run_measured(args) -> dict:
     solve_rates = []
     t_cursor = steps
     for c in range(args.chunks):
+        fault_hook("bench_chunk")
         t0 = time.perf_counter()
         state, outs = engine.run_chunk(state, t_cursor, rps)
         jax.block_until_ready(outs.agg_load)
@@ -275,12 +295,25 @@ def run_measured(args) -> dict:
             jax.block_until_ready(out)
             return (time.perf_counter() - t0) / reps
 
-        phases = {
-            "assemble": timeit(prep, state, jt, jrp),
-            "solve_refresh": timeit(solve, state, qp, factor0, refresh),
-            "solve_cached": timeit(solve, state, qp, fcarry, no_refresh),
-            "merge_collect": timeit(fin, state, jt, sol, aux, warm_sol),
-        }
+        if solver_used == "ipm":
+            # The IPM has NO cross-step factor cache (engine._solve: the
+            # refresh flag and factor carry pass through untouched), so
+            # "refresh" and "cached" would time the SAME program and any
+            # delta is noise — exactly what BENCH_r05's 8.79 vs 9.00 was
+            # (VERDICT r5 weak #4; measured ±3% run-to-run,
+            # docs/perf_notes.md round 6).  One honest key instead.
+            phases = {
+                "assemble": timeit(prep, state, jt, jrp),
+                "solve": timeit(solve, state, qp, factor0, refresh),
+                "merge_collect": timeit(fin, state, jt, sol, aux, warm_sol),
+            }
+        else:
+            phases = {
+                "assemble": timeit(prep, state, jt, jrp),
+                "solve_refresh": timeit(solve, state, qp, factor0, refresh),
+                "solve_cached": timeit(solve, state, qp, fcarry, no_refresh),
+                "merge_collect": timeit(fin, state, jt, sol, aux, warm_sol),
+            }
         _log(f"phases (s/step): {phases}")
     except Exception as e:  # profiling must never sink the benchmark
         _log(f"phase profiling failed: {e!r}")
@@ -374,6 +407,21 @@ def run_measured(args) -> dict:
     # fallback is indistinguishable from "pallas didn't help" (VERDICT r2).
     from dragg_tpu.ops import pallas_band
 
+    # Which data environment this rate was measured on ("bundled" = the
+    # shipped first-party assets; "synthetic" = the rounds-2..4
+    # generators; a custom --data-dir reports its path).  Bundled vs
+    # synthetic differ drastically in fallback work per step (solve
+    # 1.0000 vs 0.9263 — docs/perf_notes.md round 5), so a rate without
+    # this field is not comparable to anything (VERDICT r5 weak #3).
+    from dragg_tpu.data import bundled_data_dir
+
+    if args.data_dir == "":
+        data_label = "synthetic"
+    elif args.data_dir is not None:
+        data_label = args.data_dir
+    else:
+        data_label = "bundled" if bundled_data_dir() else "synthetic"
+
     return {
         "metric": f"sim_timesteps_per_s_{args.homes}homes_{args.horizon_hours}h_horizon",
         "value": round(rate, 3),
@@ -390,6 +438,7 @@ def run_measured(args) -> dict:
         # every headline artifact must state which semantics ran).
         "semantics": ("integer" if engine.params.integer_first_action
                       else "relaxation"),
+        "data": data_label,
         "band_kernel": (engine.admm_band_kernel if solver_used == "admm"
                         else engine.band_kernel),
         "pallas_selftest": pallas_band._SELFTEST,
@@ -406,57 +455,28 @@ def run_measured(args) -> dict:
     }
 
 
-def run_child(platform: str, homes: int, steps: int, chunks: int,
-              args, timeout: float) -> tuple[dict | None, dict]:
-    """Run one measured attempt in a subprocess with a hard timeout.
-    Returns (result-or-None, attempt-diagnostic)."""
-    fd, out_path = tempfile.mkstemp(suffix=".json")
-    os.close(fd)
+def child_argv(args, platform: str, attempt: int,
+               data_dir: str | None) -> list[str]:
+    """Child command line for one measured attempt.  TPU retries
+    (attempt > 0) shrink the chunk length: long single device executions
+    are the known axon-runtime failure mode (round 2)."""
+    steps, chunks = args.steps, args.chunks
+    if platform == "tpu" and attempt > 0:
+        steps, chunks = max(2, args.steps // 4), args.chunks * 2
     cmd = [
         sys.executable, os.path.abspath(__file__), "--_child",
-        "--platform", platform, "--homes", str(homes),
+        "--platform", platform, "--homes", str(args.homes),
         "--horizon-hours", str(args.horizon_hours), "--steps", str(steps),
         "--chunks", str(chunks), "--admm-iters", str(args.admm_iters),
         "--solver", args.solver,
         "--semantics", args.semantics,
-        "--out", out_path,
     ]
-    if args.data_dir is not None:
+    if data_dir is not None:
         # "" is meaningful — it forces the synthetic generators (the
         # rounds-2..4 environment); dropping it would silently run the
         # child on the bundled assets (round-5 review finding).
-        cmd += ["--data-dir", args.data_dir]
-    diag = {"platform": platform, "homes": homes, "timeout_s": timeout}
-    t0 = time.perf_counter()
-    try:
-        proc = subprocess.run(cmd, capture_output=True, timeout=timeout, text=True)
-        diag["elapsed_s"] = round(time.perf_counter() - t0, 1)
-        diag["rc"] = proc.returncode
-        stderr_tail = (proc.stderr or "")[-2000:]
-        if proc.returncode == 0 and os.path.getsize(out_path) > 0:
-            with open(out_path) as f:
-                result = json.load(f)
-            diag["ok"] = True
-            return result, diag
-        diag["ok"] = False
-        diag["stderr_tail"] = stderr_tail
-        return None, diag
-    except subprocess.TimeoutExpired as e:
-        diag["ok"] = False
-        diag["elapsed_s"] = round(time.perf_counter() - t0, 1)
-        diag["error"] = f"timeout after {timeout:.0f}s"
-        diag["stderr_tail"] = ((e.stderr.decode() if isinstance(e.stderr, bytes)
-                                else e.stderr) or "")[-2000:]
-        return None, diag
-    except Exception as e:  # pragma: no cover — harness belt-and-braces
-        diag["ok"] = False
-        diag["error"] = repr(e)
-        return None, diag
-    finally:
-        try:
-            os.remove(out_path)
-        except OSError:
-            pass
+        cmd += ["--data-dir", data_dir]
+    return cmd
 
 
 def main() -> None:
@@ -483,10 +503,13 @@ def main() -> None:
     ap.add_argument("--data-dir", default=None,
                     help="directory with nsrdb.csv + waterdraw_profiles.csv "
                          "(real assets; default: synthetic)")
+    ap.add_argument("--dual-report", action="store_true",
+                    help="emit TWO JSON lines: the bundled-data shipped "
+                         "default AND the rounds-2..4 synthetic environment "
+                         "(each labelled by its 'data' field)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny inline CPU run (50 homes, 4h horizon) for verification")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
-    ap.add_argument("--out", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.smoke:
@@ -494,103 +517,73 @@ def main() -> None:
         args.homes, args.horizon_hours = 50, 4
         args.steps, args.chunks, args.admm_iters = 4, 1, 1000
 
-    # Child mode (or inline smoke): do the measurement, write/print JSON.
+    # Child mode (or inline smoke): do the measurement, print JSON.
     if args._child or args.smoke:
         result = run_measured(args)
-        line = json.dumps(result)
-        if args.out:
-            with open(args.out, "w") as f:
-                f.write(line)
-        print(line)
+        print(json.dumps(result))
         return
 
-    # Parent mode: platform ladder with hard timeouts; never tracebacks.
-    #
-    # Tunnel-aware (round-3 verdict, next-3): a jax-level PROBE with a hard
-    # timeout gates every TPU attempt — the axon proxy accepting TCP is not
-    # liveness (CLAUDE.md), and committing blind to a 900 s attempt burned
-    # 22 min of the round-3 driver run against a dead tunnel.  On probe
-    # failure (or a timed-out TPU attempt, which is known to WEDGE the
-    # tunnel for subsequent backend inits — measured round 4,
-    # docs/onchip_r4/bench_10k_24h.json) the ladder skips straight to a
-    # FULL-SIZE CPU run so outage-round driver artifacts still carry a
-    # BASELINE-scale number.  Probe verdicts are appended to
-    # $DRAGG_PROBE_LOG (default docs/probe_log.txt) — the committed outage
-    # record round 3 lacked.
+    # Parent mode: the supervised ladder (dragg_tpu/resilience) — this
+    # process NEVER initializes a jax backend, so a wedged tunnel cannot
+    # hang the harness.  Every TPU attempt is probe-gated with the
+    # classified liveness check (a hung first attempt is known to WEDGE
+    # the tunnel — round 4, docs/onchip_r4/bench_10k_24h.json), retries
+    # back off exponentially behind fresh probes, and the CPU fallback
+    # runs the FULL requested config so outage-round artifacts still
+    # carry a BASELINE-scale number.  Probe verdicts append to
+    # $DRAGG_PROBE_LOG (default docs/probe_log.txt); each attempt's
+    # classified failure (taxonomy kind) lands in ``attempts``.
+    from dragg_tpu.resilience.runner import run_device_job
+    from dragg_tpu.resilience.supervisor import assert_parent_has_no_jax
+
+    assert_parent_has_no_jax()
     t_tpu = float(os.environ.get("BENCH_TPU_TIMEOUT", 900))
     t_cpu = float(os.environ.get("BENCH_CPU_TIMEOUT", 1800))
+    stall = float(os.environ.get("BENCH_STALL_TIMEOUT", 900)) or None
+    probe_log = os.environ.get("DRAGG_PROBE_LOG", "docs/probe_log.txt")
 
-    def tpu_probe() -> bool:
-        # Fully guarded: bench.py's contract is ONE JSON line, rc 0 — a
-        # probe-plumbing failure must degrade to "assume up" (the attempt
-        # itself still runs under a hard timeout), never traceback.
+    if args.dual_report:
+        # (data label override, --data-dir value) per emitted line.  An
+        # explicit --data-dir narrows the dual report to that one env.
+        reports = ([(args.data_dir,)] if args.data_dir is not None
+                   else [(None,), ("",)])
+    else:
+        reports = [(args.data_dir,)]
+
+    for (data_dir,) in reports:
         try:
-            from dragg_tpu.utils.probe import append_probe_log, probe_tpu
-
-            alive, detail = probe_tpu(60.0)
-        except Exception as e:  # pragma: no cover
-            _log(f"probe unavailable ({e!r}); assuming tunnel up")
-            return True
-        try:
-            path = os.environ.get("DRAGG_PROBE_LOG", "docs/probe_log.txt")
-            _log(append_probe_log(path, alive, f"[bench] {detail}"))
-        except Exception:
-            _log(f"probe: {'LIVE' if alive else 'DOWN'} {detail}")
-        return alive
-
-    cpu_full = ("cpu", args.homes, args.steps, args.chunks, t_cpu)
-    ladder = []
-    attempts = []
-    if args.platform in ("auto", "tpu"):
-        if tpu_probe():
-            ladder.append(("tpu", args.homes, args.steps, args.chunks, t_tpu))
-            # Retry with shorter chunks: long single executions are the
-            # known axon-runtime failure mode.  The retry is itself gated
-            # on a fresh probe at attempt time (see loop) — a hung first
-            # attempt usually leaves the tunnel wedged.
-            ladder.append(("tpu", args.homes, max(2, args.steps // 4),
-                           args.chunks * 2, t_tpu / 2))
-        else:
-            _log("tunnel probe failed; skipping TPU attempts")
-            # Record the verdict in the JSON artifact too, not just stderr
-            # — with an explicit --platform tpu the ladder is otherwise
-            # empty and the artifact would not explain why nothing ran
-            # (ADVICE round 4).
-            attempts.append({"platform": "tpu", "skipped": "probe_down"})
-    if args.platform == "cpu":
-        # Explicit CPU request: honor the user's config exactly.
-        ladder.append(cpu_full)
-    elif args.platform == "auto":
-        # Outage fallback at FULL problem size: the 10k×24h day runs in
-        # ~160 s on this CPU host (docs/perf_notes.md), so the reclaimed
-        # TPU-timeout budget more than covers it.
-        ladder.append(cpu_full)
-
-    for platform, homes, steps, chunks, timeout in ladder:
-        if platform == "tpu" and attempts and not attempts[-1].get("ok") \
-                and not tpu_probe():
-            _log("tunnel probe failed after TPU timeout (wedged); "
-                 "skipping retry")
-            attempts.append({"platform": "tpu", "skipped": "probe_down"})
-            continue
-        _log(f"attempt: platform={platform} homes={homes} timeout={timeout:.0f}s")
-        result, diag = run_child(platform, homes, steps, chunks, args, timeout)
-        attempts.append(diag)
+            result, attempts = run_device_job(
+                lambda platform, attempt: child_argv(args, platform, attempt,
+                                                     data_dir),
+                platform=args.platform,
+                tpu_deadline_s=t_tpu, cpu_deadline_s=t_cpu,
+                retries=1,
+                backoff_s=float(os.environ.get("BENCH_RETRY_BACKOFF", 10)),
+                probe_log=probe_log, stall_s=stall, log=_log,
+            )
+        except Exception as e:  # pragma: no cover — harness belt-and-braces
+            # The contract is one JSON line per report, rc 0, whatever
+            # breaks (round-1 regression: a bare traceback and no number).
+            result, attempts = None, [{"error": repr(e)}]
         if result is not None:
-            if platform == "cpu" and args.platform == "auto":
+            if result.get("platform") == "cpu" and args.platform == "auto":
                 result["fallback"] = True
             result["attempts"] = attempts
             print(json.dumps(result))
-            return
-
-    print(json.dumps({
-        "metric": f"sim_timesteps_per_s_{args.homes}homes_{args.horizon_hours}h_horizon",
-        "value": 0.0,
-        "unit": "timesteps/s",
-        "vs_baseline": 0.0,
-        "error": "all benchmark attempts failed",
-        "attempts": attempts,
-    }))
+        else:
+            print(json.dumps({
+                "metric": f"sim_timesteps_per_s_{args.homes}homes_"
+                          f"{args.horizon_hours}h_horizon",
+                "value": 0.0,
+                "unit": "timesteps/s",
+                "vs_baseline": 0.0,
+                # Error-path label is best-effort: the jax-free parent
+                # can't check whether bundled assets exist.
+                "data": ("synthetic" if data_dir == "" else
+                         data_dir if data_dir else "default"),
+                "error": "all benchmark attempts failed",
+                "attempts": attempts,
+            }))
 
 
 if __name__ == "__main__":

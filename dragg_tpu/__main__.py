@@ -23,6 +23,18 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--config", default=None, help="TOML config path (default: $DATA_DIR/$CONFIG_FILE)")
     run.add_argument("--data-dir", default=None, help="directory with nsrdb.csv / waterdraw profiles")
     run.add_argument("--outputs-dir", default="outputs")
+    run.add_argument("--supervised", action="store_true",
+                     help="run the simulation in a supervised child process "
+                          "(hard deadline, heartbeat-stall detection, "
+                          "checkpointed TPU→CPU degradation on device loss; "
+                          "prints one provenance JSON line — "
+                          "dragg_tpu/resilience)")
+    run.add_argument("--platform", choices=["auto", "tpu", "cpu"],
+                     default="auto", help="supervised mode only: which "
+                          "backends the ladder may try")
+    run.add_argument("--deadline", type=float, default=None,
+                     help="supervised mode only: per-attempt hard deadline "
+                          "seconds (default: resilience.deadline_s)")
 
     ref = sub.add_parser("reformat", help="discover finished runs and build comparison figures")
     ref.add_argument("--config", default=None)
@@ -46,6 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
                                         "native runtime, data files, outputs)")
     doc.add_argument("--outputs-dir", default="outputs")
     doc.add_argument("--backend-timeout", type=float, default=60.0)
+    doc.add_argument("--classify", action="store_true",
+                     help="one classified liveness verdict as a JSON line "
+                          "(names the failure: TUNNEL_DOWN / WEDGED) "
+                          "instead of the full check table")
 
     sub.add_parser("bench", help="run the benchmark harness (prints one JSON line)")
 
@@ -120,6 +136,28 @@ def main(argv=None) -> int:
     from dragg_tpu.utils.stderr_filter import install_aot_mismatch_filter
 
     install_aot_mismatch_filter()
+    if args.cmd == "run" and args.supervised:
+        # Supervised mode: THIS process stays jax-free (a wedged tunnel
+        # hangs any backend init — the supervisor must outlive it); all
+        # device work happens in supervised children with deadlines,
+        # heartbeat-stall detection, and checkpointed CPU degradation.
+        import json
+
+        from dragg_tpu.config import load_config
+        from dragg_tpu.resilience.runner import supervised_sim_run
+        from dragg_tpu.resilience.supervisor import assert_parent_has_no_jax
+
+        assert_parent_has_no_jax()
+        config = load_config(args.config)
+        if args.data_dir is not None:
+            os.environ["DATA_DIR"] = args.data_dir
+        provenance = supervised_sim_run(
+            config, args.outputs_dir, platform=args.platform,
+            deadline_s=args.deadline,
+            log=lambda m: print(f"[supervised] {m}", file=sys.stderr,
+                                flush=True))
+        print(json.dumps(provenance))
+        return 0 if provenance["completed"] else 1
     if args.cmd == "run":
         # Multi-host pod slices: every worker runs this same command and the
         # coordinator handshake merges them into ONE JAX program whose
@@ -168,6 +206,10 @@ def main(argv=None) -> int:
         r.main(save=not args.no_save)
         return 0
     if args.cmd == "doctor":
+        if args.classify:
+            from dragg_tpu.doctor import run_classify
+
+            return run_classify(backend_timeout=args.backend_timeout)
         from dragg_tpu.doctor import run_doctor
 
         return run_doctor(outputs_dir=args.outputs_dir,

@@ -738,7 +738,16 @@ class Aggregator:
         state, t = self.try_resume(self.engine.init_state())
         H = self.engine.params.horizon
         chunks = 0
+        # Supervised-run instrumentation (dragg_tpu/resilience): progress
+        # beats let the supervisor's stall detector distinguish a hung
+        # device chunk from a slow one, and the fault site lets chaos
+        # tests kill/hang this child deterministically mid-run.
+        from dragg_tpu.resilience.faults import fault_hook
+        from dragg_tpu.resilience.heartbeat import beat
+
+        beat({"timestep": t})
         while t < self.num_timesteps:
+            fault_hook("sim_chunk")
             n_steps = min(self.checkpoint_interval, self.num_timesteps - t)
             rps = np.zeros((n_steps, H), dtype=np.float32)
             t0 = time.perf_counter()
@@ -753,6 +762,7 @@ class Aggregator:
             self._phase_times["collect"] += time.perf_counter() - t0
             t += n_steps
             chunks += 1
+            beat({"timestep": t})
             if t < self.num_timesteps:
                 self.log.logger.info("Creating a checkpoint file.")
                 self.write_outputs()

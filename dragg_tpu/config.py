@@ -15,7 +15,10 @@ from __future__ import annotations
 
 import copy
 import os
-import tomllib
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: same API from the tomli backport
+    import tomli as tomllib
 from typing import Any
 
 # Required-key schema — parity with dragg/aggregator.py:38-50.  The reference
@@ -183,6 +186,22 @@ _DEFAULT: dict[str, Any] = {
             "batch_size": 32,
             "twin_q": True,
         },
+    },
+    # Supervised device execution (dragg_tpu/resilience — no reference
+    # analog; the reference has no accelerator to lose).
+    "resilience": {
+        "deadline_s": 3600.0,   # hard wall-clock limit per supervised child
+        "stall_s": 900.0,       # kill a child whose heartbeat goes older
+                                # than this (round-4 hung-compile window:
+                                # the 10k engine build stalled 900 s before
+                                # wedging the tunnel); 0 disables
+        "retries": 1,           # TPU attempts after the first failure
+        "backoff_s": 30.0,      # base of probe-gated exponential backoff
+        "probe_timeout_s": 60.0,  # jax-level tunnel probe hard timeout
+        "degrade_to_cpu": True,  # on device loss mid-run, resume the SAME
+                                 # run on CPU from the latest atomic
+                                 # checkpoint (platform transition recorded
+                                 # in the provenance JSON)
     },
     # dragg_tpu-specific knobs (no reference analog).
     "tpu": {

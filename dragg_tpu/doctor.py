@@ -126,6 +126,20 @@ def _check_config() -> tuple[dict, dict | None]:
         return {"status": FAIL, "error": repr(e)}, None
 
 
+def run_classify(backend_timeout: float = 60.0, stream=None) -> int:
+    """``python -m dragg_tpu doctor --classify``: one classified liveness
+    verdict as a JSON line — NAMES the failure (resilience taxonomy:
+    TUNNEL_DOWN / WEDGED / alive) instead of printing raw probe output,
+    so operators and the runbook branch on a word, not a stderr tail.
+    Exit 0 = a TPU backend is up; 1 = it is not (kind says why)."""
+    from dragg_tpu.resilience.liveness import check_liveness
+
+    stream = stream or sys.stdout
+    r = check_liveness(backend_timeout)
+    print(json.dumps(r._asdict()), file=stream)
+    return 0 if r.alive else 1
+
+
 def run_doctor(outputs_dir: str = "outputs", backend_timeout: float = 60.0,
                stream=None) -> int:
     stream = stream or sys.stdout

@@ -522,9 +522,10 @@ def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
                 out = tail_phase(data_l, x, y, s_l, s_u, z_l, z_u)
                 return out[:6] + (out[6][None],)  # per-shard iter count
 
+            from dragg_tpu.utils.compat import shard_map_partial
+
             it_specs = (h,) * 6
-            x, y, s_l, s_u, z_l, z_u, i2s = partial(
-                jax.shard_map, mesh=mesh, check_vma=False)(
+            x, y, s_l, s_u, z_l, z_u, i2s = shard_map_partial(mesh)(
                 wrapped,
                 in_specs=(tuple(h for _ in data),) + it_specs,
                 out_specs=it_specs + (h,),

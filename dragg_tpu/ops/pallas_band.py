@@ -606,13 +606,15 @@ def make_band_ops(plan, band_kernel: str, mesh=None, mesh_axis: str = "homes"):
 
             from jax.sharding import PartitionSpec as P
 
-            shard_map = partial(jax.shard_map, mesh=mesh, check_vma=False)
+            from dragg_tpu.utils.compat import shard_map_partial
+
+            shard_map = shard_map_partial(mesh)
 
             band_s = P(None, None, mesh_axis)   # (m, bw+1, B) — homes last
             vec_s = P(mesh_axis, None)          # (B, m)
-            # check_vma=False: pallas_call outputs carry no varying-mesh-
-            # axes annotation; the maps are per-shard elementwise over
-            # homes, so replication checking has nothing to verify.
+            # Replication check off (compat.shard_map_partial): pallas_call
+            # outputs carry no varying-mesh-axes annotation; the maps are
+            # per-shard elementwise over homes, so it has nothing to verify.
             chol_fn = shard_map(chol_fn, in_specs=(band_s,),
                                 out_specs=band_s)
             _solve = solve_fn

@@ -1,0 +1,50 @@
+"""Supervised device execution — the survival logic five rounds of TPU
+outages taught this repo (CLAUDE.md gotchas; docs/perf_notes.md rounds
+2-5), promoted from bash into one tested layer.
+
+Every long-running entry point routes device work through here:
+
+* :mod:`supervisor` — run any device workload in a CHILD process with a
+  hard deadline, a progress-heartbeat file, and stdout/stderr capture,
+  so a hung compile kills the child instead of wedging the parent (the
+  parent never initializes a jax backend — asserted in tests);
+* :mod:`liveness` — the jax-level tunnel probe + the round-4 wedge
+  signature as a structured, tested API with probe-gated exponential
+  backoff;
+* :mod:`taxonomy` — the failure vocabulary (``TUNNEL_DOWN``, ``WEDGED``,
+  ``COMPILE_HANG``, ``VMEM_OOM``, ``CHILD_CRASH``, ``DEADLINE``) and the
+  classifiers that map child outcomes / probe verdicts onto it;
+* :mod:`runner` — retry ladders and the degradation policy: on device
+  loss mid-run, resume the SAME run on CPU from the latest atomic
+  checkpoint and record the platform transition in the output JSON;
+* :mod:`faults` — deterministic fault injection (``$DRAGG_FAULT_INJECT``)
+  so chaos tests exercise every recovery path on the CPU mesh in CI;
+* :mod:`heartbeat` — the child-side progress beats the supervisor's
+  stall detector reads.
+
+Import rule: nothing in this package imports jax at module level, and
+the parent-side paths (supervisor, liveness, runner, taxonomy, faults)
+never import it at all — probes and workloads run in subprocesses.
+"""
+
+from dragg_tpu.resilience.taxonomy import (  # noqa: F401
+    CHILD_CRASH,
+    COMPILE_HANG,
+    DEADLINE,
+    FAILURE_KINDS,
+    TUNNEL_DOWN,
+    VMEM_OOM,
+    WEDGED,
+    classify_child,
+    classify_liveness,
+)
+from dragg_tpu.resilience.liveness import (  # noqa: F401
+    LivenessReport,
+    backoff_delays,
+    check_liveness,
+)
+from dragg_tpu.resilience.supervisor import (  # noqa: F401
+    SupervisedResult,
+    assert_parent_has_no_jax,
+    run_supervised,
+)

@@ -1,0 +1,165 @@
+"""Deterministic fault injection — stdlib only, zero cost when unarmed.
+
+Chaos tests must exercise every recovery path of the supervised
+execution layer on the 8-device virtual CPU mesh, with no chip and no
+flaky timing: the faults are INJECTED at named sites, armed through one
+environment variable so supervised children inherit them.
+
+``$DRAGG_FAULT_INJECT`` is a comma-separated list of specs:
+
+    <action>@<site>[:<nth>][:once]
+                                 fire at the <nth> hit of <site> (1-based,
+                                 default 1) in THIS process; ``once``
+                                 fires at most once ACROSS processes (a
+                                 marker file under ``$DRAGG_FAULT_STATE``
+                                 records the firing), so "die once, then
+                                 the relaunch succeeds" resume tests need
+                                 no other shared state
+    probe_down[:<n>]             the first <n> liveness checks report
+                                 TUNNEL_DOWN (default 1), then real
+    probe_wedge[:<n>]            ... report the full round-4 WEDGE
+                                 signature (hung probe + proxy http-403 +
+                                 compile helper not listening)
+    probe_live[:<n>]             liveness reports a live TPU — opens the
+                                 probe gate so CPU-only chaos tests can
+                                 drive the TPU-attempt paths.  Bare =
+                                 every check; ``:n`` = only the next <n>
+                                 checks (then the real probe resumes)
+
+Actions for ``fault_hook(site)`` call sites:
+
+    hang        stop beating and sleep past any deadline (the supervisor
+                must kill us — COMPILE_HANG when the stall detector
+                fires first, DEADLINE otherwise)
+    sigkill     SIGKILL our own process (abrupt device-loss analog)
+    vmem_oom    raise RuntimeError with the scoped-VMEM OOM signature
+    exit        sys.exit(17) (plain child failure)
+
+Sites are plain strings; the instrumented code names them
+(``sim_chunk``, ``bench_chunk``, ``bench_build``, ...).  Counters are
+per-process: a spec like ``sigkill@sim_chunk:3`` kills the child at its
+3rd chunk, and the RELAUNCHED child starts counting from zero — which
+is exactly what lets a resume test inject "die once, then succeed"
+without any shared state.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+ENV = "DRAGG_FAULT_INJECT"
+
+_ACTIONS = ("hang", "sigkill", "vmem_oom", "exit")
+
+# The injected scoped-VMEM OOM must trip taxonomy.looks_like_vmem_oom —
+# same wording family as the real axon AOT compiler error (round 4).
+VMEM_OOM_MESSAGE = ("RESOURCE_EXHAUSTED: injected fault: scoped vmem limit "
+                    "exceeded while allocating output (m, B) block")
+
+
+class FaultPlan:
+    """Parsed ``$DRAGG_FAULT_INJECT`` for this process."""
+
+    def __init__(self, spec: str = ""):
+        # (action, site, nth, once)
+        self.site_faults: list[tuple[str, str, int, bool]] = []
+        self.probe_seq: list[str] = []   # "down"/"wedge" prefix, consumed FIFO
+        self.probe_live = False
+        self._hits: dict[str, int] = {}
+        self._probe_calls = 0
+        for raw in (spec or "").split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            if raw.startswith("probe_live") and ":" not in raw:
+                self.probe_live = True
+                continue
+            if raw.startswith(("probe_down", "probe_wedge", "probe_live")):
+                kind = raw.split(":", 1)[0].removeprefix("probe_")
+                n = int(raw.split(":", 1)[1]) if ":" in raw else 1
+                self.probe_seq.extend([kind] * n)
+                continue
+            action, _, rest = raw.partition("@")
+            if action not in _ACTIONS or not rest:
+                raise ValueError(f"bad {ENV} spec {raw!r}")
+            parts = rest.split(":")
+            site = parts[0]
+            once = "once" in parts[1:]
+            nums = [p for p in parts[1:] if p and p != "once"]
+            self.site_faults.append((action, site,
+                                     int(nums[0]) if nums else 1, once))
+
+    @property
+    def armed(self) -> bool:
+        return bool(self.site_faults or self.probe_seq or self.probe_live)
+
+    # ---------------------------------------------------------- site hooks
+    def fire(self, site: str) -> None:
+        """Called by instrumented code at a named site; executes any armed
+        fault whose (site, nth) matches this hit."""
+        hit = self._hits[site] = self._hits.get(site, 0) + 1
+        for action, s, nth, once in self.site_faults:
+            if s != site or nth != hit:
+                continue
+            if once:
+                # Cross-process at-most-once: O_EXCL marker creation is
+                # the atomic claim; written BEFORE acting (sigkill never
+                # returns).
+                marker = os.path.join(
+                    os.environ.get("DRAGG_FAULT_STATE", "/tmp"),
+                    f"dragg_fault_{action}_{s}_{nth}.fired")
+                try:
+                    fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    os.close(fd)
+                except FileExistsError:
+                    continue
+            if action == "hang":
+                # Unbounded from the child's view; the supervisor's stall
+                # detector / deadline is what ends it.
+                while True:
+                    time.sleep(3600)
+            if action == "sigkill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            if action == "vmem_oom":
+                raise RuntimeError(VMEM_OOM_MESSAGE)
+            if action == "exit":
+                sys.exit(17)
+
+    # -------------------------------------------------------- probe faults
+    def probe_override(self) -> str | None:
+        """None = no injection (real probe runs); else "down" | "wedge" |
+        "live" for this liveness check."""
+        self._probe_calls += 1
+        if self._probe_calls <= len(self.probe_seq):
+            return self.probe_seq[self._probe_calls - 1]
+        if self.probe_live:
+            return "live"
+        return None
+
+
+_PLAN: FaultPlan | None = None
+
+
+def active_plan() -> FaultPlan:
+    """The process-wide plan, parsed once from the environment."""
+    global _PLAN
+    if _PLAN is None:
+        _PLAN = FaultPlan(os.environ.get(ENV, ""))
+    return _PLAN
+
+
+def reset_plan() -> None:
+    """Re-read ``$DRAGG_FAULT_INJECT`` on the next hook — for tests that
+    change the spec within one process."""
+    global _PLAN
+    _PLAN = None
+
+
+def fault_hook(site: str) -> None:
+    """Zero-cost no-op unless ``$DRAGG_FAULT_INJECT`` is armed."""
+    plan = active_plan()
+    if plan.armed:
+        plan.fire(site)

@@ -1,0 +1,59 @@
+"""Child-side progress heartbeat — stdlib only.
+
+The supervisor exports ``$DRAGG_HEARTBEAT_FILE`` into every child it
+runs; instrumented child code calls :func:`beat` at real progress
+boundaries (a build stage finished, a scan chunk returned).  The
+supervisor reads the file's age: no beat within ``stall_s`` means the
+child stopped making progress — the round-4 hung-compile signature —
+and it is killed BEFORE the abandoned compile can wedge the tunnel for
+every other process.
+
+Beats are deliberately EXPLICIT, not a background thread: a hung C call
+(the wedge) releases the GIL, so a thread would keep beating through
+exactly the hang this machinery exists to catch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+ENV = "DRAGG_HEARTBEAT_FILE"
+
+
+def heartbeat_path() -> str | None:
+    return os.environ.get(ENV) or None
+
+
+def beat(progress: dict | None = None) -> None:
+    """Record one progress beat (atomic write; no-op when unsupervised).
+    ``progress`` is a small JSON-able payload the supervisor surfaces in
+    its diagnostics (e.g. ``{"timestep": 120}``)."""
+    path = heartbeat_path()
+    if path is None:
+        return
+    payload = {"t": time.time(), **({"progress": progress} if progress else {})}
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError:
+        # A heartbeat must never kill the workload it instruments.
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
+def read(path: str) -> tuple[float | None, dict | None]:
+    """(age_seconds, last progress payload) of a heartbeat file, or
+    (None, None) when it does not exist / is mid-write garbage."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        return max(0.0, time.time() - float(payload["t"])), \
+            payload.get("progress")
+    except (OSError, ValueError, KeyError):
+        return None, None

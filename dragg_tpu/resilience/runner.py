@@ -1,0 +1,268 @@
+"""Retry ladders + the degradation policy — stdlib only, parent never
+imports jax.
+
+Two shapes of supervised execution:
+
+* :func:`run_device_job` — one-shot measurement jobs (bench attempts,
+  runbook stages): probe-gate the TPU attempt, retry with probe-gated
+  exponential backoff, then fall back to a CPU run of the SAME config.
+* :func:`supervised_sim_run` — long simulation runs with checkpoints
+  (``python -m dragg_tpu run --supervised``): the child writes atomic
+  checkpoints at chunk boundaries (dragg_tpu/checkpoint.py); if the
+  child dies mid-run (hang, crash, device loss), the run RESUMES on CPU
+  from the latest checkpoint instead of restarting from t=0, and the
+  platform transition is recorded in the emitted provenance JSON.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+from dragg_tpu.resilience import liveness
+from dragg_tpu.resilience.supervisor import run_supervised
+from dragg_tpu.resilience.taxonomy import TUNNEL_DOWN
+
+
+def cpu_env(base: dict | None = None) -> dict:
+    """Child environment pinned to the CPU backend: a wedged tunnel hangs
+    ANY backend init because the plugin registers at interpreter start
+    via $PALLAS_AXON_POOL_IPS (CLAUDE.md) — so CPU children must both
+    request cpu AND drop the plugin registration."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def resilience_config(config: dict | None) -> dict:
+    """The ``[resilience]`` config section with defaults applied."""
+    from dragg_tpu.config import default_config
+
+    merged = dict(default_config()["resilience"])
+    merged.update((config or {}).get("resilience", {}))
+    return merged
+
+
+def run_device_job(build_argv, *, platform: str = "auto",
+                   tpu_deadline_s: float, cpu_deadline_s: float,
+                   retries: int = 1, backoff_s: float = 30.0,
+                   probe_timeout_s: float = 60.0,
+                   probe_log: str | None = None,
+                   stall_s: float | None = None,
+                   base_env: dict | None = None, cwd: str | None = None,
+                   log=None, sleep=time.sleep):
+    """Probe-gated TPU→CPU ladder for one supervised job.
+
+    ``build_argv(platform, attempt)`` returns the child argv for "tpu" or
+    "cpu"; ``attempt`` counts TPU retries (bench shrinks its chunk length
+    on retry — long single device executions are the known axon-runtime
+    failure mode).  Returns ``(json_result_or_None, attempts)`` where
+    each attempt dict carries the platform, the classified failure, and
+    the supervisor diagnostics — the artifact trail bench.py publishes.
+    """
+    attempts: list[dict] = []
+
+    def _tpu_gate() -> bool:
+        report = liveness.check_liveness(probe_timeout_s, probe_log)
+        if log:
+            log(f"probe: {'LIVE' if report.alive else report.kind} "
+                f"{report.detail}")
+        if not report.alive:
+            attempts.append({"platform": "tpu", "skipped": "probe_down",
+                             "failure": report.kind or TUNNEL_DOWN,
+                             "detail": report.detail})
+        return report.alive
+
+    if platform in ("auto", "tpu") and _tpu_gate():
+        delays = [0.0] + liveness.backoff_delays(retries, backoff_s)
+        for i, delay in enumerate(delays):
+            if delay:
+                sleep(delay)
+                # Probe-gated retry: a timed-out attempt is known to
+                # WEDGE the tunnel for subsequent backend inits
+                # (round 4) — never retry into a dead tunnel.
+                if not _tpu_gate():
+                    break
+            # Retries run at HALF the deadline: the first attempt already
+            # burned the full budget, and callers (the runbook) size
+            # their outer stage timeouts assuming probe + attempt +
+            # retry/2 + CPU fallback fit inside them.
+            res = run_supervised(build_argv("tpu", i),
+                                 tpu_deadline_s / (2 if i else 1),
+                                 label=f"tpu attempt {i}", env=base_env,
+                                 cwd=cwd, stall_s=stall_s, log=log)
+            attempts.append({"platform": "tpu", **res.diagnostic()})
+            if res.ok and res.json is not None:
+                return res.json, attempts
+
+    if platform in ("auto", "cpu"):
+        # No stall detector on the CPU attempt: stall-kill exists to stop
+        # a hung TPU compile from wedging the tunnel; a big CPU run
+        # legitimately computes for longer than any beat cadence (a 10k
+        # admm chunk is ~2000 s between beats) and is already bounded by
+        # its hard deadline.
+        res = run_supervised(build_argv("cpu", 0), cpu_deadline_s,
+                             label="cpu attempt", env=cpu_env(base_env),
+                             cwd=cwd, stall_s=None, log=log)
+        attempts.append({"platform": "cpu", **res.diagnostic()})
+        if res.ok and res.json is not None:
+            return res.json, attempts
+    return None, attempts
+
+
+# ------------------------------------------------------------ sim runs
+
+
+def run_dir_for(config: dict, outputs_dir: str) -> str:
+    """THIS config's run directory, computed jax-free via the same shared
+    name builders the Aggregator uses (aggregator.set_run_dir /
+    utils.layout) — so the parent's checkpoint lookups are scoped to the
+    run it is supervising, never a neighbor run under the same outputs
+    root."""
+    from dragg_tpu.config import configured_solver
+    from dragg_tpu.data import parse_dt
+    from dragg_tpu.utils import date_folder_name, run_dir_name
+
+    sim = config["simulation"]
+    return os.path.join(
+        outputs_dir,
+        date_folder_name(parse_dt(sim["start_datetime"]),
+                         parse_dt(sim["end_datetime"])),
+        run_dir_name(
+            sim["check_type"],
+            config["community"]["total_number_homes"],
+            config["home"]["hems"]["prediction_horizon"],
+            int(config["agg"]["subhourly_steps"]),
+            int(config["home"]["hems"]["sub_subhourly_steps"]),
+            configured_solver(config),
+        ),
+        f"version-{sim.get('named_version', 'test')}",
+    )
+
+
+def latest_checkpoint_timestep(outputs_dir: str) -> int | None:
+    """Newest checkpointed timestep under ``outputs_dir`` — pass a RUN
+    directory (:func:`run_dir_for`), not the whole outputs root, or an
+    unrelated run's checkpoint can masquerade as this run's progress.
+    Read WITHOUT importing the aggregator (parent stays jax-free): the
+    checkpoint layout is ``<case>/checkpoint/LATEST`` → progress.json."""
+    best = None
+    for pointer in glob.glob(os.path.join(outputs_dir, "**", "checkpoint",
+                                          "LATEST"), recursive=True):
+        try:
+            with open(pointer) as f:
+                name = f.read().strip()
+            with open(os.path.join(os.path.dirname(pointer), name,
+                                   "progress.json")) as f:
+                t = int(json.load(f)["timestep"])
+        except (OSError, ValueError, KeyError):
+            continue
+        best = t if best is None else max(best, t)
+    return best
+
+
+def supervised_sim_run(config: dict, outputs_dir: str = "outputs", *,
+                       platform: str = "auto", deadline_s: float | None = None,
+                       base_env: dict | None = None, cwd: str | None = None,
+                       log=None, sleep=time.sleep) -> dict:
+    """Run an Aggregator simulation under supervision with checkpointed
+    degradation: device loss mid-run resumes the SAME run on CPU from
+    the latest atomic checkpoint.
+
+    Returns the provenance dict (also what ``--supervised`` prints as
+    one JSON line): per-attempt diagnostics, the ``platform_transitions``
+    record, and whether the run completed.  The config's ``[resilience]``
+    section supplies deadlines/backoff; ``simulation.resume`` is forced
+    true so relaunches continue instead of restarting.
+    """
+    rcfg = resilience_config(config)
+    deadline = float(deadline_s if deadline_s is not None
+                     else rcfg["deadline_s"])
+    stall = float(rcfg["stall_s"]) or None
+    retries = int(rcfg["retries"])
+    backoff = float(rcfg["backoff_s"])
+    probe_timeout = float(rcfg["probe_timeout_s"])
+    degrade = bool(rcfg["degrade_to_cpu"])
+
+    cfg = json.loads(json.dumps(config))  # deep copy, JSON-able by contract
+    cfg.setdefault("simulation", {})["resume"] = True
+    fd, cfg_path = tempfile.mkstemp(prefix="dragg_simrun_", suffix=".json")
+    with os.fdopen(fd, "w") as f:
+        json.dump(cfg, f)
+
+    def child_argv() -> list[str]:
+        return [sys.executable, "-m", "dragg_tpu.resilience.simchild",
+                "--config", cfg_path, "--outputs-dir", outputs_dir]
+
+    attempts: list[dict] = []
+    transitions: list[dict] = []
+    provenance = {"completed": False, "attempts": attempts,
+                  "platform_transitions": transitions,
+                  "outputs_dir": outputs_dir}
+
+    def attempt(plat: str, env: dict | None) -> bool:
+        # Stall detection only on the TPU attempt (wedge prevention); a
+        # CPU chunk may legitimately compute longer than any beat cadence
+        # and is bounded by the deadline alone.
+        res = run_supervised(child_argv(), deadline, label=f"sim on {plat}",
+                             env=env, cwd=cwd,
+                             stall_s=stall if plat == "tpu" else None,
+                             log=log)
+        attempts.append({"platform": plat, **res.diagnostic()})
+        return res.ok
+
+    try:
+        want_tpu = platform in ("auto", "tpu")
+        ran_tpu = False
+        if want_tpu:
+            report = liveness.wait_for_liveness(
+                retries, backoff, probe_timeout, sleep=sleep)
+            if log:
+                log(f"probe: {'LIVE' if report.alive else report.kind} "
+                    f"{report.detail}")
+            if report.alive:
+                ran_tpu = True
+                if attempt("tpu", base_env):
+                    provenance.update(completed=True, final_platform="tpu")
+                    return provenance
+            else:
+                attempts.append({"platform": "tpu", "skipped": "probe_down",
+                                 "failure": report.kind or TUNNEL_DOWN,
+                                 "detail": report.detail})
+        if platform == "tpu" and not (degrade and ran_tpu):
+            # An explicit TPU-only request either disabled degradation or
+            # never acquired a device at all (probe down) — a CPU run
+            # here would be a CPU artifact masquerading as the requested
+            # TPU measurement.  degrade_to_cpu covers device loss
+            # MID-RUN, not a run that never started (docs/config.md).
+            return provenance
+        # Degradation: resume the SAME run on CPU from the latest atomic
+        # checkpoint (the child forces simulation.resume, so a fresh
+        # start only happens when no checkpoint was ever written).  The
+        # lookup is scoped to THIS config's run directory — a neighbor
+        # run's checkpoint under the same outputs root must not
+        # masquerade as this run's progress.
+        root = (os.path.join(cwd, outputs_dir)
+                if cwd and not os.path.isabs(outputs_dir) else outputs_dir)
+        resume_t = latest_checkpoint_timestep(run_dir_for(cfg, root))
+        if want_tpu:
+            transitions.append({
+                "from": "tpu",
+                "to": "cpu",
+                "resumed_from_timestep": resume_t,
+                "failure": next((a.get("failure") for a in reversed(attempts)
+                                 if a.get("failure")), None),
+            })
+        if attempt("cpu", cpu_env(base_env)):
+            provenance.update(completed=True, final_platform="cpu")
+        return provenance
+    finally:
+        try:
+            os.remove(cfg_path)
+        except OSError:
+            pass

@@ -1,0 +1,36 @@
+"""Supervised simulation child: ``python -m dragg_tpu.resilience.simchild``.
+
+The ONLY process in a supervised sim run that initializes a jax backend.
+Loads the JSON config the parent staged (``runner.supervised_sim_run``),
+runs the Aggregator (which beats the heartbeat and writes atomic
+checkpoints at chunk boundaries), and exits 0 on completion.  A relaunch
+after a mid-run death resumes from the newest checkpoint because the
+parent forces ``simulation.resume`` true.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", required=True, help="JSON config path")
+    ap.add_argument("--outputs-dir", default="outputs")
+    args = ap.parse_args()
+    with open(args.config) as f:
+        config = json.load(f)
+
+    from dragg_tpu.aggregator import Aggregator
+    from dragg_tpu.resilience.heartbeat import beat
+
+    beat({"stage": "aggregator_init"})
+    agg = Aggregator(config=config, outputs_dir=args.outputs_dir)
+    agg.run()
+    beat({"stage": "done", "timestep": agg.timestep})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,237 @@
+"""Subprocess supervisor — stdlib only; the parent NEVER imports jax.
+
+Every device workload this repo runs can hang (a wedged tunnel hangs
+backend init at interpreter start), stall (the 10k engine compile hung
+between build and first step for 900 s, round 4), OOM, or disappear.
+The supervisor runs the workload in a CHILD process with:
+
+* a hard **deadline** — on expiry the child's whole process group gets
+  SIGTERM, then SIGKILL after a grace period, so a hung compile dies in
+  the child instead of wedging the parent;
+* a **heartbeat file** (``$DRAGG_HEARTBEAT_FILE``, written by
+  :mod:`heartbeat` at the child's real progress boundaries) — with
+  ``stall_s`` set, a child that stops beating is killed EARLY, before
+  the abandoned compile can wedge the tunnel for every later process
+  (the round-4 failure chain this layer exists to break);
+* **stdout/stderr capture** to temp files (no pipe-buffer deadlock on
+  chatty children), returned as bounded tails;
+* a classified verdict from :mod:`taxonomy`.
+
+The parent-side guarantee — no jax backend init in this process — is
+what keeps the supervisor itself un-wedgeable; :func:`assert_parent_has_no_jax`
+enforces it and a chaos test proves it end-to-end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import NamedTuple
+
+from dragg_tpu.resilience import heartbeat as hb
+from dragg_tpu.resilience.taxonomy import classify_child
+
+
+class SupervisedResult(NamedTuple):
+    ok: bool
+    rc: int | None           # child return code (negative = killed by signal)
+    timed_out: bool          # hard deadline expired
+    stalled: bool            # heartbeat went stale (killed early)
+    failure: str | None      # taxonomy kind, None on success
+    elapsed_s: float
+    stdout_tail: str
+    stderr_tail: str
+    heartbeat_age_s: float | None  # age at verdict time (None = no file)
+    progress: dict | None    # last progress payload the child beat
+    json: dict | None        # last JSON-parseable stdout line, if any
+
+    def diagnostic(self) -> dict:
+        """Compact attempt record for artifacts (bench ``attempts`` etc.)."""
+        d = {"ok": self.ok, "rc": self.rc, "elapsed_s": round(self.elapsed_s, 1)}
+        if self.failure:
+            d["failure"] = self.failure
+        if self.timed_out:
+            d["timed_out"] = True
+        if self.stalled:
+            d["stalled"] = True
+        if self.heartbeat_age_s is not None:
+            d["heartbeat_age_s"] = round(self.heartbeat_age_s, 1)
+        if self.progress:
+            d["progress"] = self.progress
+        if not self.ok and self.stderr_tail:
+            d["stderr_tail"] = self.stderr_tail[-2000:]
+        return d
+
+
+def assert_parent_has_no_jax() -> None:
+    """The supervising process must never have initialized jax: a wedged
+    tunnel hangs ANY backend init (the plugin registers at interpreter
+    start), and the supervisor is the one component that must stay alive
+    through that.  Raises RuntimeError if jax is already imported."""
+    if "jax" in sys.modules:
+        raise RuntimeError(
+            "supervisor parent has imported jax — a wedged tunnel could hang "
+            "this process; run device work only in supervised children")
+
+
+def _read_tail(path: str, limit: int) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - limit))
+            return f.read().decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
+def _last_json_line(path: str) -> dict | None:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 1_000_000))
+            lines = f.read().decode("utf-8", "replace").splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _kill_group(proc: subprocess.Popen, grace_s: float) -> None:
+    """SIGTERM the child's process group, escalate to SIGKILL.  The group
+    matters: device children spawn their own subprocesses (probes, nested
+    stages) and an orphaned grandchild holding a hung compile is exactly
+    the wedge this layer prevents."""
+    def _signal_group(sig):
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
+
+    _signal_group(signal.SIGTERM)
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            break
+        time.sleep(0.1)
+    if proc.poll() is None:
+        _signal_group(signal.SIGKILL)
+        try:
+            proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def run_supervised(argv: list[str], deadline_s: float, *,
+                   label: str = "", env: dict | None = None,
+                   cwd: str | None = None, stall_s: float | None = None,
+                   poll_s: float = 0.25, grace_s: float = 5.0,
+                   tail_bytes: int = 4000,
+                   stdout_path: str | None = None,
+                   stderr_path: str | None = None,
+                   log=None) -> SupervisedResult:
+    """Run ``argv`` in a supervised child process.
+
+    ``deadline_s`` — hard wall-clock limit; ``stall_s`` — kill earlier if
+    the child's heartbeat file goes older than this (None disables; the
+    file is seeded at launch, so a child that never beats is stalled
+    ``stall_s`` after start).  ``env`` replaces the child environment
+    when given (otherwise inherits); ``$DRAGG_HEARTBEAT_FILE`` is always
+    exported.  ``log`` is an optional ``callable(str)`` for progress
+    lines (the runbook's transcript).  ``stdout_path``/``stderr_path``
+    persist the FULL captures as artifacts (the runbook's per-stage
+    .json/.log files) instead of supervisor-private temp files.
+
+    Entry-point parents (bench.py, the runbook, ``run --supervised``)
+    call :func:`assert_parent_has_no_jax` before supervising — not
+    enforced here, because test processes legitimately drive the
+    supervisor with jax already imported for OTHER purposes.
+    """
+    child_env = dict(os.environ if env is None else env)
+    hb_fd, hb_path = tempfile.mkstemp(prefix="dragg_hb_")
+    os.close(hb_fd)
+    child_env[hb.ENV] = hb_path
+    out_f = (open(stdout_path, "wb") if stdout_path else
+             tempfile.NamedTemporaryFile(prefix="dragg_sup_out_", delete=False))
+    err_f = (open(stderr_path, "wb") if stderr_path else
+             tempfile.NamedTemporaryFile(prefix="dragg_sup_err_", delete=False))
+    t0 = time.monotonic()
+    # Seed the heartbeat at launch so stall time is measured from start.
+    with open(hb_path, "w") as f:
+        json.dump({"t": time.time()}, f)
+    timed_out = stalled = False
+    try:
+        proc = subprocess.Popen(argv, env=child_env, cwd=cwd,
+                                stdout=out_f, stderr=err_f,
+                                start_new_session=True)
+        if log:
+            log(f">>> {label or argv[0]} pid={proc.pid} "
+                f"deadline={deadline_s:.0f}s"
+                + (f" stall={stall_s:.0f}s" if stall_s else ""))
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            elapsed = time.monotonic() - t0
+            if elapsed >= deadline_s:
+                timed_out = True
+                # The deadline verdict (COMPILE_HANG vs DEADLINE) hinges
+                # on whether the child was still making progress when the
+                # limit landed.
+                age, _ = hb.read(hb_path)
+                stalled = (stall_s is not None and age is not None
+                           and age > stall_s)
+                _kill_group(proc, grace_s)
+                break
+            if stall_s is not None:
+                age, _ = hb.read(hb_path)
+                if age is not None and age > stall_s:
+                    stalled = True
+                    _kill_group(proc, grace_s)
+                    break
+            time.sleep(poll_s)
+        rc = proc.poll()
+    finally:
+        out_f.close()
+        err_f.close()
+    elapsed = time.monotonic() - t0
+    age, progress = hb.read(hb_path)
+    stderr_tail = _read_tail(err_f.name, tail_bytes)
+    failure = classify_child(rc, timed_out, stalled, stderr_tail)
+    result = SupervisedResult(
+        ok=failure is None,
+        rc=rc, timed_out=timed_out, stalled=stalled, failure=failure,
+        elapsed_s=elapsed,
+        stdout_tail=_read_tail(out_f.name, tail_bytes),
+        stderr_tail=stderr_tail,
+        heartbeat_age_s=age, progress=progress,
+        json=_last_json_line(out_f.name),
+    )
+    keep = {stdout_path, stderr_path}
+    for p in (hb_path, out_f.name, err_f.name):
+        if p in keep:
+            continue
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    if log:
+        log(f"<<< {label or argv[0]} rc={rc} "
+            f"{'ok' if result.ok else result.failure} "
+            f"({elapsed:.1f}s)")
+    return result

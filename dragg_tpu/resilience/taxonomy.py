@@ -1,0 +1,100 @@
+"""The failure taxonomy and its classifiers — stdlib only, no jax.
+
+Five rounds of outage forensics (CLAUDE.md "Environment gotchas",
+docs/perf_notes.md rounds 2-5) produced a small, stable vocabulary of
+ways device work dies here.  This module pins that vocabulary and the
+rules that map raw observations (child exit status, heartbeat age,
+stderr text, probe verdicts) onto it, so every entry point names
+failures the same way and chaos tests can assert on the names.
+
+Kinds
+-----
+``TUNNEL_DOWN``   the axon tunnel is unreachable: the jax-level probe
+                  fails fast or hangs WITHOUT the wedge signature, or
+                  the default backend resolves to CPU when a TPU was
+                  requested (rounds 2-5: the tunnel flaps for hours).
+``WEDGED``        the round-4 wedge signature: the jax probe hangs
+                  while the local proxy still answers plain HTTP
+                  (403 in ~20 ms) and the remote-compile helper port
+                  (8093) stops listening.  A hung big compile causes
+                  this; every later backend init then hangs too.
+``COMPILE_HANG``  a supervised child stopped making progress (stale
+                  heartbeat) and had to be killed — the round-4
+                  10k-engine-build failure mode, caught before it can
+                  wedge the tunnel for other processes.
+``VMEM_OOM``      the child died with the scoped-VMEM OOM signature
+                  (m=149 Pallas kernels at LANE_BLOCK=512 on the axon
+                  AOT compiler — CLAUDE.md).
+``CHILD_CRASH``   the child died abnormally for any other reason
+                  (signal, nonzero exit) — including a SIGKILL'd or
+                  OOM-killed process.
+``DEADLINE``      the child was still beating its heartbeat but ran
+                  past its hard deadline — slow, not stuck.
+"""
+
+from __future__ import annotations
+
+import re
+
+TUNNEL_DOWN = "TUNNEL_DOWN"
+WEDGED = "WEDGED"
+COMPILE_HANG = "COMPILE_HANG"
+VMEM_OOM = "VMEM_OOM"
+CHILD_CRASH = "CHILD_CRASH"
+DEADLINE = "DEADLINE"
+
+FAILURE_KINDS = (TUNNEL_DOWN, WEDGED, COMPILE_HANG, VMEM_OOM, CHILD_CRASH,
+                 DEADLINE)
+
+# The scoped-VMEM OOM as the axon AOT compiler reports it (round-4 logs:
+# RESOURCE_EXHAUSTED with a scoped-vmem allocation trace; the full (m, B)
+# output appears in the scoped budget — CLAUDE.md).  Matched on stderr
+# tails, case-insensitive; fault injection raises the same signature.
+_VMEM_OOM_RE = re.compile(
+    r"(?i)(scoped\s*vmem|vmem\s*(limit|budget|capacity)|"
+    r"resource_exhausted[^\n]*vmem|vmem[^\n]*exceed)")
+
+
+def looks_like_vmem_oom(text: str | None) -> bool:
+    return bool(text) and _VMEM_OOM_RE.search(text) is not None
+
+
+def classify_child(rc: int | None, timed_out: bool, stalled: bool,
+                   stderr_tail: str | None = "") -> str | None:
+    """Name the failure of one supervised child, or None on success.
+
+    ``stalled`` — the supervisor killed the child because its heartbeat
+    went stale (no progress beat within ``stall_s``); with ``timed_out``
+    it distinguishes a hang (COMPILE_HANG — no progress) from honest
+    slowness (DEADLINE — still beating when the deadline landed).
+    """
+    if rc == 0 and not timed_out and not stalled:
+        return None
+    if stalled:
+        return COMPILE_HANG
+    if timed_out:
+        return DEADLINE
+    if looks_like_vmem_oom(stderr_tail):
+        return VMEM_OOM
+    return CHILD_CRASH
+
+
+def classify_liveness(probe_ok: bool, backend: str | None, probe_hung: bool,
+                      proxy: str | None, compile_helper: str | None
+                      ) -> str | None:
+    """Name the tunnel state from one probe + wedge-signature read, or
+    None when a TPU backend is actually up.
+
+    The wedge signature (round 4, CLAUDE.md): the jax probe HANGS while
+    the proxy answers plain HTTP (``http-403``/any ``http-*``) and the
+    compile-helper port is not listening.  A hung probe without that
+    corroboration is an ordinary outage — the signature upgrades it to
+    WEDGED, which operators treat differently (restart the tunnel; do
+    not retry compiles into it).
+    """
+    if probe_ok and backend == "tpu":
+        return None
+    if probe_hung and proxy is not None and proxy.startswith("http-") \
+            and compile_helper == "no-listen":
+        return WEDGED
+    return TUNNEL_DOWN

@@ -1,0 +1,27 @@
+"""Cross-version jax compatibility shims — ONE home, so version drift
+shows up here instead of in six call sites.
+
+The repo targets current jax (``jax.shard_map``); the baked toolchain in
+some build images ships pre-0.5 jax where the same primitive lives at
+``jax.experimental.shard_map.shard_map`` with ``check_rep`` instead of
+``check_vma``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+
+def shard_map_partial(mesh):
+    """``partial(shard_map, mesh=mesh, <replication check off>)`` under
+    whichever spelling this jax provides.  Replication checking is off in
+    every repo use: pallas_call outputs carry no varying-mesh-axes
+    annotation and the wrapped maps are per-shard elementwise over homes,
+    so the check has nothing to verify."""
+    if hasattr(jax, "shard_map"):
+        return partial(jax.shard_map, mesh=mesh, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return partial(shard_map, mesh=mesh, check_rep=False)

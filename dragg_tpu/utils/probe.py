@@ -49,6 +49,12 @@ def probe_backend(timeout_s: float = 60.0) -> dict:
                 "timeout": True,
                 "error": f"backend init hung >{timeout_s:.0f}s (wedged "
                          "accelerator tunnel? try JAX_PLATFORMS=cpu)"}
+    except Exception as e:
+        # Probe PLUMBING failure (fork OSError, rc-0 child with garbled
+        # stdout, ...): callers guarantee one-JSON-line contracts
+        # (bench.py) — a broken probe must classify, never traceback.
+        return {"ok": False, "elapsed_s": round(time.monotonic() - t0, 1),
+                "timeout": False, "error": f"probe plumbing failed: {e!r}"}
 
 
 def _wedge_signature() -> str:
@@ -56,32 +62,13 @@ def _wedge_signature() -> str:
     round-4 wedge signature: proxy answers 403 in ms while the remote-
     compile helper port stops listening — CLAUDE.md; round 3 separately
     saw the proxy ACCEPT and then hang, which gets its own "hang" label).
-    Diagnostic color only; the jax probe stays authoritative."""
-    import socket
-    import urllib.error
-    import urllib.request
+    Diagnostic color only; the jax probe stays authoritative.  The peek
+    itself lives in dragg_tpu/resilience/liveness.py (the structured,
+    classified API) — this keeps the legacy one-line format."""
+    from dragg_tpu.resilience.liveness import read_wedge_signature
 
-    # Direct connection: urlopen honors $http_proxy by default, which in
-    # a tunneled environment would peek at the WRONG endpoint.
-    opener = urllib.request.build_opener(
-        urllib.request.ProxyHandler({}))
-
-    def peek(port: int) -> str:
-        try:
-            opener.open(f"http://127.0.0.1:{port}/", timeout=1.5)
-            return "http-ok"
-        except urllib.error.HTTPError as e:
-            return f"http-{e.code}"
-        except (TimeoutError, socket.timeout):
-            return "hang"  # accepted the connection, never answered
-        except urllib.error.URLError as e:
-            if isinstance(e.reason, (TimeoutError, socket.timeout)):
-                return "hang"
-            return "no-listen"
-        except Exception:
-            return "no-listen"
-
-    return f"[proxy:{peek(48271)} compile:{peek(8093)}]"
+    proxy, helper = read_wedge_signature()
+    return f"[proxy:{proxy} compile:{helper}]"
 
 
 def probe_tpu(timeout_s: float = 60.0) -> tuple[bool, str]:

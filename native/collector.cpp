@@ -64,9 +64,17 @@ void write_double(std::string &out, double v) {
         out.append(v < 0 ? "-Infinity" : "Infinity");
         return;
     }
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
     char buf[32];
     auto res = std::to_chars(buf, buf + sizeof buf, v);
     out.append(buf, res.ptr);
+#else
+    // libstdc++ < 11 (e.g. GCC 10 build images) has no floating-point
+    // to_chars; %.17g round-trips every double, at slightly longer output.
+    char buf[32];
+    int n = std::snprintf(buf, sizeof buf, "%.17g", v);
+    out.append(buf, n > 0 ? static_cast<size_t>(n) : 0);
+#endif
 }
 
 }  // namespace

@@ -13,11 +13,21 @@ import os
 # platform before conftest runs, so JAX_PLATFORMS / XLA_FLAGS set here are
 # too late — the config API still works until a backend is initialized.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Pre-0.5 jax has no jax_num_cpu_devices config; the XLA flag is the
+# same mesh.  Set BEFORE the import — in images whose sitecustomize
+# already imported jax this is too late and the config call below takes
+# over instead.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # pre-0.5 jax: the XLA_FLAGS route above applies
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,55 @@
+"""The offline lint floor runs from the suite (round 6), so the
+device-call discipline in entry points — no bare jax.devices(), no
+un-deadlined subprocess calls in tools/ or bench.py — is CI-enforced,
+not advisory."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "dragg_lint", os.path.join(ROOT, "tools", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_passes_lint():
+    proc = subprocess.run([sys.executable, os.path.join(ROOT, "tools", "lint.py")],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_device_discipline_flags_bare_calls(tmp_path):
+    lint = _load_lint()
+    bad = tmp_path / "bad_tool.py"
+    bad.write_text(
+        "import subprocess\n"
+        "import jax\n"
+        "d = jax.devices()\n"
+        "ok = jax.devices()  # device-call-ok: supervised child\n"
+        "subprocess.run(['true'])\n"
+        "subprocess.run(['true'], timeout=5)\n"
+    )
+    # The rule is scoped to entry points (tools/ + bench.py); call the
+    # checker directly so the fixture file need not live in the repo.
+    import ast
+
+    src = bad.read_text()
+    problems = lint.check_device_discipline(
+        ast.parse(src), src.splitlines(), "tools/bad_tool.py")
+    assert len(problems) == 2
+    assert any("jax.devices" in p and ":3:" in p for p in problems)
+    assert any("subprocess.run" in p and ":5:" in p for p in problems)
+
+
+def test_device_discipline_scoping():
+    lint = _load_lint()
+    assert lint._is_entry_point(os.path.join(ROOT, "bench.py"))
+    assert lint._is_entry_point(os.path.join(ROOT, "tools", "x.py"))
+    assert not lint._is_entry_point(os.path.join(ROOT, "dragg_tpu", "engine.py"))

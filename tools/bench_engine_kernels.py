@@ -38,7 +38,7 @@ def main():
     from dragg_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
-    dev = jax.devices()[0]
+    dev = jax.devices()[0]  # device-call-ok: runs under the runbook supervisor deadline
     res = {
         "tool": "bench_engine_kernels",
         "platform": dev.platform,

@@ -7,7 +7,15 @@ what the stdlib can check:
 
 * every Python file parses (`check-ast` parity);
 * no unused imports (autoflake parity; `# noqa` opt-out honored);
-* no tabs in indentation, no trailing whitespace, newline at EOF.
+* no tabs in indentation, no trailing whitespace, newline at EOF;
+* device-call discipline in `tools/` and `bench.py` (round 6): no bare
+  ``jax.devices()``/``jax.default_backend()``/``jax.local_devices()`` —
+  a wedged tunnel hangs backend init, so device calls in entry points
+  must run inside a supervised/probed child (dragg_tpu/resilience);
+  lines that legitimately run in a supervised child carry a
+  ``# device-call-ok: <why>`` marker — and no un-deadlined
+  ``subprocess.run/check_output/check_call/call`` (a child that can
+  hang forever defeats the supervision; pass ``timeout=``).
 
 The full flake8/autoflake hooks run via .pre-commit-config.yaml and CI
 where those tools are installable; this script is the offline floor and
@@ -57,6 +65,45 @@ class ImportUsage(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# Entry-point files where every device touch must be supervised or
+# probed: tools/ CLIs and the bench harness (CLAUDE.md gotcha — never
+# bare jax.devices()).
+_DEVICE_CALLS = {"devices", "local_devices", "default_backend"}
+_SUBPROCESS_FNS = {"run", "check_output", "check_call", "call"}
+_DEVICE_MARKER = "# device-call-ok:"
+
+
+def _is_entry_point(path: str) -> bool:
+    rel = os.path.relpath(path, ROOT)
+    return rel == "bench.py" or rel.startswith("tools" + os.sep)
+
+
+def check_device_discipline(tree, lines: list[str], rel: str) -> list[str]:
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if fn.value.id == "jax" and fn.attr in _DEVICE_CALLS:
+            if _DEVICE_MARKER not in line:
+                problems.append(
+                    f"{rel}:{node.lineno}: bare jax.{fn.attr}() in an entry "
+                    f"point — probe/supervise it (dragg_tpu/resilience), or "
+                    f"mark the line '{_DEVICE_MARKER} <why>' if it runs in a "
+                    f"supervised child")
+        if fn.value.id == "subprocess" and fn.attr in _SUBPROCESS_FNS:
+            if not any(kw.arg == "timeout" for kw in node.keywords):
+                problems.append(
+                    f"{rel}:{node.lineno}: subprocess.{fn.attr}() without "
+                    f"timeout= in an entry point — an un-deadlined child can "
+                    f"hang forever (use resilience.supervisor or pass a "
+                    f"timeout)")
+    return problems
+
+
 def check_file(path: str) -> list[str]:
     problems = []
     rel = os.path.relpath(path, ROOT)
@@ -88,6 +135,8 @@ def check_file(path: str) -> list[str]:
         if f'"{name}"' in src or f"'{name}'" in src:  # __all__ / getattr use
             continue
         problems.append(f"{rel}:{lineno}: unused import '{name}'")
+    if _is_entry_point(path):
+        problems.extend(check_device_discipline(tree, lines, rel))
     return problems
 
 

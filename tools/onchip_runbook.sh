@@ -1,124 +1,14 @@
 #!/bin/bash
 # On-chip measurement runbook — run the moment the TPU tunnel is alive.
-# Round-5 revision (VERDICT r4 next-1/2/3):
-#   * HANG BISECTION FIRST: the 10k engine compile has never completed on
-#     the axon backend and the abandoned attempt wedges the tunnel —
-#     bisect it per-stage (trace/lower/compile/execute, own subprocesses,
-#     own timeouts) at 1k then 10k BEFORE anything else; a completed 10k
-#     diagnose also warms the compile cache for the later bench;
-#   * auto VMEM policy validation: the 48h (m=149) microbench runs with
-#     NO env overrides — the round-5 _auto_blocks policy must pick a
-#     fitting block — plus one explicit LANE_BLOCK=512 run that is
-#     EXPECTED to scoped-VMEM OOM (confirms the hypothesis, bounded);
-#   * semantics A/B at 10k: default (integer repair, the shipped story)
-#     AND relaxation (comparable with rounds 2-4 numbers);
-#   * probe BETWEEN steps (a wedge aborts instead of burning timeouts);
-#     staged sizes; per-step outer timeouts sized to fit internal ladders.
-# Output: docs/onchip_r*/ *.json|log.
+#
+# Round 6: the stage logic moved from bash into the supervised Python
+# API (tools/runbook.py over dragg_tpu/resilience): per-stage hard
+# deadlines + heartbeat-stall detection + process-group kill, classified
+# probe gates between stages (a wedge aborts the pass and NAMES itself),
+# and a jax-free parent that cannot be wedged.  This wrapper only
+# preserves the historical entry point.
 #
 #   bash tools/onchip_runbook.sh [outdir]
 set -u
 cd "$(dirname "$0")/.."
-OUT=${1:-docs/onchip_r5}
-mkdir -p "$OUT"
-export DRAGG_PROBE_LOG="$OUT/probe_log.txt"
-stamp() { date +%H:%M:%S; }
-probe() { # probe <label> — returns 1 (and logs) when the tunnel is down
-  python tools/tpu_probe.py --log "$DRAGG_PROBE_LOG" >/dev/null 2>&1
-  local rc=$?
-  echo "[$(stamp)] probe($1) rc=$rc" | tee -a "$OUT/runbook.log"
-  return $rc
-}
-run() { # run <name> <timeout_s> <cmd...>
-  local name=$1 t=$2; shift 2
-  echo "[$(stamp)] >>> $name ($*)" | tee -a "$OUT/runbook.log"
-  timeout "$t" "$@" >"$OUT/$name.json" 2>"$OUT/$name.log"
-  local rc=$?
-  echo "[$(stamp)] <<< $name rc=$rc" | tee -a "$OUT/runbook.log"
-  tail -c 2000 "$OUT/$name.json" >> "$OUT/runbook.log" || true
-  return $rc
-}
-
-# 0. Is the chip actually reachable? (hard timeout; a wedged tunnel hangs)
-probe start || { echo "TPU unreachable; aborting" | tee -a "$OUT/runbook.log"; exit 1; }
-
-# 1. THE HANG BISECTION — first, while the window is freshest (VERDICT
-#    r4 next-1).  1k localizes scale-dependence cheaply; 10k is the
-#    shape that has never compiled.  420 s/stage: a legitimately-slow
-#    remote AOT compile must not be misdiagnosed as hung.  Each stage is
-#    its own subprocess, so a hang here cannot wedge THIS process — and
-#    the per-stage verdict JSON is the committed artifact either way.
-run diagnose_1k 1200 python tools/diagnose_tpu_hang.py \
-  --homes 1000 --horizon 24 --timeout 180
-probe after_diag1k || exit 1
-run diagnose_10k 3600 python tools/diagnose_tpu_hang.py \
-  --homes 10000 --horizon 24 --timeout 420
-probe after_diag10k || {
-  echo "[$(stamp)] tunnel wedged by 10k diagnose — bracketing at 2.5k/5k next window" \
-    | tee -a "$OUT/runbook.log"; exit 1; }
-if ! grep -q '"all_ok": true' "$OUT/diagnose_10k.json" 2>/dev/null; then
-  # Bracket the failing scale while the tunnel still answers.
-  run diagnose_2k5 1800 python tools/diagnose_tpu_hang.py \
-    --homes 2500 --horizon 24 --timeout 300
-  probe after_diag2k5 || exit 1
-  run diagnose_5k 2400 python tools/diagnose_tpu_hang.py \
-    --homes 5000 --horizon 24 --timeout 420
-  probe after_diag5k || exit 1
-fi
-
-# 2. Band-kernel microbench (failure-isolated per timing).  The 48h
-#    (m=149) run uses NO env overrides — validates the round-5 scoped-
-#    VMEM auto policy end-to-end (auto should pick lane 256 + B-chunks).
-run band_kernel_24h 600 python tools/bench_band_kernel.py --homes 10000 --horizon 24
-probe after_micro24 || exit 1
-run band_kernel_48h_auto 600 python tools/bench_band_kernel.py --homes 25000 --horizon 48
-probe after_micro48 || exit 1
-#    Hypothesis check (bounded, EXPECTED to scoped-VMEM OOM at m=149).
-#    BCHUNK=0 pins chunking OFF — the round-4 OOM config; with it unset
-#    the auto policy would B-chunk and the control could pass for the
-#    wrong reason (round-5 review finding).
-run band_kernel_48h_lb512_expect_oom 300 env DRAGG_LANE_BLOCK=512 DRAGG_PALLAS_BCHUNK=0 \
-  python tools/bench_band_kernel.py --homes 25000 --horizon 48
-probe after_micro48b || exit 1
-
-# 3. STAGED engine benches: 1k first.  bench.py probe-gates its TPU
-#    attempts and falls back to a full-size CPU run; internal ladder
-#    budget (probe 60 + BENCH_TPU_TIMEOUT + probe + retry/2 + CPU
-#    fallback) must FIT the outer timeout.
-run bench_1k_24h 900 env BENCH_TPU_TIMEOUT=300 BENCH_CPU_TIMEOUT=300 \
-  python bench.py --homes 1000 --horizon-hours 24 --solver ipm
-probe after_1k || exit 1
-
-# 4. Engine-level band-kernel A/B at 1k (cheap): decides the auto kernel
-#    policy with an end-to-end verdict (microbench said pallas-chol but
-#    xla-solve, round 4).
-run band_ab_1k 900 python tools/bench_engine_kernels.py --homes 1000 --horizon-hours 24
-probe after_ab || exit 1
-
-# 5. Headline bench, BASELINE row-3 config (10k x 24h), SHIPPED semantics
-#    (integer repair — the artifact the driver records).
-#    Internal budget: 60 + 600 + 60 + 300 + 600 = 1620 < 1800.
-run bench_10k_24h 1800 env BENCH_TPU_TIMEOUT=600 BENCH_CPU_TIMEOUT=600 \
-  python bench.py --homes 10000 --horizon-hours 24 --solver ipm
-probe after_10k || exit 1
-#    Relaxation A/B — the semantics rounds 2-4 measured (6.29 ts/s r2).
-#    --data-dir "" pins the SYNTHETIC weather those rounds ran (bundled
-#    vs synthetic differ drastically in fallback work per step — solve
-#    1.0000 vs 0.9263, perf notes round 5 — so comparability needs both
-#    knobs pinned):
-run bench_10k_24h_relaxation 1800 env BENCH_TPU_TIMEOUT=600 BENCH_CPU_TIMEOUT=600 \
-  python bench.py --homes 10000 --horizon-hours 24 --solver ipm \
-  --semantics relaxation --data-dir ""
-probe after_10k_rel || exit 1
-
-# 6. The row-5 per-chip slice: 25k homes x 48h, auto VMEM policy (no env
-#    overrides).  Internal: 60+600+60+300+1200 = 2220.
-run bench_25k_48h 2400 env BENCH_TPU_TIMEOUT=600 BENCH_CPU_TIMEOUT=1200 \
-  python bench.py --homes 25000 --horizon-hours 48 --steps 8 --solver ipm
-probe after_25k || exit 1
-
-# 7. Scale validation at 10k x 48h x 2 days (solve rate + comfort).
-run validate_10k_48h 2400 python tools/validate_scale.py \
-  --homes 10000 --horizon-hours 48 --days 2 --solver ipm
-
-echo "[$(stamp)] runbook complete — record results in docs/perf_notes.md" | tee -a "$OUT/runbook.log"
+exec python tools/runbook.py --out "${1:-docs/onchip_r6}"

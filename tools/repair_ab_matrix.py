@@ -1,0 +1,113 @@
+"""Pin the integer-repair A/B matrix as a committed JSON artifact
+(VERDICT r5 weak #5: the 512-home matrix existed only as a perf_notes
+narrative).
+
+Runs the full combination matrix — solver {admm, ipm} × repair
+{off, project, resolve} — on the SAME 512-home mixed community over one
+simulated day, recording per-combo: solve rate, max comfort-band
+violation on solved steps, community cost, and mean solver iterations.
+The committed artifact (docs/repair_ab_512_r6.json) is what the
+closed-loop MILP test's claims cite.
+
+Usage: python tools/repair_ab_matrix.py [--homes 512] [--horizon-hours 6]
+           [--steps 24] [--out docs/repair_ab_512_r6.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_combo(n, horizon_h, steps, solver, repair):
+    import jax
+    import numpy as np
+
+    from dragg_tpu.config import default_config
+    from dragg_tpu.data import (load_environment, load_waterdraw_profiles,
+                                waterdraw_path)
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+    from dragg_tpu.resilience.heartbeat import beat
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = n
+    cfg["community"]["homes_pv"] = int(0.4 * n)
+    cfg["community"]["homes_battery"] = int(0.1 * n)
+    cfg["community"]["homes_pv_battery"] = int(0.1 * n)
+    cfg["home"]["hems"]["prediction_horizon"] = horizon_h
+    cfg["home"]["hems"]["solver"] = solver
+    cfg["tpu"]["integer_first_action"] = repair != "off"
+    if repair != "off":
+        cfg["tpu"]["integer_repair"] = repair
+
+    env = load_environment(cfg)
+    dt = int(cfg["agg"]["subhourly_steps"])
+    wd = load_waterdraw_profiles(waterdraw_path(cfg, None), seed=12)
+    homes = create_homes(cfg, steps, dt, wd)
+    batch = build_home_batch(homes, horizon_h * dt, dt,
+                             int(cfg["home"]["hems"]["sub_subhourly_steps"]))
+    eng = make_engine(batch, env, cfg, 0)
+    state = eng.init_state()
+    rps = np.zeros((steps, eng.params.horizon), dtype=np.float32)
+    t0 = time.perf_counter()
+    state, outs = eng.run_chunk(state, 0, rps)
+    jax.block_until_ready(outs.agg_load)
+    wall = time.perf_counter() - t0
+
+    solved = np.asarray(outs.correct_solve)
+    tin = np.asarray(outs.temp_in)
+    twh = np.asarray(outs.temp_wh)
+    vi = np.where(solved > 0,
+                  np.maximum(np.asarray(batch.temp_in_min)[None] - tin,
+                             tin - np.asarray(batch.temp_in_max)[None]), -1.0)
+    vw = np.where(solved > 0,
+                  np.maximum(np.asarray(batch.temp_wh_min)[None] - twh,
+                             twh - np.asarray(batch.temp_wh_max)[None]), -1.0)
+    beat({"combo": f"{solver}/{repair}"})
+    return {
+        "solver": solver,
+        "repair": repair,
+        "solve_rate": round(float(solved.mean()), 4),
+        "comfort_violation_max": round(max(float(vi.max()), float(vw.max())), 5),
+        "community_cost": round(float(np.asarray(outs.cost).sum()), 4),
+        "mean_solver_iters": round(float(np.mean(np.asarray(outs.admm_iters))), 1),
+        "repair_failed_total": int(np.asarray(outs.repair_failed).sum()),
+        "wall_s": round(wall, 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--homes", type=int, default=512)
+    ap.add_argument("--horizon-hours", type=int, default=6)
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--out", default=None,
+                    help="also write the artifact JSON here")
+    args = ap.parse_args()
+
+    result = {
+        "tool": "repair_ab_matrix",
+        "homes": args.homes,
+        "horizon_hours": args.horizon_hours,
+        "steps": args.steps,
+        "combos": [],
+    }
+    for solver in ("admm", "ipm"):
+        for repair in ("off", "project", "resolve"):
+            row = run_combo(args.homes, args.horizon_hours, args.steps,
+                            solver, repair)
+            print(f"[{solver}/{repair}] {row}", file=sys.stderr, flush=True)
+            result["combos"].append(row)
+    line = json.dumps(result)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+
+
+if __name__ == "__main__":
+    main()

@@ -8,33 +8,43 @@ outage/uptime record the round-3 verdict said was missing (weak #5:
 Usage:
   python tools/tpu_probe.py [--log docs/onchip_r4/probe_log.txt]
       one probe; exit 0 = live, 1 = down
+  python tools/tpu_probe.py --classify
+      additionally print the classified verdict JSON (resilience
+      taxonomy: alive / TUNNEL_DOWN / WEDGED + wedge-signature fields)
   python tools/tpu_probe.py --watch 180
       probe forever at that cadence (for a background watcher)
 """
 
 import argparse
+import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from dragg_tpu.utils.probe import append_probe_log, probe_tpu  # noqa: E402
+from dragg_tpu.resilience.liveness import check_liveness  # noqa: E402
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--log", default="docs/onchip_r4/probe_log.txt")
     ap.add_argument("--timeout", type=float, default=60.0)
+    ap.add_argument("--classify", action="store_true",
+                    help="print the classified verdict as a JSON line")
     ap.add_argument("--watch", type=float, default=0.0,
                     help="probe forever at this cadence in seconds")
     args = ap.parse_args()
 
     while True:
-        alive, detail = probe_tpu(args.timeout)
-        print(append_probe_log(args.log, alive, detail), flush=True)
+        report = check_liveness(args.timeout, log_path=args.log)
+        if args.classify:
+            print(json.dumps(report._asdict()), flush=True)
+        else:
+            print(f"{'LIVE' if report.alive else 'DOWN'} {report.detail}",
+                  flush=True)
         if not args.watch:
-            sys.exit(0 if alive else 1)
+            sys.exit(0 if report.alive else 1)
         time.sleep(args.watch)
 
 

@@ -4,6 +4,12 @@ item 4 / BASELINE.md row 5 regime on one chip).
 Asserts, per chunk: solve rate >= threshold, comfort bands held on solved
 steps (to fp32 band tolerance), all outputs finite.  Prints one JSON line.
 
+Supervised (round 6): the measurement runs in a CHILD process under the
+resilience supervisor — hard deadline (``--deadline``), heartbeat-stall
+detection (``--stall``; each chunk beats), classified failure on the
+parent's stderr — so a hung device chunk kills the child instead of
+wedging this process (the parent never initializes a jax backend).
+
 Usage: python tools/validate_scale.py [--homes 10000] [--horizon-hours 48]
                                       [--days 2] [--chunk 8]
 """
@@ -15,8 +21,6 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import numpy as np
 
 
 def main():
@@ -40,9 +44,44 @@ def main():
                     help="directory with nsrdb.csv + waterdraw_profiles.csv "
                          "(e.g. the reference's real assets); default: "
                          "$DATA_DIR, else synthetic weather/draws")
+    ap.add_argument("--deadline", type=float, default=7200.0,
+                    help="hard wall-clock limit for the supervised "
+                         "measurement child")
+    ap.add_argument("--stall", type=float, default=0.0,
+                    help="kill the child if no chunk completes for this "
+                         "many seconds (0 = disabled, the default: a big "
+                         "CPU chunk legitimately computes longer than any "
+                         "beat cadence and the hard --deadline still "
+                         "bounds it; set ~900 for on-chip runs where a "
+                         "stall means a wedge-risk hang)")
+    ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
+    if not args._child:
+        # Supervised parent: jax-free, un-wedgeable.  The child is this
+        # same script; its one JSON line is forwarded verbatim.
+        from dragg_tpu.resilience.supervisor import (assert_parent_has_no_jax,
+                                                     run_supervised)
+
+        assert_parent_has_no_jax()
+        res = run_supervised(
+            [sys.executable, os.path.abspath(__file__), "--_child",
+             *sys.argv[1:]],
+            args.deadline, label="validate_scale",
+            stall_s=args.stall or None,
+            log=lambda m: print(f"[supervise] {m}", file=sys.stderr,
+                                flush=True))
+        sys.stderr.write(res.stderr_tail)
+        if res.json is not None:
+            print(json.dumps(res.json))
+        elif not res.ok:
+            print(json.dumps({"ok": False, "failure": res.failure,
+                              "rc": res.rc,
+                              "elapsed_s": round(res.elapsed_s, 1)}))
+        sys.exit(res.rc if res.rc is not None and res.rc >= 0 else 1)
+
     import jax
+    import numpy as np
 
     from dragg_tpu.config import default_config
     from dragg_tpu.data import load_environment, load_waterdraw_profiles
@@ -83,10 +122,15 @@ def main():
     twh_max = np.asarray(batch.temp_wh_max)
     band_tol = 0.05  # fp32 dynamics-row tolerance on ~degC scales
 
+    from dragg_tpu.resilience.faults import fault_hook
+    from dragg_tpu.resilience.heartbeat import beat
+
     t = 0
     rates, chunk_times, viol_max = [], [], 0.0
     t_all = time.perf_counter()
+    beat({"timestep": 0})
     while t < num_ts:
+        fault_hook("scale_chunk")
         k = min(args.chunk, num_ts - t)
         rps = np.zeros((k, eng.params.horizon), dtype=np.float32)
         t0 = time.perf_counter()
@@ -111,6 +155,7 @@ def main():
                       np.maximum(twh_min[None] - twh, twh - twh_max[None]), -1.0)
         viol_max = max(viol_max, float(vi.max()), float(vw.max()))
         t += k
+        beat({"timestep": t})
         print(f"[t={t}/{num_ts}] solve_rate={rates[-1]:.4f} "
               f"chunk_s={chunk_times[-1]:.1f} viol_max={viol_max:.4f}",
               file=sys.stderr, flush=True)
@@ -122,10 +167,10 @@ def main():
         "homes": n, "horizon_h": args.horizon_hours, "days": args.days,
         "steps": num_ts,
         "solver": args.solver,
-        "platform": jax.devices()[0].platform,
-        "device_kind": str(getattr(jax.devices()[0], "device_kind", "")),
+        "platform": jax.devices()[0].platform,  # device-call-ok: supervised child
+        "device_kind": str(getattr(jax.devices()[0], "device_kind", "")),  # device-call-ok: supervised child
         "sharded": bool(args.sharded),
-        "n_devices": len(jax.devices()) if args.sharded else 1,
+        "n_devices": len(jax.devices()) if args.sharded else 1,  # device-call-ok: supervised child
         "home_slots": eng.n_homes,
         "solve_rate": round(solve_rate, 4),
         "comfort_violation_max": round(viol_max, 5),

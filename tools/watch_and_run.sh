@@ -1,48 +1,14 @@
 #!/bin/bash
-# Tunnel watcher that AUTO-RUNS the on-chip runbook the moment a probe
-# comes back LIVE — live windows are the scarce resource (rounds 2-4:
-# one window in three rounds) and must not be wasted waiting for a human
-# or an agent to notice.  Probes every CADENCE seconds, appends to the
-# probe transcript, and on the first LIVE verdict executes
-# tools/onchip_runbook.sh once, then keeps watching (a later flap +
-# revival triggers a fresh runbook into a new suffix dir).
+# Tunnel watcher that AUTO-RUNS the on-chip runbook on every DOWN→LIVE
+# edge — live windows are the scarce resource (rounds 2-5: one window in
+# four rounds) and must not be wasted waiting for a human to notice.
 #
-#   nohup bash tools/watch_and_run.sh docs/onchip_r4 180 > /tmp/watch.out 2>&1 &
+# Round 6: the watch loop moved into the supervised Python API
+# (tools/runbook.py --watch over dragg_tpu/resilience); each pass runs
+# into a fresh suffix dir, and a failed pass does not latch the edge.
+# This wrapper only preserves the historical entry point.
+#
+#   nohup bash tools/watch_and_run.sh docs/onchip_r6 180 > /tmp/watch.out 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-OUT=${1:-docs/onchip_r4}
-CADENCE=${2:-180}
-n=0
-prev=down
-while true; do
-  if python tools/tpu_probe.py --log "$OUT/probe_log.txt" >/dev/null 2>&1; then
-    # Fire only on the DOWN→LIVE edge: a tunnel that stays up must not
-    # re-run the multi-hour runbook every probe — the duplicate 10k/25k
-    # compiles are themselves the documented wedge trigger (CLAUDE.md).
-    if [ "$prev" = down ]; then
-      n=$((n + 1))
-      # Always a FRESH suffix dir: the base OUT holds committed artifacts
-      # from earlier passes/rounds, and the runbook's > redirections would
-      # silently truncate them (advisor finding, r4).
-      dir="${OUT}_w$n"
-      echo "[$(date +%H:%M:%S)] tunnel LIVE — running runbook into $dir"
-      bash tools/onchip_runbook.sh "$dir"
-      rc=$?
-      echo "[$(date +%H:%M:%S)] runbook pass $n finished rc=$rc"
-      if [ $rc -eq 0 ]; then
-        prev=live
-      else
-        # A failed runbook (e.g. its own start probe lost a transient
-        # flap) must NOT latch prev=live — that would suppress the edge
-        # for the rest of a real window.  Treat as still-down and retry
-        # on the next probe.
-        prev=down
-      fi
-    else
-      prev=live
-    fi
-  else
-    prev=down
-  fi
-  sleep "$CADENCE"
-done
+exec python tools/runbook.py --out "${1:-docs/onchip_r6}" --watch "${2:-180}"
