@@ -39,8 +39,17 @@ Besides the headline rate the JSON carries per-phase timers
 estimate for the compiled chunk, and an MFU estimate against the chip's
 peak (device_kind-keyed table).
 
+The benchmarked config defaults to the SHIPPED bundled-data environment
+(VERDICT r5 weak #3); ``--synthetic`` pins the rounds-2..4 generators for
+cross-round comparability.  Since round 7 every timer flows through the
+unified telemetry registry (dragg_tpu/telemetry): warmup/chunk/phase
+timings are spans, the JSON derives from metrics snapshots, and
+``flops_per_step`` is always populated (analytic model) so MFU can be
+back-filled from telemetry the moment a chip is reachable.
+
 Usage: python bench.py [--homes N] [--horizon-hours H] [--steps K]
                        [--chunks C] [--platform auto|tpu|cpu] [--smoke]
+                       [--synthetic] [--dual-report]
 """
 
 from __future__ import annotations
@@ -150,9 +159,19 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
 
 
 def run_measured(args) -> dict:
-    """The actual measurement (runs inside the supervised child)."""
+    """The actual measurement (runs inside the supervised child).
+
+    Every timer lands in the unified telemetry registry
+    (dragg_tpu/telemetry): warmup/chunk/phase timings are spans observed
+    into histograms, and the JSON fields are DERIVED from metrics
+    snapshots — no scattered perf_counter pairs deciding headline
+    numbers (round 7).  When the supervising parent exported
+    ``$DRAGG_TELEMETRY_DIR`` the events also stream to its
+    events.jsonl; otherwise the bus is memory-only."""
+    from dragg_tpu import telemetry
     from dragg_tpu.resilience.faults import fault_hook
 
+    telemetry.init_run(os.environ.get(telemetry.ENV_DIR))
     fault_hook("bench_build")
     import jax
 
@@ -245,31 +264,32 @@ def run_measured(args) -> dict:
     # baked into the compiled program, so a different shape would put a full
     # recompile inside the timed window.
     _log("warmup chunk (compile)...")
-    t0 = time.perf_counter()
-    state, outs = engine.run_chunk(state, 0, rps)
-    jax.block_until_ready(outs.agg_load)
-    compile_s = time.perf_counter() - t0
-    _log(f"warmup done in {compile_s:.1f}s; timing {args.chunks} chunks "
-         f"of {steps} steps")
+    with telemetry.span("bench.warmup_s"):
+        state, outs = engine.run_chunk(state, 0, rps)
+        jax.block_until_ready(outs.agg_load)
+    _log(f"warmup done; timing {args.chunks} chunks of {steps} steps")
 
-    chunk_rates = []
     iters_per_step = []
     solve_rates = []
     t_cursor = steps
     for c in range(args.chunks):
         fault_hook("bench_chunk")
-        t0 = time.perf_counter()
-        state, outs = engine.run_chunk(state, t_cursor, rps)
-        jax.block_until_ready(outs.agg_load)
-        elapsed = time.perf_counter() - t0
+        with telemetry.span("bench.chunk_s") as sp:
+            state, outs = engine.run_chunk(state, t_cursor, rps)
+            jax.block_until_ready(outs.agg_load)
         t_cursor += steps
-        chunk_rates.append(steps / elapsed)
         iters_per_step.append(float(np.mean(np.asarray(outs.admm_iters))))
         solve_rates.append(float(np.mean(np.asarray(outs.correct_solve))))
-        _log(f"chunk {c}: {chunk_rates[-1]:.3f} ts/s, "
+        _log(f"chunk {c}: {steps / sp.s:.3f} ts/s, "
              f"mean solver iters {iters_per_step[-1]:.0f}, "
              f"solve rate {solve_rates[-1]:.4f}")
+    # The headline rate and the compile time come OUT OF the metrics
+    # snapshot the spans populated — one source of truth for timers.
+    hists = telemetry.snapshot()["histograms"]
+    compile_s = hists["bench.warmup_s"]["last"]
+    chunk_rates = [steps / s for s in hists["bench.chunk_s"]["samples"]]
     rate = max(chunk_rates)  # steady-state rate; chunks differ only by noise
+    telemetry.set_gauge("bench.rate_ts_per_s", rate)
 
     # --- Phase breakdown (separately jitted; attribution, not headline).
     phases = None
@@ -288,13 +308,18 @@ def run_measured(args) -> dict:
         jax.block_until_ready(solve(state, qp, fcarry, no_refresh))
         reps = max(2, min(8, args.steps))
 
-        def timeit(fn, *a):
+        def timeit(metric, fn, *a):
+            """Per-step phase time, observed into the named histogram —
+            the phases dict below is read back from the snapshot.  The
+            reps stay UNBLOCKED between dispatches (pipelining parity
+            with the scan), one block at the end."""
             t0 = time.perf_counter()
             for _ in range(reps):
                 out = fn(*a)
             jax.block_until_ready(out)
-            return (time.perf_counter() - t0) / reps
+            telemetry.observe(metric, (time.perf_counter() - t0) / reps)  # telemetry-name-ok: every caller below passes a bench.phase.* registry literal
 
+        timeit("bench.phase.assemble_s", prep, state, jt, jrp)
         if solver_used == "ipm":
             # The IPM has NO cross-step factor cache (engine._solve: the
             # refresh flag and factor carry pass through untouched), so
@@ -302,20 +327,23 @@ def run_measured(args) -> dict:
             # delta is noise — exactly what BENCH_r05's 8.79 vs 9.00 was
             # (VERDICT r5 weak #4; measured ±3% run-to-run,
             # docs/perf_notes.md round 6).  One honest key instead.
-            phases = {
-                "assemble": timeit(prep, state, jt, jrp),
-                "solve": timeit(solve, state, qp, factor0, refresh),
-                "merge_collect": timeit(fin, state, jt, sol, aux, warm_sol),
-            }
+            timeit("bench.phase.solve_s", solve, state, qp, factor0, refresh)
         else:
-            phases = {
-                "assemble": timeit(prep, state, jt, jrp),
-                "solve_refresh": timeit(solve, state, qp, factor0, refresh),
-                "solve_cached": timeit(solve, state, qp, fcarry, no_refresh),
-                "merge_collect": timeit(fin, state, jt, sol, aux, warm_sol),
-            }
+            timeit("bench.phase.solve_refresh_s",
+                   solve, state, qp, factor0, refresh)
+            timeit("bench.phase.solve_cached_s",
+                   solve, state, qp, fcarry, no_refresh)
+        timeit("bench.phase.merge_collect_s",
+               fin, state, jt, sol, aux, warm_sol)
+        pfx = "bench.phase."
+        phases = {
+            name[len(pfx):-len("_s")]: h["mean"]
+            for name, h in telemetry.snapshot()["histograms"].items()
+            if name.startswith(pfx)
+        }
         _log(f"phases (s/step): {phases}")
     except Exception as e:  # profiling must never sink the benchmark
+        phases = None
         _log(f"phase profiling failed: {e!r}")
 
     # --- FLOPs + MFU (analytic model of the ADMM's dominant dense ops; the
@@ -346,7 +374,6 @@ def run_measured(args) -> dict:
         mfu = (flops_per_step * rate) / peak
     hbm_util = bytes_per_step = None
     if solver_used != "admm":
-        flops_per_step = None
         # IPM FLOPs floor (VPU elementwise, per iteration per home): band
         # factor ≈ 2·m·(bw+1)², ~10 forward/backward solve passes at
         # 2·m·(bw+1) MACs each, and ~6 sparse A matvecs at 2·nnz.  The
@@ -355,15 +382,25 @@ def run_measured(args) -> dict:
         # but a populated value lets artifacts show HOW far this solver
         # sits from the MXU roofline instead of reporting null
         # (VERDICT r4 next-2).
+        nnz = engine.static.pattern.nnz
         if engine.band_bw is not None:
             bwp1 = engine.band_bw + 1
-            nnz = engine.static.pattern.nnz
             flops_iter_ipm = B * (2.0 * m * bwp1 * bwp1
                                   + 10 * 2.0 * m * bwp1
                                   + 6 * 2.0 * nnz)
-            flops_per_step = mean_iters * flops_iter_ipm
-            if peak:
-                mfu = (flops_per_step * rate) / peak
+        else:
+            # Band plan disabled → the factorization is a dense per-home
+            # Cholesky: m³/3 plus ~10 triangular-solve passes at 2·m²
+            # MACs and the same sparse matvecs.  flops_per_step is ALWAYS
+            # populated (round 7): the analytic model is platform-free,
+            # so MFU can be back-filled from telemetry the moment a chip
+            # is reachable instead of staying null until a re-run.
+            flops_iter_ipm = B * (m ** 3 / 3.0
+                                  + 10 * 2.0 * m * m
+                                  + 6 * 2.0 * nnz)
+        flops_per_step = mean_iters * flops_iter_ipm
+        if peak:
+            mfu = (flops_per_step * rate) / peak
         # The IPM is bandwidth-bound: per iteration the fused band kernels
         # stream the (B, m, bw+1) factor ~9 times (scatter write, Cholesky
         # read+write, and 2 refined solves × [L fwd+bwd ×2 passes + band-S
@@ -422,7 +459,9 @@ def run_measured(args) -> dict:
     else:
         data_label = "bundled" if bundled_data_dir() else "synthetic"
 
-    return {
+    if flops_per_step is not None:
+        telemetry.set_gauge("bench.flops_per_step", float(flops_per_step))
+    result = {
         "metric": f"sim_timesteps_per_s_{args.homes}homes_{args.horizon_hours}h_horizon",
         "value": round(rate, 3),
         "unit": "timesteps/s",
@@ -453,6 +492,15 @@ def run_measured(args) -> dict:
         "hbm_bytes_per_step_est": bytes_per_step,
         "hbm_util": round(hbm_util, 4) if hbm_util is not None else None,
     }
+    # Mirror the headline artifact onto the unified stream and persist
+    # the metrics snapshot (no-op on the memory-only bus) so a run dir
+    # carries the same numbers the JSON line reports.  The snapshot is
+    # per-child-pid: several bench children can share one supervised
+    # stream dir (--dual-report, retries) and must not clobber each
+    # other's metrics.
+    telemetry.emit("bench.result", result=result)
+    telemetry.write_snapshot(name=f"metrics.bench_{os.getpid()}.json")
+    return result
 
 
 def child_argv(args, platform: str, attempt: int,
@@ -502,7 +550,13 @@ def main() -> None:
                          "cross-round perf A/Bs (rounds <=4 measured this)")
     ap.add_argument("--data-dir", default=None,
                     help="directory with nsrdb.csv + waterdraw_profiles.csv "
-                         "(real assets; default: synthetic)")
+                         "(default: the shipped bundled assets — the "
+                         "environment headline artifacts measure)")
+    ap.add_argument("--synthetic", action="store_true",
+                    help="measure the rounds-2..4 synthetic environment "
+                         "(alias for --data-dir ''; kept for cross-round "
+                         "comparability — the 'data' field labels either "
+                         "way)")
     ap.add_argument("--dual-report", action="store_true",
                     help="emit TWO JSON lines: the bundled-data shipped "
                          "default AND the rounds-2..4 synthetic environment "
@@ -511,6 +565,11 @@ def main() -> None:
                     help="tiny inline CPU run (50 homes, 4h horizon) for verification")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.synthetic:
+        if args.data_dir not in (None, ""):
+            ap.error("--synthetic conflicts with an explicit --data-dir")
+        args.data_dir = ""  # "" forces the synthetic generators
 
     if args.smoke:
         args.platform = "cpu"

@@ -151,6 +151,21 @@ def main(argv=None) -> int:
         config = load_config(args.config)
         if args.data_dir is not None:
             os.environ["DATA_DIR"] = args.data_dir
+        # One telemetry stream for the whole supervised run: the parent
+        # opens the bus ON THE RUN DIRECTORY (computed jax-free), so its
+        # probe/supervisor/degradation events interleave with the
+        # child's run.start/chunk.done records in one events.jsonl.  The
+        # child owns the final metrics.json (the parent never snapshots
+        # — it would overwrite the run's metrics with supervisor-only
+        # numbers).
+        from dragg_tpu import telemetry
+        from dragg_tpu.resilience.runner import run_dir_for
+
+        if config.get("telemetry", {}).get("enabled", True):
+            telemetry.init_run(
+                config.get("telemetry", {}).get("dir")
+                or os.environ.get(telemetry.ENV_DIR)
+                or run_dir_for(config, args.outputs_dir))
         provenance = supervised_sim_run(
             config, args.outputs_dir, platform=args.platform,
             deadline_s=args.deadline,

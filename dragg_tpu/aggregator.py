@@ -22,6 +22,7 @@ import time
 
 import numpy as np
 
+from dragg_tpu import telemetry
 from dragg_tpu.config import configured_solver, load_config
 from dragg_tpu.data import EnvironmentData, load_environment, load_waterdraw_profiles, parse_dt
 from dragg_tpu.engine import Engine, StepOutputs, make_engine
@@ -125,6 +126,14 @@ class Aggregator:
         self.version = self.config["simulation"].get("named_version", "test")
         self.run_dir = None
         self._solve_iters: list[int] = []
+        # Whether THIS aggregator opened the telemetry bus (run() →
+        # _telemetry_open: config-enabled AND process 0).  The engine
+        # emits below gate on this flag, NOT on telemetry.active(): the
+        # bus auto-joins $DRAGG_TELEMETRY_DIR lazily, and without the
+        # flag every non-zero rank of a pod run would duplicate
+        # chunk.done onto the shared stream (and telemetry.enabled=false
+        # would be overridden by a supervising parent's env export).
+        self._telemetry_on = False
         # Persistent XLA compilation cache: a re-run of the same config
         # skips the 20-40 s cold compile entirely (docs/perf_notes.md).
         from dragg_tpu.utils.compile_cache import enable_compile_cache
@@ -249,7 +258,8 @@ class Aggregator:
         self.collector.add_chunk("temp_wh_opt", temp_wh_init)
         self.collector.add_chunk("e_batt_opt", e_batt_init)
 
-    def _collect_chunk(self, outs: StepOutputs, track_setpoints: bool = True) -> None:
+    def _collect_chunk(self, outs: StepOutputs, track_setpoints: bool = True,
+                       device_s: float | None = None) -> None:
         """Append a chunk of stacked step outputs to the series store — the
         analog of per-step ``collect_data`` Redis reads
         (dragg/aggregator.py:728-755), amortized over the whole chunk: one
@@ -257,7 +267,13 @@ class Aggregator:
 
         ``track_setpoints=False`` skips the host-side ``gen_setpoint`` loop:
         the RL-aggregator scan already tracks the setpoint on device and
-        overwrites ``all_sps`` with the authoritative values."""
+        overwrites ``all_sps`` with the authoritative values.
+
+        ``device_s`` (the caller's measured device wall time for this
+        chunk) feeds the per-chunk step-latency telemetry; the solver
+        telemetry (iterations, residual maxima, solve rate) rides the
+        SAME host transfer as the collected series — StepOutputs carries
+        it, so telemetry adds no extra device→host syncs."""
         from dragg_tpu.checkpoint import to_host
 
         n_true = getattr(self.engine, "true_n_homes", None) or self.engine.n_homes
@@ -293,6 +309,34 @@ class Aggregator:
         # surface any regression so on-chip configs can detect it (ADVICE
         # round 4).
         n_repair_failed = float(np.sum(host["repair_failed"]))
+        if self._telemetry_on:
+            # One typed record per chunk on the run's unified stream —
+            # what the dashboard's /live view and the forensic artifacts
+            # tail (docs/telemetry.md).
+            rate = float(host["correct_solve"].mean())
+            mean_iters = float(host["admm_iters"].mean())
+            rpm = float(host["r_prim_max"].max())
+            rdm = float(host["r_dual_max"].max())
+            fields = dict(t0=self.timestep, t1=self.timestep + n_steps,
+                          n_steps=n_steps, solve_rate=round(rate, 4),
+                          solver_iters=round(mean_iters, 1),
+                          r_prim_max=rpm, r_dual_max=rdm,
+                          repair_failed=int(n_repair_failed))
+            if device_s is not None:
+                fields["device_s"] = round(device_s, 3)
+                fields["steps_per_s"] = round(
+                    n_steps / max(device_s, 1e-9), 3)
+                telemetry.observe("engine.chunk_device_s", device_s)
+                telemetry.observe("engine.chunk_steps_per_s",
+                                  fields["steps_per_s"])
+            telemetry.emit("chunk.done", **fields)
+            telemetry.observe("engine.solve_iters", mean_iters)
+            telemetry.set_gauge("engine.solve_rate", rate)
+            telemetry.set_gauge("engine.r_prim_max", rpm)
+            telemetry.set_gauge("engine.r_dual_max", rdm)
+            telemetry.set_gauge("sim.timestep", self.timestep + n_steps)
+            if n_repair_failed:
+                telemetry.inc("engine.repair_failed", n_repair_failed)
         if n_repair_failed > 0:
             self.log.logger.progress(
                 f"chunk t={self.timestep}..{self.timestep + n_steps}: "
@@ -756,10 +800,14 @@ class Aggregator:
                 import jax
 
                 jax.block_until_ready(outs.agg_load)
-            self._phase_times["device_chunks"] += time.perf_counter() - t0
+            device_s = time.perf_counter() - t0
+            self._phase_times["device_chunks"] += device_s
             t0 = time.perf_counter()
-            self._collect_chunk(outs)
-            self._phase_times["collect"] += time.perf_counter() - t0
+            self._collect_chunk(outs, device_s=device_s)
+            collect_s = time.perf_counter() - t0
+            self._phase_times["collect"] += collect_s
+            if self._telemetry_on:
+                telemetry.observe("engine.collect_s", collect_s)
             t += n_steps
             chunks += 1
             beat({"timestep": t})
@@ -936,13 +984,63 @@ class Aggregator:
             "weekly": self.dt * 24 * 7,
         }.get(interval, 500)
 
+    def _telemetry_open(self) -> bool:
+        """Open the run-scoped telemetry bus (``<run_dir>/events.jsonl``
+        + in-memory metrics — dragg_tpu/telemetry) on process 0.  The
+        destination resolves config ``telemetry.dir`` →
+        ``$DRAGG_TELEMETRY_DIR`` (a supervising parent exports it so the
+        child's events land on the SAME stream as the supervisor's) →
+        the run directory."""
+        from dragg_tpu.config import default_config
+
+        tcfg = {**default_config()["telemetry"],
+                **self.config.get("telemetry", {})}
+        import jax
+
+        if not tcfg["enabled"] or jax.process_index() != 0:
+            return False
+        tdir = tcfg["dir"] or os.environ.get(telemetry.ENV_DIR) \
+            or self.run_dir
+        telemetry.init_run(tdir)
+        cfg = self.config
+        telemetry.emit(
+            "run.start",
+            case=self.case,
+            homes=cfg["community"]["total_number_homes"],
+            horizon=cfg["home"]["hems"]["prediction_horizon"],
+            solver=configured_solver(cfg),
+            run_dir=self.run_dir,
+        )
+        return True
+
+    def _telemetry_close(self, t0: float) -> None:
+        telemetry.emit(
+            "run.end",
+            timestep=self.timestep,
+            num_timesteps=self.num_timesteps,
+            elapsed_s=round(time.time() - t0, 3),
+            completed=self.timestep >= self.num_timesteps,
+        )
+        telemetry.write_snapshot()
+        telemetry.close_run()
+
     def run(self) -> None:
         """Entry point (dragg/aggregator.py:941-970)."""
         self.log.logger.info("Made it to Aggregator Run")
         self.checkpoint_interval = self._checkpoint_steps()
         self.version = self.config["simulation"].get("named_version", "test")
         self.set_run_dir()
+        self._telemetry_on = self._telemetry_open()
+        t_run0 = time.time()
+        try:
+            self._run_cases()
+        finally:
+            if self._telemetry_on:
+                self._telemetry_close(t_run0)
+                self._telemetry_on = False
 
+    def _run_cases(self) -> None:
+        """The enabled simulation cases, in reference order."""
         if self.config["simulation"].get("run_rbo_mpc", True):
             self.case = "baseline"
             self.get_homes()

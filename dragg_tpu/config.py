@@ -203,6 +203,16 @@ _DEFAULT: dict[str, Any] = {
                                  # checkpoint (platform transition recorded
                                  # in the provenance JSON)
     },
+    # Unified run telemetry (dragg_tpu/telemetry — round-7 tentpole).
+    "telemetry": {
+        "enabled": True,  # run-scoped event bus: <run_dir>/events.jsonl +
+                          # final metrics.json snapshot; false = metrics
+                          # and events both no-op (near-zero overhead)
+        "dir": "",        # events/metrics destination ("" = resolve
+                          # $DRAGG_TELEMETRY_DIR, else the run directory —
+                          # supervised runs export the env var so parent
+                          # and child share one stream)
+    },
     # dragg_tpu-specific knobs (no reference analog).
     "tpu": {
         "admm_iters": 1500,

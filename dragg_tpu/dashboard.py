@@ -13,6 +13,16 @@ Routes:
   ``/``                     index: discovered runs, stats table, figure links
   ``/fig/<name>.svg``       any figure from :data:`FIGURES`
   ``/fig/single_home.svg?home=<name>``  per-home drill-down
+  ``/live``                 tail of an IN-PROGRESS run's telemetry stream
+                            (``events.jsonl`` — dragg_tpu/telemetry);
+                            ``?run=<idx>`` selects among discovered streams
+  ``/metrics.json``         the selected run's metrics snapshot: the final
+                            ``metrics.json`` when the run finished, else a
+                            partial snapshot folded live from the events
+
+The figure routes only see FINISHED runs (they need results.json); the
+live routes discover any run directory with an ``events.jsonl``, so an
+in-progress simulation is observable the moment its first chunk lands.
 
 Usage: ``python -m dragg_tpu dashboard [--port 8050]`` (the reference stub's
 default Dash port), or :func:`serve` / :class:`Dashboard` programmatically.
@@ -23,6 +33,7 @@ from __future__ import annotations
 import glob
 import html
 import io
+import json
 import os
 import threading
 import urllib.parse
@@ -31,6 +42,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from dragg_tpu import telemetry
 from dragg_tpu.logger import Logger
 from dragg_tpu.reformat import Reformat, daily_stats, stats_table
 
@@ -125,6 +137,138 @@ class Dashboard:
         with self.render_lock:
             return self.render_figure(name, home=home)
 
+    # ------------------------------------------------------------ live runs
+    def live_runs(self) -> list[dict]:
+        """Every run directory under the outputs tree with a telemetry
+        stream (``events.jsonl``), newest first — in-progress runs
+        included (they have no results.json yet, so figure discovery
+        can't see them)."""
+        runs = []
+        pattern = os.path.join(self.ref.outputs_dir, "**",
+                               telemetry.EVENTS_FILE)
+        for path in glob.glob(pattern, recursive=True):
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            runs.append({
+                "events": path,
+                "dir": os.path.dirname(path),
+                "mtime": mtime,
+                "final": os.path.isfile(os.path.join(
+                    os.path.dirname(path), telemetry.METRICS_FILE)),
+            })
+        runs.sort(key=lambda r: r["mtime"], reverse=True)
+        return runs
+
+    def _select_run(self, runs: list[dict], query: str) -> dict | None:
+        """The ``?run=<idx>`` selection (index into :meth:`live_runs`'s
+        newest-first order — never a raw client-supplied path)."""
+        if not runs:
+            return None
+        try:
+            idx = int(urllib.parse.parse_qs(query).get("run", ["0"])[0])
+        except ValueError:
+            return None
+        return runs[idx] if 0 <= idx < len(runs) else None
+
+    @staticmethod
+    def tail_events(events_path: str, limit: int = 50,
+                    tail_bytes: int = 262_144) -> list[dict]:
+        """Last ``limit`` parseable event records of an events.jsonl —
+        reads a bounded tail, so tailing a huge in-progress stream stays
+        O(limit) not O(run)."""
+        try:
+            with open(events_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                lines = f.read().decode("utf-8", "replace").splitlines()
+        except OSError:
+            return []
+        out = []
+        for line in reversed(lines):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # torn first line of the tail window / mid-write
+            if len(out) >= limit:
+                break
+        return list(reversed(out))
+
+    def metrics_snapshot(self, run: dict) -> dict:
+        """The run's metrics: the final ``metrics.json`` when the run
+        wrote one, else a partial snapshot folded from the event stream
+        (event counts + the latest record per type), so ``/metrics.json``
+        answers for a run that is still mid-simulation."""
+        if run["final"]:
+            try:
+                with open(os.path.join(run["dir"],
+                                       telemetry.METRICS_FILE)) as f:
+                    snap = json.load(f)
+                return {"final": True, "run_dir": run["dir"], **snap}
+            except (OSError, ValueError):
+                pass  # fall through to the event fold
+        events = self.tail_events(run["events"], limit=500)
+        by_event: dict[str, int] = {}
+        last: dict[str, dict] = {}
+        for rec in events:
+            name = rec.get("event", "?")
+            by_event[name] = by_event.get(name, 0) + 1
+            last[name] = rec
+        return {"final": False, "run_dir": run["dir"],
+                "tailed_events": len(events), "by_event": by_event,
+                "last": last}
+
+    def live_html(self, query: str = "") -> str:
+        runs = self.live_runs()
+        run = self._select_run(runs, query)
+        run_list = "\n".join(
+            f'<li><a href="/live?run={i}">{html.escape(r["dir"])}</a>'
+            f'{" (finished)" if r["final"] else " (in progress)"}</li>'
+            for i, r in enumerate(runs)
+        )
+        if run is None:
+            body = "<p>(no telemetry streams found)</p>"
+        else:
+            snap = self.metrics_snapshot(run)
+            events = self.tail_events(run["events"])
+            rows = "\n".join(
+                "<tr><td>{}</td><td>{}</td><td><code>{}</code></td></tr>"
+                .format(
+                    html.escape(str(rec.get("mono", ""))),
+                    html.escape(str(rec.get("event", ""))),
+                    html.escape(json.dumps(
+                        {k: v for k, v in rec.items()
+                         if k not in ("event", "t", "mono", "pid", "seq")},
+                        default=str)[:400]),
+                )
+                for rec in events
+            )
+            body = (
+                f"<h2>{html.escape(run['dir'])}"
+                f"{' — finished' if run['final'] else ' — in progress'}</h2>"
+                f"<h3>Metrics</h3><pre>"
+                f"{html.escape(json.dumps(snap, indent=1, default=str)[:8000])}"
+                f"</pre>"
+                f"<h3>Last {len(events)} events</h3>"
+                f"<table border=1 cellpadding=4 style='border-collapse:"
+                f"collapse'><tr><th>mono</th><th>event</th><th>fields</th>"
+                f"</tr>{rows}</table>"
+            )
+        return f"""<!doctype html><html><head><title>dragg_tpu live</title>
+<meta http-equiv="refresh" content="5">
+<style>body{{font-family:sans-serif;margin:2em;max-width:1100px}}
+pre{{background:#f6f6f6;padding:1em;overflow-x:auto}}</style></head><body>
+<h1>live telemetry</h1><p><a href="/">back to results</a> —
+auto-refreshes every 5 s</p>
+<h2>Streams</h2><ul>{run_list or "<li>(none)</li>"}</ul>
+{body}
+</body></html>"""
+
     # --------------------------------------------------------------- index
     def _home_names(self) -> list[str]:
         names: set[str] = set()
@@ -159,6 +303,7 @@ class Dashboard:
 <style>body{{font-family:sans-serif;margin:2em;max-width:1100px}}
 pre{{background:#f6f6f6;padding:1em;overflow-x:auto}}</style></head><body>
 <h1>dragg_tpu dashboard</h1>
+<p><a href="/live">live telemetry</a> (in-progress runs)</p>
 <h2>Discovered runs</h2><ul>{run_list or "<li>(none)</li>"}</ul>
 <h2>Daily statistics</h2><pre>{html.escape(stats)}</pre>
 <h2>Figures</h2>{figs}
@@ -187,6 +332,30 @@ def make_handler(dash: Dashboard):
                     self._send(500, "text/plain", f"index failed: {e!r}".encode())
                     return
                 self._send(200, "text/html; charset=utf-8", body)
+                return
+            if parsed.path == "/live":
+                try:
+                    body = dash.live_html(parsed.query).encode()
+                except Exception as e:  # a torn stream must not kill the server
+                    self._send(500, "text/plain", f"live failed: {e!r}".encode())
+                    return
+                self._send(200, "text/html; charset=utf-8", body)
+                return
+            if parsed.path == "/metrics.json":
+                try:
+                    runs = dash.live_runs()
+                    run = dash._select_run(runs, parsed.query)
+                    if run is None:
+                        self._send(404, "application/json",
+                                   b'{"error": "no telemetry stream"}')
+                        return
+                    body = json.dumps(dash.metrics_snapshot(run),
+                                      default=str).encode()
+                except Exception as e:
+                    self._send(500, "text/plain",
+                               f"metrics failed: {e!r}".encode())
+                    return
+                self._send(200, "application/json", body)
                 return
             if parsed.path.startswith("/fig/") and parsed.path.endswith(".svg"):
                 name = parsed.path[len("/fig/"):-len(".svg")]

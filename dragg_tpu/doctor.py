@@ -99,6 +99,22 @@ def _check_data(cfg: dict | None) -> dict:
                if missing else {})}
 
 
+def _check_telemetry() -> dict:
+    """Unified-telemetry plumbing: registry loads, a throwaway bus round-
+    trips one event (dragg_tpu/telemetry).  Reports the shared stream
+    when ``$DRAGG_TELEMETRY_DIR`` routes this process's events."""
+    try:
+        from dragg_tpu import telemetry
+
+        r = telemetry.selftest()
+        stream = os.environ.get(telemetry.ENV_DIR)
+        return {"status": OK if r["ok"] else FAIL,
+                "registered": f"{r['events']} events / {r['metrics']} metrics",
+                **({"stream": stream} if stream else {})}
+    except Exception as e:
+        return {"status": FAIL, "error": repr(e)}
+
+
 def _check_outputs(outputs_dir: str) -> dict:
     try:
         os.makedirs(outputs_dir, exist_ok=True)
@@ -155,6 +171,7 @@ def run_doctor(outputs_dir: str = "outputs", backend_timeout: float = 60.0,
         "native_runtime": _check_native(),
         "data_files": _check_data(cfg),
         "outputs_writable": _check_outputs(outputs_dir),
+        "telemetry": _check_telemetry(),
     }
     # Pallas only matters when a TPU backend is up — and its self-test
     # compiles a kernel, so it runs in a SUBPROCESS with the same hard

@@ -112,6 +112,15 @@ class StepOutputs(NamedTuple):
                                   # relaxed action (0 when repair is off);
                                   # surfaces the measured-99.9% coverage
                                   # regressing on chip (ADVICE round 4)
+    r_prim_max: jnp.ndarray       # () max final primal residual over the
+                                  # check-mask homes — device-side solver
+                                  # telemetry piggybacked on the chunk
+                                  # outputs (no extra device→host sync);
+                                  # non-finite residuals of diverged homes
+                                  # are clamped to an f32-max sentinel so
+                                  # divergence is visible, not NaN
+    r_dual_max: jnp.ndarray       # () max final dual residual (same
+                                  # masking/sentinel convention)
 
 
 class StepAux(NamedTuple):
@@ -795,6 +804,16 @@ class Engine:
         fore = mpc.p_grid[:, 1] / s if H > 1 else jnp.zeros((n,), f32)
         fore = jnp.where(solved, fore, p_load0)
 
+        # Residual maxima over the check-mask homes: the per-step solver
+        # telemetry the unified stream records (dragg_tpu/telemetry).  A
+        # diverged home's non-finite residual becomes an f32-max sentinel
+        # (visible in chunk telemetry) instead of NaN-poisoning the max.
+        _big = jnp.asarray(3.4e38, f32)
+
+        def _res_max(r):
+            r = jnp.where(self._check_mask > 0, r, 0.0)
+            return jnp.max(jnp.where(jnp.isfinite(r), r, _big))
+
         sel2 = solved[:, None]
         new_state = CommunityState(
             temp_in=temp_in_next,
@@ -833,6 +852,8 @@ class Engine:
             agg_cost=jnp.sum(cost0 * self._check_mask),
             admm_iters=sol.iters,
             repair_failed=jnp.asarray(repair_failed, f32),
+            r_prim_max=_res_max(sol.r_prim),
+            r_dual_max=_res_max(sol.r_dual),
         )
         return new_state, out
 

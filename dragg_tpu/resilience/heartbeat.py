@@ -33,6 +33,16 @@ def beat(progress: dict | None = None) -> None:
     path = heartbeat_path()
     if path is None:
         return
+    # Beats mirror onto the unified telemetry stream (no-op without a
+    # bus; never raises) so an after-the-fact wedge forensic can see the
+    # child's last progress inline with the supervisor's verdicts.
+    try:
+        from dragg_tpu import telemetry
+
+        telemetry.emit("heartbeat.beat",
+                       **({"progress": progress} if progress else {}))
+    except Exception:
+        pass
     payload = {"t": time.time(), **({"progress": progress} if progress else {})}
     tmp = f"{path}.tmp{os.getpid()}"
     try:

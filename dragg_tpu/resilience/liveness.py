@@ -23,6 +23,7 @@ import urllib.error
 import urllib.request
 from typing import NamedTuple
 
+from dragg_tpu import telemetry
 from dragg_tpu.resilience import faults
 from dragg_tpu.resilience.taxonomy import TUNNEL_DOWN, WEDGED, classify_liveness
 
@@ -119,6 +120,18 @@ def check_liveness(timeout_s: float = 60.0,
             append_probe_log(log_path, report.alive, report.detail)
         except OSError:
             pass
+    # Every verdict lands on the unified stream too (no-op when no bus
+    # is open) — the watcher (tools/tpu_probe.py --watch), bench's
+    # ladder, doctor --classify, and the runbook all share this one
+    # forensic format instead of per-tool transcripts.
+    telemetry.emit("probe.verdict", alive=report.alive, kind=report.kind,
+                   detail=report.detail, backend=report.backend,
+                   proxy=report.proxy, compile_helper=report.compile_helper,
+                   elapsed_s=report.elapsed_s)
+    telemetry.observe("probe.elapsed_s", report.elapsed_s)
+    if report.kind is not None:
+        telemetry.emit("failure." + report.kind,  # telemetry-name-ok: kind from taxonomy.FAILURE_KINDS, each registered literally
+                       source="probe", detail=report.detail)
     return report
 
 

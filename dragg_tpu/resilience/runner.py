@@ -23,6 +23,7 @@ import sys
 import tempfile
 import time
 
+from dragg_tpu import telemetry
 from dragg_tpu.resilience import liveness
 from dragg_tpu.resilience.supervisor import run_supervised
 from dragg_tpu.resilience.taxonomy import TUNNEL_DOWN
@@ -101,6 +102,16 @@ def run_device_job(build_argv, *, platform: str = "auto",
                 return res.json, attempts
 
     if platform in ("auto", "cpu"):
+        if platform == "auto" and attempts:
+            # The ladder is degrading: every TPU avenue (probe gate or
+            # executed attempts) failed and the same config re-runs on
+            # CPU — record the transition on the unified stream with the
+            # classified reason, like supervised_sim_run's provenance.
+            telemetry.emit(
+                "degrade.transition", from_platform="tpu",
+                to_platform="cpu",
+                failure=next((a.get("failure") for a in reversed(attempts)
+                              if a.get("failure")), None))
         # No stall detector on the CPU attempt: stall-kill exists to stop
         # a hung TPU compile from wedging the tunnel; a big CPU run
         # legitimately computes for longer than any beat cadence (a 10k
@@ -258,6 +269,10 @@ def supervised_sim_run(config: dict, outputs_dir: str = "outputs", *,
                 "failure": next((a.get("failure") for a in reversed(attempts)
                                  if a.get("failure")), None),
             })
+            telemetry.emit("degrade.transition", from_platform="tpu",
+                           to_platform="cpu",
+                           resumed_from_timestep=resume_t,
+                           failure=transitions[-1]["failure"])
         if attempt("cpu", cpu_env(base_env)):
             provenance.update(completed=True, final_platform="cpu")
         return provenance

@@ -33,6 +33,7 @@ import tempfile
 import time
 from typing import NamedTuple
 
+from dragg_tpu import telemetry
 from dragg_tpu.resilience import heartbeat as hb
 from dragg_tpu.resilience.taxonomy import classify_child
 
@@ -166,6 +167,12 @@ def run_supervised(argv: list[str], deadline_s: float, *,
     hb_fd, hb_path = tempfile.mkstemp(prefix="dragg_hb_")
     os.close(hb_fd)
     child_env[hb.ENV] = hb_path
+    # When this (jax-free) parent has an on-disk telemetry stream, the
+    # child joins it: its events (heartbeats, engine chunks, bench
+    # results) land in the SAME events.jsonl as the supervisor's own
+    # lifecycle records — one correlated forensic file per run.
+    if telemetry.run_dir():
+        child_env.setdefault(telemetry.ENV_DIR, telemetry.run_dir())
     out_f = (open(stdout_path, "wb") if stdout_path else
              tempfile.NamedTemporaryFile(prefix="dragg_sup_out_", delete=False))
     err_f = (open(stderr_path, "wb") if stderr_path else
@@ -183,6 +190,9 @@ def run_supervised(argv: list[str], deadline_s: float, *,
             log(f">>> {label or argv[0]} pid={proc.pid} "
                 f"deadline={deadline_s:.0f}s"
                 + (f" stall={stall_s:.0f}s" if stall_s else ""))
+        telemetry.emit("supervisor.launch", label=label or argv[0],
+                       pid=proc.pid, deadline_s=deadline_s,
+                       stall_s=stall_s)
         while True:
             rc = proc.poll()
             if rc is not None:
@@ -230,6 +240,16 @@ def run_supervised(argv: list[str], deadline_s: float, *,
             os.remove(p)
         except OSError:
             pass
+    telemetry.observe("supervisor.child_s", elapsed)
+    telemetry.emit("supervisor.exit", label=label or argv[0], rc=rc,
+                   ok=result.ok, failure=failure, timed_out=timed_out,
+                   stalled=stalled, elapsed_s=round(elapsed, 3))
+    if failure is not None:
+        # The taxonomy kind IS the event type — wedge forensics grep one
+        # stream for "failure." instead of three ad-hoc transcripts.
+        telemetry.emit("failure." + failure,  # telemetry-name-ok: kind from taxonomy.FAILURE_KINDS, each registered literally
+                       source="supervisor", label=label or argv[0],
+                       rc=rc, elapsed_s=round(elapsed, 3))
     if log:
         log(f"<<< {label or argv[0]} rc={rc} "
             f"{'ok' if result.ok else result.failure} "

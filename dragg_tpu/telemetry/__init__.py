@@ -1,0 +1,52 @@
+"""Unified run telemetry: event bus + metrics registry + span API.
+
+Round-7 tentpole.  One substrate for every observability pocket the repo
+grew separately — bench phase timers, resilience heartbeats and
+classified probe verdicts, the ``--watch`` outage transcript, per-chunk
+solver telemetry — so a run leaves ONE correlated forensic record:
+``<run_dir>/events.jsonl`` (append-only typed events) plus a final
+``metrics.json`` snapshot, both under the central name registry
+(:mod:`~dragg_tpu.telemetry.registry`; ``docs/telemetry.md`` documents
+every name, ``tools/lint.py`` rejects free-string names).
+
+Usage::
+
+    from dragg_tpu import telemetry
+
+    telemetry.init_run(run_dir)            # or $DRAGG_TELEMETRY_DIR joins lazily
+    telemetry.emit("chunk.done", t0=0, t1=24, solve_rate=1.0)
+    with telemetry.span("engine.chunk_device_s"):
+        ...device work...
+    telemetry.write_snapshot()             # <run_dir>/metrics.json
+    telemetry.close_run()
+
+Stdlib-only by contract: the jax-free resilience parents emit through
+this module, so importing it must never initialize a jax backend.
+"""
+
+from dragg_tpu.telemetry.bus import (
+    ENV_DIR,
+    EVENTS_FILE,
+    METRICS_FILE,
+    active,
+    close_run,
+    emit,
+    events_path,
+    inc,
+    init_run,
+    observe,
+    run_dir,
+    selftest,
+    set_gauge,
+    snapshot,
+    span,
+    write_snapshot,
+)
+from dragg_tpu.telemetry.registry import EVENTS, METRICS
+
+__all__ = [
+    "ENV_DIR", "EVENTS_FILE", "METRICS_FILE", "EVENTS", "METRICS",
+    "active", "close_run", "emit", "events_path", "inc", "init_run",
+    "observe", "run_dir", "selftest", "set_gauge", "snapshot", "span",
+    "write_snapshot",
+]

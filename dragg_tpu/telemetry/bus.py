@@ -1,0 +1,338 @@
+"""Run-scoped event bus + metrics registry + span API — stdlib only.
+
+One process-wide bus, explicitly opened by entry points
+(:func:`init_run`) or joined automatically from ``$DRAGG_TELEMETRY_DIR``
+(how supervised children land their events in the same stream as the
+jax-free parent that launched them).  While a bus is open:
+
+* :func:`emit` appends one typed JSON record per call to
+  ``<run_dir>/events.jsonl`` (append-only; each record carries wall
+  time, a monotonic offset, pid, and a per-process sequence number, so
+  merged multi-process streams stay ordered and attributable);
+* :func:`inc` / :func:`set_gauge` / :func:`observe` update the in-memory
+  metrics registry; :func:`snapshot` reads it and
+  :func:`write_snapshot` persists it as ``<run_dir>/metrics.json``;
+* :func:`span` times a block into a histogram metric (and emits a
+  ``span`` event), wrapping ``jax.profiler.TraceAnnotation`` when jax is
+  ALREADY imported in this process — telemetry itself never imports jax,
+  because the resilience parents that emit through it must stay jax-free
+  (a wedged tunnel hangs any backend init; see resilience.supervisor).
+
+Disabled mode (no bus open, env unset) is the default and near-free:
+every entry point is a registry membership check plus one module-global
+load — measured ≪1 µs/call (tests/test_telemetry.py pins the A/B).
+Name discipline is enforced even when disabled: an unregistered name
+raises ValueError so a typo cannot hide until a run is instrumented.
+IO failures, by contrast, are swallowed — telemetry must never kill the
+workload it observes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+from dragg_tpu.telemetry import registry
+
+ENV_DIR = "DRAGG_TELEMETRY_DIR"
+EVENTS_FILE = "events.jsonl"
+METRICS_FILE = "metrics.json"
+SCHEMA_VERSION = 1
+_SAMPLE_CAP = 256  # bounded per-histogram sample tail kept in snapshots
+
+
+def _jsonable(o):
+    """Fallback serializer: numpy scalars -> float, everything else str."""
+    try:
+        return float(o)
+    except (TypeError, ValueError):
+        return str(o)
+
+
+class _Hist:
+    __slots__ = ("count", "total", "vmin", "vmax", "last", "samples")
+
+    def __init__(self):
+        import collections
+
+        self.count = 0
+        self.total = 0.0
+        self.vmin = self.vmax = self.last = None
+        # A true bounded TAIL (the newest _SAMPLE_CAP observations), not
+        # a prefix: consumers like bench's chunk_rates want steady-state
+        # samples, and a prefix would silently drop the warmed-up end of
+        # a long series.
+        self.samples: "collections.deque[float]" = collections.deque(
+            maxlen=_SAMPLE_CAP)
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        self.last = v
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
+        self.samples.append(v)
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.vmin,
+            "max": self.vmax,
+            "mean": self.total / self.count if self.count else None,
+            "last": self.last,
+            "samples": list(self.samples),
+        }
+
+
+class _Bus:
+    def __init__(self, run_dir: str | None, jsonl: bool = True):
+        self.run_dir = run_dir
+        self.lock = threading.RLock()
+        self.seq = 0
+        self.mono0 = time.monotonic()
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.hists: dict[str, _Hist] = {}
+        self.path = None
+        self._fh = None
+        if run_dir and jsonl:
+            os.makedirs(run_dir, exist_ok=True)
+            self.path = os.path.join(run_dir, EVENTS_FILE)
+            self._fh = open(self.path, "a", encoding="utf-8")
+
+    def emit(self, event: str, fields: dict) -> None:
+        with self.lock:
+            self.seq += 1
+            rec = {"event": event, "t": round(time.time(), 3),
+                   "mono": round(time.monotonic() - self.mono0, 6),
+                   "pid": os.getpid(), "seq": self.seq}
+            rec.update(fields)
+            if self._fh is not None:
+                try:
+                    # One full line per write: POSIX O_APPEND keeps lines
+                    # from different processes whole in a shared file.
+                    self._fh.write(json.dumps(rec, default=_jsonable) + "\n")
+                    self._fh.flush()
+                except (OSError, ValueError):
+                    pass  # telemetry never kills the workload
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {
+                "schema": SCHEMA_VERSION,
+                "written_at": round(time.time(), 3),
+                "run_dir": self.run_dir,
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "histograms": {k: h.summary() for k, h in self.hists.items()},
+            }
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
+_bus: _Bus | None = None
+_env_checked = False
+_state_lock = threading.Lock()
+
+
+def _current() -> _Bus | None:
+    """The active bus, joining ``$DRAGG_TELEMETRY_DIR`` lazily on first
+    use (re-checked after every :func:`close_run`)."""
+    global _bus, _env_checked
+    bus = _bus
+    if bus is not None or _env_checked:
+        return bus
+    with _state_lock:
+        if _bus is None and not _env_checked:
+            _env_checked = True
+            d = os.environ.get(ENV_DIR)
+            if d:
+                try:
+                    _bus = _Bus(d)
+                except OSError:
+                    _bus = None
+        return _bus
+
+
+def init_run(run_dir: str | None = None, jsonl: bool = True) -> str | None:
+    """Open the process bus.  ``run_dir=None`` gives a memory-only bus
+    (metrics + spans work, no events file — what bench's measured child
+    uses unless the supervisor exported a telemetry dir).  Returns the
+    events.jsonl path, or None when memory-only."""
+    global _bus, _env_checked
+    with _state_lock:
+        if _bus is not None:
+            _bus.close()
+        _bus = _Bus(run_dir, jsonl=jsonl)
+        _env_checked = True
+        return _bus.path
+
+
+def close_run(write_metrics: bool = False) -> None:
+    """Close the bus (optionally persisting a final metrics snapshot
+    first) and re-arm the ``$DRAGG_TELEMETRY_DIR`` auto-join."""
+    global _bus, _env_checked
+    with _state_lock:
+        if _bus is not None:
+            if write_metrics and _bus.run_dir:
+                _write_snapshot_locked(_bus)
+            _bus.close()
+        _bus = None
+        _env_checked = False
+
+
+def active() -> bool:
+    return _current() is not None
+
+
+def events_path() -> str | None:
+    bus = _current()
+    return bus.path if bus else None
+
+
+def run_dir() -> str | None:
+    bus = _current()
+    return bus.run_dir if bus else None
+
+
+# ------------------------------------------------------------------ emits
+def emit(event: str, **fields) -> None:
+    """Append one typed event record to the run stream (no-op when no
+    bus is open; unregistered names raise regardless)."""
+    registry.check_event(event)
+    bus = _current()
+    if bus is not None:
+        bus.emit(event, fields)
+
+
+def inc(name: str, value: float = 1.0) -> None:
+    registry.check_metric(name, "counter")
+    bus = _current()
+    if bus is not None:
+        with bus.lock:
+            bus.counters[name] = bus.counters.get(name, 0.0) + float(value)
+
+
+def set_gauge(name: str, value: float) -> None:
+    registry.check_metric(name, "gauge")
+    bus = _current()
+    if bus is not None:
+        with bus.lock:
+            bus.gauges[name] = float(value)
+
+
+def observe(name: str, value: float) -> None:
+    registry.check_metric(name, "histogram")
+    bus = _current()
+    if bus is not None:
+        with bus.lock:
+            bus.hists.setdefault(name, _Hist()).observe(float(value))
+
+
+class span:
+    """``with telemetry.span("bench.chunk_s") as sp: ...`` — times the
+    block into the named histogram metric, emits a ``span`` event, and
+    leaves the duration on ``sp.s``.  Wraps the block in a
+    ``jax.profiler.TraceAnnotation`` when jax is already imported (so
+    spans show up in profiler traces) — never imports jax itself."""
+
+    __slots__ = ("name", "s", "_t0", "_ann")
+
+    def __init__(self, name: str):
+        registry.check_metric(name, "histogram")
+        self.name = name
+        self.s = None
+        self._ann = None
+
+    def __enter__(self):
+        if "jax" in sys.modules and _current() is not None:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._ann = TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.s = time.perf_counter() - self._t0
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:
+                pass
+        bus = _current()
+        if bus is not None:
+            with bus.lock:
+                bus.hists.setdefault(self.name, _Hist()).observe(self.s)
+            bus.emit("span", {"name": self.name, "s": round(self.s, 6)})
+        return False
+
+
+# -------------------------------------------------------------- snapshots
+def snapshot() -> dict:
+    """The current metrics registry as one JSON-able dict
+    (``{"active": False}`` when no bus is open)."""
+    bus = _current()
+    if bus is None:
+        return {"active": False}
+    return bus.snapshot()
+
+
+def _write_snapshot_locked(bus: _Bus, name: str | None = None) -> str | None:
+    if not bus.run_dir:
+        return None
+    path = os.path.join(bus.run_dir, name or METRICS_FILE)
+    try:
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(bus.snapshot(), f, indent=1, default=_jsonable)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def write_snapshot(name: str | None = None) -> str | None:
+    """Persist the metrics registry as ``<run_dir>/metrics.json``
+    (atomic tmp+rename).  Returns the path, or None when memory-only /
+    no bus / write failure.  ``name`` overrides the file name — pass a
+    distinct one when several processes share a stream dir and each
+    wants its own snapshot (bench children on a supervised pass), since
+    the default is last-writer-wins."""
+    bus = _current()
+    if bus is None:
+        return None
+    return _write_snapshot_locked(bus, name)
+
+
+def selftest() -> dict:
+    """Doctor's plumbing check: a throwaway bus in a temp dir, one emit,
+    one metric, parse the line back.  Never touches the process bus."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="dragg_tel_") as d:
+        bus = _Bus(d)
+        try:
+            bus.emit("telemetry.selftest", {"ok": True})
+            with bus.lock:
+                bus.hists.setdefault("probe.elapsed_s", _Hist()).observe(0.0)
+            with open(bus.path) as f:
+                rec = json.loads(f.read().strip().splitlines()[-1])
+            ok = rec["event"] == "telemetry.selftest" and rec["seq"] == 1
+            return {"ok": ok, "events": len(registry.EVENTS),
+                    "metrics": len(registry.METRICS)}
+        finally:
+            bus.close()

@@ -1,0 +1,130 @@
+"""The central telemetry name registry — every event and metric name, in
+one literal table.
+
+Rationale (round 7): the repo accumulated *pockets* of observability —
+bench phase timers, resilience heartbeats, the probe --watch transcript,
+per-home failure logs — each with its own ad-hoc format, so nothing
+could be correlated across a run.  The registry is the contract that
+keeps the unified stream analyzable: an emit with an unregistered name
+raises at runtime, ``tools/lint.py`` rejects free-string names
+statically, and ``docs/telemetry.md`` must document every entry (a test
+enforces the doc coverage both ways).
+
+Both tables are PURE LITERALS on purpose: the lint rule reads them via
+``ast`` without importing this package, so a computed key would be
+invisible to it.  ``tests/test_telemetry.py`` asserts the ``failure.*``
+entries stay in sync with :data:`dragg_tpu.resilience.taxonomy.FAILURE_KINDS`.
+"""
+
+from __future__ import annotations
+
+# Event name -> one-line semantics.  Field names in parentheses are the
+# payload keys the emitter attaches beyond the envelope (t/mono/pid/seq).
+EVENTS: dict[str, str] = {
+    "run.start": "simulation run began (case, homes, horizon, solver, "
+                 "run_dir)",
+    "run.end": "simulation run finished (timestep, num_timesteps, "
+               "elapsed_s, completed)",
+    "chunk.done": "one device scan chunk finished (t0, t1, n_steps, "
+                  "device_s, steps_per_s, solve_rate, solver_iters, "
+                  "r_prim_max, r_dual_max, repair_failed)",
+    "span": "a telemetry.span() block closed (name = the histogram "
+            "metric it observed, s = seconds)",
+    "bench.result": "one benchmark headline artifact mirrored onto the "
+                    "stream (result = the bench.py JSON-line dict)",
+    "probe.verdict": "classified tunnel liveness verdict (alive, kind, "
+                     "detail, backend, proxy, compile_helper, elapsed_s)",
+    "heartbeat.beat": "child progress beat under supervision (progress "
+                      "payload, if any)",
+    "supervisor.launch": "supervised child launched (label, pid, "
+                         "deadline_s, stall_s)",
+    "supervisor.exit": "supervised child exited (label, rc, ok, failure, "
+                       "timed_out, stalled, elapsed_s)",
+    "degrade.transition": "degradation policy moved platforms "
+                          "(from_platform, to_platform, "
+                          "resumed_from_timestep, failure)",
+    "telemetry.selftest": "doctor plumbing check event (written to a "
+                          "throwaway dir only)",
+    # The resilience failure taxonomy as event types (one per kind in
+    # taxonomy.FAILURE_KINDS; ``source`` says which layer classified it:
+    # "probe" or "supervisor", ``detail``/``label`` locate it).
+    "failure.TUNNEL_DOWN": "classified failure: tunnel unreachable "
+                           "(taxonomy TUNNEL_DOWN)",
+    "failure.WEDGED": "classified failure: round-4 wedge signature "
+                      "(taxonomy WEDGED)",
+    "failure.COMPILE_HANG": "classified failure: heartbeat went stale, "
+                            "child killed early (taxonomy COMPILE_HANG)",
+    "failure.VMEM_OOM": "classified failure: scoped-VMEM OOM signature "
+                        "(taxonomy VMEM_OOM)",
+    "failure.CHILD_CRASH": "classified failure: abnormal child death "
+                           "(taxonomy CHILD_CRASH)",
+    "failure.DEADLINE": "classified failure: still beating at the hard "
+                        "deadline (taxonomy DEADLINE)",
+}
+
+# Metric name -> (kind, one-line semantics).  Kinds: "counter" (monotone
+# sum), "gauge" (last value wins), "histogram" (count/sum/min/max/mean +
+# a bounded sample tail; span() observes into histograms).
+METRICS: dict[str, tuple[str, str]] = {
+    "engine.chunk_device_s": ("histogram",
+                              "device wall seconds per scan chunk"),
+    "engine.chunk_steps_per_s": ("histogram",
+                                 "achieved sim-timesteps/s per chunk"),
+    "engine.collect_s": ("histogram",
+                         "host collect seconds per chunk"),
+    "engine.solve_iters": ("histogram",
+                           "mean solver iterations per step (one sample "
+                           "per chunk)"),
+    "engine.solve_rate": ("gauge", "latest chunk mean solve rate"),
+    "engine.r_prim_max": ("gauge",
+                          "latest chunk max primal residual (f32-max "
+                          "sentinel = a home diverged non-finite)"),
+    "engine.r_dual_max": ("gauge", "latest chunk max dual residual"),
+    "engine.repair_failed": ("counter",
+                             "cumulative homes whose integer-pin repair "
+                             "failed (kept the relaxed action)"),
+    "sim.timestep": ("gauge", "latest completed sim timestep"),
+    "bench.warmup_s": ("histogram",
+                       "bench warmup (compile) chunk seconds"),
+    "bench.chunk_s": ("histogram", "bench timed chunk seconds"),
+    "bench.phase.assemble_s": ("histogram",
+                               "bench assemble-phase seconds per step"),
+    "bench.phase.solve_s": ("histogram",
+                            "bench solve-phase seconds per step (ipm — "
+                            "no factor cache, one honest key)"),
+    "bench.phase.solve_refresh_s": ("histogram",
+                                    "bench solve-phase seconds per step, "
+                                    "exact refactorization (admm)"),
+    "bench.phase.solve_cached_s": ("histogram",
+                                   "bench solve-phase seconds per step, "
+                                   "cached factor (admm)"),
+    "bench.phase.merge_collect_s": ("histogram",
+                                    "bench merge/collect-phase seconds "
+                                    "per step"),
+    "bench.rate_ts_per_s": ("gauge", "headline sim-timesteps/s"),
+    "bench.flops_per_step": ("gauge",
+                             "analytic FLOPs per sim step — the MFU "
+                             "back-fill basis when the platform peak is "
+                             "unknown"),
+    "probe.elapsed_s": ("histogram", "liveness probe wall seconds"),
+    "supervisor.child_s": ("histogram", "supervised child wall seconds"),
+}
+
+
+def check_event(name: str) -> None:
+    if name not in EVENTS:
+        raise ValueError(
+            f"unregistered telemetry event {name!r} — register it in "
+            f"dragg_tpu/telemetry/registry.py (and docs/telemetry.md)")
+
+
+def check_metric(name: str, kind: str) -> None:
+    got = METRICS.get(name)
+    if got is None:
+        raise ValueError(
+            f"unregistered telemetry metric {name!r} — register it in "
+            f"dragg_tpu/telemetry/registry.py (and docs/telemetry.md)")
+    if got[0] != kind:
+        raise ValueError(
+            f"telemetry metric {name!r} is registered as a {got[0]}, "
+            f"used as a {kind}")

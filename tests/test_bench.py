@@ -45,6 +45,13 @@ def test_bench_smoke_contract():
     # Pallas self-test must not have been attempted.
     assert result["band_kernel"] == "xla"
     assert result["pallas_selftest"] is None
+    # flops_per_step is ALWAYS populated (round 7 — analytic model,
+    # platform-free) so MFU can be back-filled from telemetry the moment
+    # a chip is reachable; mfu itself stays null off-chip (no CPU entry
+    # in the peak table).
+    assert result["flops_per_step_est"] is not None
+    assert result["flops_per_step_est"] > 0
+    assert result["mfu"] is None
 
 
 def test_bench_probe_gated_ladder_dual_report(tmp_path):

@@ -53,3 +53,37 @@ def test_device_discipline_scoping():
     assert lint._is_entry_point(os.path.join(ROOT, "bench.py"))
     assert lint._is_entry_point(os.path.join(ROOT, "tools", "x.py"))
     assert not lint._is_entry_point(os.path.join(ROOT, "dragg_tpu", "engine.py"))
+
+
+def test_telemetry_name_discipline(tmp_path):
+    """Round-7 rule: telemetry emits in dragg_tpu/, tools/, and bench.py
+    must name central-registry entries as literals; computed names need
+    the telemetry-name-ok marker."""
+    import ast
+
+    lint = _load_lint()
+    src = (
+        "from dragg_tpu import telemetry\n"
+        "telemetry.emit('chunk.done', t0=0)\n"                  # ok: registered
+        "telemetry.emit('made.up.event')\n"                     # bad
+        "telemetry.observe('engine.chunk_device_s', 1.0)\n"     # ok
+        "telemetry.span('free.string.metric')\n"                # bad
+        "kind = 'WEDGED'\n"
+        "telemetry.emit('failure.' + kind)\n"                   # bad: no marker
+        "telemetry.emit('failure.' + kind)  "
+        "# telemetry-name-ok: taxonomy kinds are registered\n"  # ok: marked
+    )
+    problems = lint.check_telemetry_names(
+        ast.parse(src), src.splitlines(), "dragg_tpu/x.py")
+    assert len(problems) == 3, problems
+    assert any("made.up.event" in p and ":3:" in p for p in problems)
+    assert any("free.string.metric" in p and ":5:" in p for p in problems)
+    assert any("computed name" in p and ":7:" in p for p in problems)
+
+
+def test_telemetry_scope():
+    lint = _load_lint()
+    assert lint._is_telemetry_scope(os.path.join(ROOT, "dragg_tpu", "engine.py"))
+    assert lint._is_telemetry_scope(os.path.join(ROOT, "bench.py"))
+    assert lint._is_telemetry_scope(os.path.join(ROOT, "tools", "x.py"))
+    assert not lint._is_telemetry_scope(os.path.join(ROOT, "tests", "x.py"))

@@ -96,15 +96,20 @@ def test_sharded_engine_all_leaves_fixed_iters(tiny_config):
                                   np.asarray(ref_out.admm_iters))
 
     per_home = {"agg_load", "forecast_load", "agg_cost", "admm_iters",
-                "repair_failed"}
+                "repair_failed", "r_prim_max", "r_dual_max"}
     for name, ref_leaf, sh_leaf in zip(
         ref_out._fields, ref_out, sh_out
     ):
         ref_a, sh_a = np.asarray(ref_leaf), np.asarray(sh_leaf)
         if name not in per_home:       # (T, n_padded) → real homes only
             sh_a = sh_a[:, :n]
+        # The telemetry residual maxima amplify per-compile fp wobble
+        # (a max over per-home residuals of non-contractive iterates) —
+        # measured ~1.4e-4 relative between layouts; the physical
+        # outputs keep the tight bound.
+        tol = 1e-3 if name in ("r_prim_max", "r_dual_max") else 1e-5
         np.testing.assert_allclose(
-            sh_a, ref_a, rtol=1e-5, atol=1e-5,
+            sh_a, ref_a, rtol=tol, atol=tol,
             err_msg=f"StepOutputs.{name} diverged between sharded and single",
         )
 
@@ -144,13 +149,14 @@ def test_sharded_engine_all_leaves_ipm(tiny_config):
     _, sh_out = sh_engine.run_chunk(sh_engine.init_state(), 0, rps)
 
     per_home = {"agg_load", "forecast_load", "agg_cost", "admm_iters",
-                "repair_failed"}
+                "repair_failed", "r_prim_max", "r_dual_max"}
     for name, ref_leaf, sh_leaf in zip(ref_out._fields, ref_out, sh_out):
         ref_a, sh_a = np.asarray(ref_leaf), np.asarray(sh_leaf)
         if name not in per_home:
             sh_a = sh_a[:, :n]
+        tol = 1e-3 if name in ("r_prim_max", "r_dual_max") else 1e-4
         np.testing.assert_allclose(
-            sh_a, ref_a, rtol=1e-4, atol=1e-4,
+            sh_a, ref_a, rtol=tol, atol=tol,
             err_msg=f"StepOutputs.{name} diverged between sharded and single",
         )
 

@@ -1,0 +1,330 @@
+"""The unified telemetry layer (round-7 tentpole): golden schema over a
+tiny CPU-mesh run's events.jsonl, disabled-mode overhead A/B, registry ⇄
+docs coverage, the shared supervised stream, and the dashboard's /live +
+/metrics.json endpoints against an in-progress run dir."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from dragg_tpu import telemetry
+from dragg_tpu.resilience.taxonomy import FAILURE_KINDS
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENVELOPE = {"event", "t", "mono", "pid", "seq"}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_bus():
+    """Every test starts and ends with no process bus (close_run also
+    re-arms the $DRAGG_TELEMETRY_DIR auto-join)."""
+    telemetry.close_run()
+    yield
+    telemetry.close_run()
+
+
+def _tiny_cfg():
+    from dragg_tpu.config import default_config
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = 3
+    cfg["community"]["homes_pv"] = 0
+    cfg["simulation"]["end_datetime"] = "2015-01-01 06"
+    cfg["simulation"]["checkpoint_interval"] = "hourly"
+    cfg["home"]["hems"]["prediction_horizon"] = 2
+    return cfg
+
+
+# ----------------------------------------------------------- registry/docs
+def test_registry_and_docs_cover_each_other():
+    """docs/telemetry.md lists every registered name, and every
+    backticked dotted name in its tables is registered — the doc cannot
+    drift from the registry in either direction."""
+    import re
+
+    with open(os.path.join(ROOT, "docs", "telemetry.md")) as f:
+        doc = f.read()
+    for name in (*telemetry.EVENTS, *telemetry.METRICS):
+        assert f"`{name}`" in doc, f"{name} undocumented in docs/telemetry.md"
+    documented = {m for m in re.findall(r"`([a-z_]+(?:\.[A-Za-z_]+)+)`", doc)
+                  if m.split(".")[0] in ("run", "chunk", "span", "bench",
+                                         "probe", "heartbeat", "supervisor",
+                                         "degrade", "failure", "telemetry",
+                                         "engine", "sim")}
+    registered = set(telemetry.EVENTS) | set(telemetry.METRICS) \
+        | {"telemetry.enabled", "telemetry.dir", "span.s"}
+    stray = {d for d in documented if d not in registered
+             and not d.startswith(("telemetry.", "docs.", "tools.",
+                                   "dragg_tpu.", "bench.py"))}
+    assert not stray, f"docs/telemetry.md names unregistered entries: {stray}"
+
+
+def test_failure_events_track_taxonomy():
+    """The failure.* event family stays in sync with the resilience
+    taxonomy (the registry is a literal table, so this is the guard)."""
+    for kind in FAILURE_KINDS:
+        assert f"failure.{kind}" in telemetry.EVENTS
+    extra = {e for e in telemetry.EVENTS if e.startswith("failure.")} \
+        - {f"failure.{k}" for k in FAILURE_KINDS}
+    assert not extra, f"registry has failure events with no taxonomy kind: {extra}"
+
+
+def test_unregistered_names_raise():
+    """Name discipline holds even with no bus open: a typo fails fast
+    instead of silently fragmenting the stream."""
+    with pytest.raises(ValueError, match="unregistered telemetry event"):
+        telemetry.emit("no.such.event")
+    with pytest.raises(ValueError, match="unregistered telemetry metric"):
+        telemetry.observe("no.such.metric", 1.0)
+    with pytest.raises(ValueError, match="registered as a gauge"):
+        telemetry.observe("engine.solve_rate", 1.0)  # gauge, not histogram
+    with pytest.raises(ValueError):
+        telemetry.span("engine.solve_rate")  # spans need a histogram
+
+
+# ------------------------------------------------------------- bus basics
+def test_span_and_snapshot_roundtrip(tmp_path):
+    telemetry.init_run(str(tmp_path))
+    with telemetry.span("engine.chunk_device_s") as sp:
+        time.sleep(0.01)
+    assert sp.s is not None and sp.s >= 0.01
+    telemetry.inc("engine.repair_failed", 2)
+    telemetry.set_gauge("engine.solve_rate", 0.75)
+    path = telemetry.write_snapshot()
+    snap = json.load(open(path))
+    assert snap["counters"]["engine.repair_failed"] == 2
+    assert snap["gauges"]["engine.solve_rate"] == 0.75
+    h = snap["histograms"]["engine.chunk_device_s"]
+    assert h["count"] == 1 and h["last"] == pytest.approx(sp.s)
+    assert h["samples"] == [pytest.approx(sp.s)]
+    # The span also left a typed event on the stream.
+    recs = [json.loads(l) for l in open(os.path.join(
+        str(tmp_path), telemetry.EVENTS_FILE))]
+    assert recs[-1]["event"] == "span"
+    assert recs[-1]["name"] == "engine.chunk_device_s"
+
+
+def test_disabled_overhead_negligible(tmp_path):
+    """Disabled-mode emits are a registry lookup + one global load —
+    the A/B pins them well under the enabled (file-writing) cost and
+    under an absolute 10 µs/call ceiling."""
+    n_off = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n_off):
+        telemetry.emit("chunk.done", t0=0, t1=1, solve_rate=1.0)
+        telemetry.observe("engine.solve_iters", 1.0)
+    off_per_call = (time.perf_counter() - t0) / (2 * n_off)
+
+    telemetry.init_run(str(tmp_path))
+    n_on = 2_000
+    t0 = time.perf_counter()
+    for _ in range(n_on):
+        telemetry.emit("chunk.done", t0=0, t1=1, solve_rate=1.0)
+        telemetry.observe("engine.solve_iters", 1.0)
+    on_per_call = (time.perf_counter() - t0) / (2 * n_on)
+
+    assert off_per_call < 10e-6, f"disabled emit {off_per_call*1e6:.2f} µs"
+    assert off_per_call < on_per_call, (
+        f"disabled ({off_per_call*1e6:.2f} µs) not cheaper than enabled "
+        f"({on_per_call*1e6:.2f} µs)")
+
+
+def test_env_dir_auto_join(tmp_path, monkeypatch):
+    """$DRAGG_TELEMETRY_DIR joins the stream lazily — how supervised
+    children (which never call init_run) land on the parent's file."""
+    monkeypatch.setenv(telemetry.ENV_DIR, str(tmp_path))
+    telemetry.emit("heartbeat.beat", progress={"x": 1})
+    recs = [json.loads(l) for l in open(os.path.join(
+        str(tmp_path), telemetry.EVENTS_FILE))]
+    assert recs[0]["event"] == "heartbeat.beat"
+    assert recs[0]["progress"] == {"x": 1}
+
+
+# -------------------------------------------------------- golden run schema
+def test_tiny_run_events_golden_schema(tmp_path):
+    """A default tiny CPU-mesh run produces <run_dir>/events.jsonl +
+    metrics.json matching the docs/telemetry.md schema: enveloped
+    records, registered names only, per-process monotone seq/mono, and
+    the engine's device-side solver telemetry on every chunk.done."""
+    from dragg_tpu.aggregator import Aggregator
+
+    agg = Aggregator(_tiny_cfg(), data_dir=None,
+                     outputs_dir=str(tmp_path / "out"))
+    agg.run()
+
+    events = os.path.join(agg.run_dir, telemetry.EVENTS_FILE)
+    metrics = os.path.join(agg.run_dir, telemetry.METRICS_FILE)
+    assert os.path.isfile(events) and os.path.isfile(metrics)
+
+    recs = [json.loads(line) for line in open(events)]
+    assert recs, "events.jsonl is empty"
+    last_seq = {}
+    last_mono = {}
+    for rec in recs:
+        assert ENVELOPE <= set(rec), f"envelope missing in {rec}"
+        assert rec["event"] in telemetry.EVENTS, rec["event"]
+        assert rec["seq"] > last_seq.get(rec["pid"], 0)
+        assert rec["mono"] >= last_mono.get(rec["pid"], 0.0)
+        last_seq[rec["pid"]] = rec["seq"]
+        last_mono[rec["pid"]] = rec["mono"]
+
+    by_event = {}
+    for rec in recs:
+        by_event.setdefault(rec["event"], []).append(rec)
+    assert by_event["run.start"][0]["homes"] == 3
+    assert by_event["run.end"][-1]["completed"] is True
+    chunks = by_event["chunk.done"]
+    assert len(chunks) == 6  # hourly checkpoints over a 6 h window
+    for c in chunks:
+        for field in ("t0", "t1", "n_steps", "solve_rate", "solver_iters",
+                      "r_prim_max", "r_dual_max", "repair_failed",
+                      "device_s", "steps_per_s"):
+            assert field in c, f"chunk.done missing {field}"
+        assert 0.0 <= c["solve_rate"] <= 1.0
+        assert c["r_prim_max"] >= 0.0 and c["r_dual_max"] >= 0.0
+    assert chunks[-1]["t1"] == 6
+
+    snap = json.load(open(metrics))
+    for section, table in (("counters", telemetry.METRICS),
+                           ("gauges", telemetry.METRICS),
+                           ("histograms", telemetry.METRICS)):
+        for name in snap[section]:
+            assert name in table, f"unregistered {section} name {name}"
+    assert snap["gauges"]["sim.timestep"] == 6
+    assert snap["histograms"]["engine.chunk_device_s"]["count"] == 6
+    assert 0.0 <= snap["gauges"]["engine.solve_rate"] <= 1.0
+
+
+def test_telemetry_disabled_writes_nothing(tmp_path):
+    cfg = _tiny_cfg()
+    cfg["simulation"]["end_datetime"] = "2015-01-01 02"
+    cfg["telemetry"] = {"enabled": False}
+    from dragg_tpu.aggregator import Aggregator
+
+    agg = Aggregator(cfg, data_dir=None, outputs_dir=str(tmp_path / "out"))
+    agg.run()
+    assert not os.path.isfile(os.path.join(agg.run_dir,
+                                           telemetry.EVENTS_FILE))
+    assert not os.path.isfile(os.path.join(agg.run_dir,
+                                           telemetry.METRICS_FILE))
+
+
+# -------------------------------------------------- shared supervised stream
+def test_supervisor_and_child_share_one_stream(tmp_path):
+    """The supervisor's lifecycle records and the child's beats land in
+    the SAME events.jsonl: the parent exports $DRAGG_TELEMETRY_DIR, the
+    child auto-joins (the round-7 'one forensic file per run' contract)."""
+    from dragg_tpu.resilience.supervisor import run_supervised
+
+    telemetry.init_run(str(tmp_path))
+    child = ("import sys; sys.path.insert(0, %r); "
+             "from dragg_tpu.resilience.heartbeat import beat; "
+             "beat({'stage': 'child-proof'})" % ROOT)
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    res = run_supervised([sys.executable, "-c", child], deadline_s=60.0,
+                         label="telemetry-child", env=env)
+    assert res.ok, res.stderr_tail
+    recs = [json.loads(l) for l in open(os.path.join(
+        str(tmp_path), telemetry.EVENTS_FILE))]
+    names = [r["event"] for r in recs]
+    assert "supervisor.launch" in names
+    assert "supervisor.exit" in names
+    beats = [r for r in recs if r["event"] == "heartbeat.beat"]
+    assert beats and beats[0]["progress"] == {"stage": "child-proof"}
+    assert beats[0]["pid"] != os.getpid(), "beat must come from the child"
+
+
+def test_probe_watcher_emits_jsonl_transcript(tmp_path):
+    """tools/tpu_probe.py routes its outage/uptime transcript through
+    the telemetry schema (probe.verdict + failure.<kind>) — the
+    watcher, supervisor, and runbook share one forensic format."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["DRAGG_FAULT_INJECT"] = "probe_down"  # deterministic, no subprocess probe
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpu_probe.py"),
+         "--log", str(tmp_path / "probe_log.txt"),
+         "--events-dir", str(tmp_path), "--classify"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=ROOT)
+    assert proc.returncode == 1, proc.stdout + proc.stderr  # DOWN
+    recs = [json.loads(l) for l in open(os.path.join(
+        str(tmp_path), telemetry.EVENTS_FILE))]
+    verdicts = [r for r in recs if r["event"] == "probe.verdict"]
+    assert verdicts and verdicts[0]["alive"] is False
+    assert verdicts[0]["kind"] == "TUNNEL_DOWN"
+    fails = [r for r in recs if r["event"] == "failure.TUNNEL_DOWN"]
+    assert fails and fails[0]["source"] == "probe"
+    # Legacy text transcript still appended alongside.
+    assert "DOWN" in open(tmp_path / "probe_log.txt").read()
+
+
+# ------------------------------------------------------- dashboard live view
+def _write_in_progress_run(outputs_dir: str) -> str:
+    """An in-progress run dir: events.jsonl, no metrics.json, no
+    results.json — invisible to figure discovery, visible to /live."""
+    run_dir = os.path.join(outputs_dir, "2015-01-01T00_2015-01-02T00",
+                           "all-homes_3-horizon_2-interval_60-10-solver_ipm",
+                           "version-test")
+    telemetry.init_run(run_dir)
+    telemetry.emit("run.start", case="baseline", homes=3, horizon=2,
+                   solver="ipm", run_dir=run_dir)
+    telemetry.emit("chunk.done", t0=0, t1=24, n_steps=24, solve_rate=0.99,
+                   solver_iters=12.0, r_prim_max=1e-4, r_dual_max=1e-5,
+                   repair_failed=0, device_s=1.5, steps_per_s=16.0)
+    telemetry.close_run()
+    return run_dir
+
+
+def test_dashboard_live_and_metrics_endpoints(tmp_path):
+    from dragg_tpu.dashboard import Dashboard, make_handler
+    from http.server import ThreadingHTTPServer
+
+    outputs = str(tmp_path / "out")
+    run_dir = _write_in_progress_run(outputs)
+    dash = Dashboard(outputs_dir=outputs)
+
+    # Render side: the stream is discovered as in-progress and the
+    # partial snapshot folds from the events (no metrics.json yet).
+    runs = dash.live_runs()
+    assert len(runs) == 1 and runs[0]["final"] is False
+    snap = dash.metrics_snapshot(runs[0])
+    assert snap["final"] is False
+    assert snap["by_event"] == {"run.start": 1, "chunk.done": 1}
+    assert snap["last"]["chunk.done"]["solve_rate"] == 0.99
+    html = dash.live_html("")
+    assert "chunk.done" in html and "in progress" in html
+
+    # HTTP side: /live and /metrics.json answer over a real socket.
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(dash))
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        opener = urllib.request.build_opener(
+            urllib.request.ProxyHandler({}))
+        live = opener.open(f"{base}/live", timeout=30).read().decode()
+        assert "chunk.done" in live
+        m = json.loads(opener.open(f"{base}/metrics.json?run=0",
+                                   timeout=30).read())
+        assert m["final"] is False and m["by_event"]["chunk.done"] == 1
+        # Once the run finishes (metrics.json lands), the endpoint
+        # serves the final snapshot instead of the event fold.
+        telemetry.init_run(run_dir)
+        telemetry.set_gauge("sim.timestep", 24)
+        telemetry.write_snapshot()
+        telemetry.close_run()
+        m2 = json.loads(opener.open(f"{base}/metrics.json?run=0",
+                                    timeout=30).read())
+        assert m2["final"] is True
+        assert m2["gauges"]["sim.timestep"] == 24
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

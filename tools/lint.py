@@ -15,7 +15,14 @@ what the stdlib can check:
   lines that legitimately run in a supervised child carry a
   ``# device-call-ok: <why>`` marker — and no un-deadlined
   ``subprocess.run/check_output/check_call/call`` (a child that can
-  hang forever defeats the supervision; pass ``timeout=``).
+  hang forever defeats the supervision; pass ``timeout=``);
+* telemetry-name discipline in `dragg_tpu/`, `tools/`, and `bench.py`
+  (round 7): every ``telemetry.emit/span/observe/inc/set_gauge`` call
+  must name an entry of the central registry
+  (dragg_tpu/telemetry/registry.py) as a string LITERAL — free strings
+  fragment the unified stream the registry exists to keep analyzable.
+  Computed names carry a ``# telemetry-name-ok: <why>`` marker (e.g.
+  the taxonomy-kind events, whose kinds are each registered literally).
 
 The full flake8/autoflake hooks run via .pre-commit-config.yaml and CI
 where those tools are installable; this script is the offline floor and
@@ -78,6 +85,79 @@ def _is_entry_point(path: str) -> bool:
     return rel == "bench.py" or rel.startswith("tools" + os.sep)
 
 
+# Telemetry-name discipline (round 7): emits in framework + entry-point
+# code must reference the central registry so the unified event stream
+# stays analyzable (one schema, documented in docs/telemetry.md).
+_TELEMETRY_FNS = {"emit": "EVENTS", "span": "METRICS", "observe": "METRICS",
+                  "inc": "METRICS", "set_gauge": "METRICS"}
+_TELEMETRY_MARKER = "# telemetry-name-ok:"
+_REGISTRY_PATH = os.path.join(ROOT, "dragg_tpu", "telemetry", "registry.py")
+_registry_names_cache: dict | None = None
+
+
+def _telemetry_registry() -> dict | None:
+    """{'EVENTS': set, 'METRICS': set} parsed from the registry module's
+    literal tables via ast (no import — lint stays dependency-free)."""
+    global _registry_names_cache
+    if _registry_names_cache is not None:
+        return _registry_names_cache
+    try:
+        with open(_REGISTRY_PATH, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    names: dict = {"EVENTS": set(), "METRICS": set()}
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.Assign, ast.AnnAssign))):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id in names \
+                    and isinstance(node.value, ast.Dict):
+                names[t.id] |= {k.value for k in node.value.keys
+                                if isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)}
+    _registry_names_cache = names
+    return names
+
+
+def _is_telemetry_scope(path: str) -> bool:
+    rel = os.path.relpath(path, ROOT)
+    return (rel == "bench.py" or rel.startswith("tools" + os.sep)
+            or rel.startswith("dragg_tpu" + os.sep))
+
+
+def check_telemetry_names(tree, lines: list[str], rel: str) -> list[str]:
+    reg = _telemetry_registry()
+    if reg is None:
+        return []
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+                and fn.value.id == "telemetry" and fn.attr in _TELEMETRY_FNS):
+            continue
+        table = _TELEMETRY_FNS[fn.attr]
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        arg = node.args[0] if node.args else None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in reg[table]:
+                problems.append(
+                    f"{rel}:{node.lineno}: telemetry.{fn.attr}"
+                    f"({arg.value!r}) names nothing in registry.{table} — "
+                    f"register it in dragg_tpu/telemetry/registry.py (and "
+                    f"docs/telemetry.md)")
+        elif _TELEMETRY_MARKER not in line:
+            problems.append(
+                f"{rel}:{node.lineno}: telemetry.{fn.attr}() with a "
+                f"computed name — pass a registry literal, or mark the "
+                f"line '{_TELEMETRY_MARKER} <why>' if every runtime value "
+                f"is registered")
+    return problems
+
+
 def check_device_discipline(tree, lines: list[str], rel: str) -> list[str]:
     problems = []
     for node in ast.walk(tree):
@@ -137,6 +217,8 @@ def check_file(path: str) -> list[str]:
         problems.append(f"{rel}:{lineno}: unused import '{name}'")
     if _is_entry_point(path):
         problems.extend(check_device_discipline(tree, lines, rel))
+    if _is_telemetry_scope(path):
+        problems.extend(check_telemetry_names(tree, lines, rel))
     return problems
 
 

@@ -138,6 +138,13 @@ def run_pass(out: str, probe_timeout: float = 60.0) -> int:
     os.makedirs(out, exist_ok=True)
     probe_log = os.path.join(out, "probe_log.txt")
     transcript = os.path.join(out, "runbook.log")
+    # One telemetry stream per pass (<out>/events.jsonl): probe verdicts,
+    # supervisor lifecycle, and the stage children's own events (the
+    # supervisor exports $DRAGG_TELEMETRY_DIR) — one forensic file per
+    # on-chip window (docs/telemetry.md).
+    from dragg_tpu import telemetry
+
+    telemetry.init_run(out)
 
     def log(msg: str) -> None:
         line = f"[{time.strftime('%H:%M:%S')}] {msg}"

@@ -1,9 +1,11 @@
 """TPU-tunnel liveness CLI over the shared subprocess probe
 (dragg_tpu/utils/probe.py) with a committed transcript.
 
-Every call appends one timestamped line to the log file, building the
-outage/uptime record the round-3 verdict said was missing (weak #5:
-"the outage record is narrative, not artifact").
+Every call appends one timestamped line to the legacy text log AND one
+``probe.verdict`` record to a telemetry event stream (events.jsonl —
+dragg_tpu/telemetry), so the watcher, the resilience supervisor, bench's
+ladder, and the runbook all share ONE forensic format (round 7; the
+round-3 verdict's missing outage record was the text log's origin).
 
 Usage:
   python tools/tpu_probe.py [--log docs/onchip_r4/probe_log.txt]
@@ -12,7 +14,11 @@ Usage:
       additionally print the classified verdict JSON (resilience
       taxonomy: alive / TUNNEL_DOWN / WEDGED + wedge-signature fields)
   python tools/tpu_probe.py --watch 180
-      probe forever at that cadence (for a background watcher)
+      probe forever at that cadence (for a background watcher); the
+      outage/uptime transcript accumulates in the event stream
+  python tools/tpu_probe.py --events-dir docs/onchip_r7
+      route the event stream (default: the --log file's directory;
+      pass '' to disable and keep only the text log)
 """
 
 import argparse
@@ -23,6 +29,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from dragg_tpu import telemetry  # noqa: E402
 from dragg_tpu.resilience.liveness import check_liveness  # noqa: E402
 
 
@@ -34,7 +41,18 @@ def main():
                     help="print the classified verdict as a JSON line")
     ap.add_argument("--watch", type=float, default=0.0,
                     help="probe forever at this cadence in seconds")
+    ap.add_argument("--events-dir", default=None,
+                    help="directory for the telemetry event stream "
+                         "(events.jsonl; default: alongside --log, "
+                         "'' disables)")
     args = ap.parse_args()
+
+    events_dir = (args.events_dir if args.events_dir is not None
+                  else os.path.dirname(args.log) or ".")
+    if events_dir:
+        # One stream per watcher: check_liveness emits probe.verdict
+        # (and failure.<kind>) onto it for every probe below.
+        telemetry.init_run(events_dir)
 
     while True:
         report = check_liveness(args.timeout, log_path=args.log)
