@@ -92,7 +92,8 @@ def _log(msg: str) -> None:
 
 def build(n_homes: int, horizon_hours: int, admm_iters: int,
           solver: str = "admm", band_kernel: str | None = None,
-          data_dir: str | None = None, semantics: str = "default"):
+          data_dir: str | None = None, semantics: str = "default",
+          bucketed: str = "auto"):
     """Build THE benchmark community engine (population mix, sim window,
     solver config).  This is the one definition of the measured community —
     tools/bench_engine_kernels.py reuses it so kernel A/B verdicts are
@@ -119,6 +120,7 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
     cfg["home"]["hems"]["prediction_horizon"] = horizon_hours
     cfg["tpu"]["admm_iters"] = admm_iters
     cfg["home"]["hems"]["solver"] = solver
+    cfg["tpu"]["bucketed"] = bucketed
     if band_kernel is not None:
         cfg["tpu"]["band_kernel"] = band_kernel
     if semantics != "default":
@@ -154,7 +156,11 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
     _log("constructing engine (device commit + jit wrap)...")
     engine = make_engine(batch, env, cfg, 0)
     _log(f"engine ready: band_kernel={engine.band_kernel} "
-         f"bw={engine.band_bw}")
+         f"bw={engine.band_bw} bucketed={engine.bucketed}")
+    if engine.bucketed:
+        _log("buckets: " + ", ".join(
+            f"{b['name']}×{b['n_real']} (m={b['m_eq']}, n={b['n_var']})"
+            for b in engine.bucket_info()))
     return engine, np
 
 
@@ -197,7 +203,8 @@ def run_measured(args) -> dict:
     _log(f"building engine: {args.homes} homes, {args.horizon_hours}h horizon")
     engine, np = build(args.homes, args.horizon_hours, args.admm_iters,
                        solver="admm" if args.solver == "auto" else args.solver,
-                       data_dir=args.data_dir, semantics=args.semantics)
+                       data_dir=args.data_dir, semantics=args.semantics,
+                       bucketed=args.bucketed)
     solver_used = engine.params.solver
     if args.solver == "auto":
         # Race the two solver families over SEVERAL sequential steps and
@@ -211,7 +218,8 @@ def run_measured(args) -> dict:
             engine_ipm, _ = build(args.homes, args.horizon_hours,
                                   args.admm_iters, solver="ipm",
                                   data_dir=args.data_dir,
-                                  semantics=args.semantics)
+                                  semantics=args.semantics,
+                                  bucketed=args.bucketed)
 
             def steps_time(eng, k=6, budget_s=60.0):
                 """Mean warm-step time over up to k steps, stopping early
@@ -288,8 +296,33 @@ def run_measured(args) -> dict:
     hists = telemetry.snapshot()["histograms"]
     compile_s = hists["bench.warmup_s"]["last"]
     chunk_rates = [steps / s for s in hists["bench.chunk_s"]["samples"]]
-    rate = max(chunk_rates)  # steady-state rate; chunks differ only by noise
+    # Best chunk as the headline (cross-round comparability).  Chunks do
+    # NOT differ only by noise: later chunks cover later sim windows whose
+    # problems are measurably harder (BENCH_r05's [0.15, 0.112, 0.11] decay
+    # reproduced at 512 homes — mean IPM iters 10.2 → 15.8 with solve rate
+    # 0.96 → 0.81 as t advances, while re-running a FIXED (t, state) chunk
+    # holds rate constant, ruling out host-side accumulation —
+    # docs/perf_notes.md round 8).  chunk_rates carries the full profile.
+    rate = max(chunk_rates)
     telemetry.set_gauge("bench.rate_ts_per_s", rate)
+
+    # Per-bucket telemetry (type-bucketed engine): solve rate per bucket
+    # from the last timed chunk's per-home mask; the per-bucket solve-phase
+    # timers join below, inside the phase-profiling block.
+    binfo = engine.bucket_info()
+    bucket_stats = None
+    if engine.bucketed:
+        cs = np.asarray(outs.correct_solve)
+        bucket_stats = {
+            b["name"]: {
+                "n_homes": b["n_real"], "m_eq": b["m_eq"],
+                "n_var": b["n_var"],
+                "solve_rate": round(float(
+                    cs[:, b["start_slot"]:b["start_slot"] + b["n_real"]]
+                    .mean()), 4),
+            }
+            for b in binfo
+        }
 
     # --- Phase breakdown (separately jitted; attribution, not headline).
     phases = None
@@ -335,12 +368,32 @@ def run_measured(args) -> dict:
                    solve, state, qp, fcarry, no_refresh)
         timeit("bench.phase.merge_collect_s",
                fin, state, jt, sol, aux, warm_sol)
+        # Per-bucket solve attribution (type-bucketed engine): one
+        # separately-jitted assemble+solve per bucket (engine.bucket_
+        # solve_fns), observed into the per-type registry literals so the
+        # A/B artifacts can show WHERE the bucketed win comes from.
+        _BUCKET_SOLVE_METRICS = {
+            "pv_battery": "bench.phase.solve_pv_battery_s",
+            "pv_only": "bench.phase.solve_pv_only_s",
+            "battery_only": "bench.phase.solve_battery_only_s",
+            "base": "bench.phase.solve_base_s",
+        }
+        for bname, bfn in engine.bucket_solve_fns():
+            jax.block_until_ready(bfn(state, jt, jrp, refresh, factor0))
+            timeit(_BUCKET_SOLVE_METRICS[bname],
+                   bfn, state, jt, jrp, refresh, factor0)
         pfx = "bench.phase."
         phases = {
             name[len(pfx):-len("_s")]: h["mean"]
             for name, h in telemetry.snapshot()["histograms"].items()
             if name.startswith(pfx)
         }
+        if bucket_stats is not None:
+            for bname in list(bucket_stats):
+                key = f"solve_{bname}"
+                if key in phases:
+                    bucket_stats[bname]["solve_s_per_step"] = round(
+                        phases[key], 4)
         _log(f"phases (s/step): {phases}")
     except Exception as e:  # profiling must never sink the benchmark
         phases = None
@@ -359,11 +412,15 @@ def run_measured(args) -> dict:
     # charged once per admm_refactor_every steps, matching the factor-cache
     # cadence (in-loop adaptive-rho refactors add more; warm-started steady
     # state rarely triggers them).
-    B, m = args.homes, engine.layout.m_eq
+    # Shapes come from bucket_info so the same sums cover both engines:
+    # unbucketed = one superset entry (B, m); bucketed = per-type entries
+    # summed (iters is the binding bucket's count — a slight overestimate
+    # for buckets that freeze earlier).
     K = max(1, engine.params.admm_refactor_every)
     mean_iters = float(np.mean(iters_per_step))
-    flops_iter = 6.0 * B * m * m
-    flops_factor = (1 / 3 + 1 + 1) * B * m**3
+    flops_iter = sum(6.0 * b["n_slots"] * b["m_eq"] ** 2 for b in binfo)
+    flops_factor = sum((1 / 3 + 1 + 1) * b["n_slots"] * b["m_eq"] ** 3
+                       for b in binfo)
     flops_per_step = mean_iters * flops_iter + flops_factor / K
     mfu = peak = None
     for key, val in PEAK_FLOPS:
@@ -382,23 +439,23 @@ def run_measured(args) -> dict:
         # but a populated value lets artifacts show HOW far this solver
         # sits from the MXU roofline instead of reporting null
         # (VERDICT r4 next-2).
-        nnz = engine.static.pattern.nnz
-        if engine.band_bw is not None:
-            bwp1 = engine.band_bw + 1
-            flops_iter_ipm = B * (2.0 * m * bwp1 * bwp1
-                                  + 10 * 2.0 * m * bwp1
-                                  + 6 * 2.0 * nnz)
-        else:
+        def ipm_iter_flops(b):
+            if b["band_bw"] is not None:
+                bwp1 = b["band_bw"] + 1
+                return b["n_slots"] * (2.0 * b["m_eq"] * bwp1 * bwp1
+                                       + 10 * 2.0 * b["m_eq"] * bwp1
+                                       + 6 * 2.0 * b["nnz"])
             # Band plan disabled → the factorization is a dense per-home
             # Cholesky: m³/3 plus ~10 triangular-solve passes at 2·m²
             # MACs and the same sparse matvecs.  flops_per_step is ALWAYS
             # populated (round 7): the analytic model is platform-free,
             # so MFU can be back-filled from telemetry the moment a chip
             # is reachable instead of staying null until a re-run.
-            flops_iter_ipm = B * (m ** 3 / 3.0
-                                  + 10 * 2.0 * m * m
-                                  + 6 * 2.0 * nnz)
-        flops_per_step = mean_iters * flops_iter_ipm
+            return b["n_slots"] * (b["m_eq"] ** 3 / 3.0
+                                   + 10 * 2.0 * b["m_eq"] ** 2
+                                   + 6 * 2.0 * b["nnz"])
+
+        flops_per_step = mean_iters * sum(ipm_iter_flops(b) for b in binfo)
         if peak:
             mfu = (flops_per_step * rate) / peak
         # The IPM is bandwidth-bound: per iteration the fused band kernels
@@ -411,15 +468,17 @@ def run_measured(args) -> dict:
         # width comes from the engine's actual RCM plan (bw=4 at the MPC
         # pattern today) rather than a hardcoded literal, so a pattern
         # change can't silently skew hbm_util (ADVICE r2).
-        if engine.band_bw is None:
+        if any(b["band_bw"] is None for b in binfo):
             # Band plan disabled: the analytic model below is specific to
             # the banded path — substituting a literal bandwidth here would
             # silently skew hbm_util for that configuration (ADVICE r3);
             # emit null instead.
             bytes_per_step = hbm_util = None
         else:
-            bw_band = engine.band_bw + 1
-            bytes_iter = B * m * 4 * (9 * bw_band + 6 * 4 + 8)
+            bytes_iter = sum(
+                b["n_slots"] * b["m_eq"] * 4 * (9 * (b["band_bw"] + 1)
+                                                + 6 * 4 + 8)
+                for b in binfo)
             bytes_per_step = mean_iters * bytes_iter
             for key, val in PEAK_HBM_BW:
                 if key in str(device_kind).lower():
@@ -481,6 +540,11 @@ def run_measured(args) -> dict:
         "band_kernel": (engine.admm_band_kernel if solver_used == "admm"
                         else engine.band_kernel),
         "pallas_selftest": pallas_band._SELFTEST,
+        # Whether the type-bucketed engine ran (tpu.bucketed resolution)
+        # and, when it did, each bucket's shape + solve rate (+ per-bucket
+        # solve s/step when phase profiling succeeded).
+        "bucketed": engine.bucketed,
+        "buckets": bucket_stats,
         "horizon_steps": H,
         "chunk_rates": [round(r, 3) for r in chunk_rates],
         "compile_s": round(compile_s, 1),
@@ -518,6 +582,7 @@ def child_argv(args, platform: str, attempt: int,
         "--chunks", str(chunks), "--admm-iters", str(args.admm_iters),
         "--solver", args.solver,
         "--semantics", args.semantics,
+        "--bucketed", args.bucketed,
     ]
     if data_dir is not None:
         # "" is meaningful — it forces the synthetic generators (the
@@ -543,6 +608,11 @@ def main() -> None:
                          "saves half a constrained TPU window; auto: race "
                          "both over several warm steps and keep the winner")
     ap.add_argument("--platform", choices=["auto", "tpu", "cpu"], default="auto")
+    ap.add_argument("--bucketed", choices=["auto", "true", "false"],
+                    default="auto",
+                    help="type-bucketed shape specialization (tpu.bucketed): "
+                         "auto (default) buckets the bench mix; false pins "
+                         "the one-batch superset path for A/Bs")
     ap.add_argument("--semantics", choices=["default", "integer", "relaxation"],
                     default="default",
                     help="integer = integer_first_action repair (the shipped "

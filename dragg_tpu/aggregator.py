@@ -203,6 +203,11 @@ class Aggregator:
                 f"({self.engine.true_n_homes} real)")
         else:
             self.engine = make_engine(batch, self.env, self.config, self.start_index)
+        if self.engine.bucketed:
+            self.log.logger.info(
+                "type-bucketed engine: " + ", ".join(
+                    f"{b['name']}×{b['n_real']} (m={b['m_eq']}, n={b['n_var']})"
+                    for b in self.engine.bucket_info()))
 
     # ------------------------------------------------------------- data mgmt
     def _home_selected(self, home: dict) -> bool:
@@ -277,16 +282,22 @@ class Aggregator:
         from dragg_tpu.checkpoint import to_host
 
         n_true = getattr(self.engine, "true_n_homes", None) or self.engine.n_homes
+        # Sharded engines pad the home axis (whole-batch padding at the
+        # end, or per-bucket padding at bucket boundaries when the engine
+        # is type-bucketed); real_home_cols maps slot order back to the
+        # true community order either way.
+        cols = getattr(self.engine, "real_home_cols", None)
+        if cols is None:
+            cols = np.arange(n_true)
         host = {}
         for f in StepOutputs._fields:
             # to_host all-gathers leaves that span processes (multi-host
             # pods) — it is a collective, so it runs on every process even
             # though only process 0 writes files.
             a = to_host(getattr(outs, f))
-            # Sharded engines pad the home axis to a mesh multiple; the
-            # replica homes are masked out of aggregates on device and
+            # Replica homes are masked out of aggregates on device and
             # dropped from per-home series here.
-            host[f] = a[:, :n_true] if a.ndim == 2 else a
+            host[f] = a[:, cols] if a.ndim == 2 else a
         n_steps = host["p_grid"].shape[0]
         for out_key, field in (*_BASE_KEYS.items(), *_PV_KEYS.items(), *_BATT_KEYS.items()):
             self.collector.add_chunk(out_key, host[field])
@@ -600,6 +611,14 @@ class Aggregator:
             # "invalidate, don't crash" dimension (advisor finding, r4).
             "warm_cols": (self.engine.warm_cols
                           if self.engine is not None else None),
+            # Type-bucketed state is a per-bucket tuple whose leaf shapes
+            # depend on the bucket partition — a checkpoint from a
+            # different tpu.bucketed resolution (or home mix) must start
+            # fresh, not crash in the leaf-count/shape check.
+            "buckets": ([[b["name"], b["n_slots"]]
+                         for b in self.engine.bucket_info()]
+                        if self.engine is not None and self.engine.bucketed
+                        else None),
             "horizon": int(self.config["home"]["hems"]["prediction_horizon"]),
             # Shard files are per-process; a checkpoint from a different
             # process topology must start fresh, not mis-assemble.

@@ -286,6 +286,15 @@ _DEFAULT: dict[str, Any] = {
         "band_kernel": "auto",  # band factor/solve impl: "pallas" (fused TPU
                                 # kernels, ops/pallas_band.py) | "xla" (scan
                                 # path) | "auto" = pallas on TPU, xla elsewhere
+        "bucketed": "auto",  # type-bucketed shape specialization: solve each
+                             # home-type bucket at its own (n, m) shape
+                             # instead of padding every home to the superset
+                             # pv_battery layout (base homes carry ~33%
+                             # smaller band factors).  "auto" buckets when
+                             # the community is >=32 homes and >=25% of
+                             # them are non-superset (engine.BUCKETED_MIN_*;
+                             # thresholds from the 512-home A/B, perf notes
+                             # round 8); true/false force either path
         "forecast_noise_cap": 3.0,  # max forecast-noise std (degC): the reference's
                                     # unbounded 1.1^k growth breaks the season gate
                                     # beyond ~16h horizons (see engine._prepare)

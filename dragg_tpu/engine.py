@@ -42,7 +42,9 @@ from dragg_tpu.models.fallback import fallback_control
 from dragg_tpu.ops.admm import FactorCarry, admm_solve_qp_cached, init_factor_carry
 from dragg_tpu.ops.qp import (
     QPLayout,
+    SUPERSET_SPEC,
     TAP_TEMP,
+    TYPE_SPECS,
     assemble_qp_step,
     build_qp_static,
     recover_solution,
@@ -50,6 +52,103 @@ from dragg_tpu.ops.qp import (
 )
 
 WINTER_MAX_OAT = 30.0  # season switch threshold, degC (dragg/mpc_calc.py:303)
+
+# ``tpu.bucketed = "auto"`` enables type-bucketed solving when BOTH hold
+# (thresholds set from the 512-home CPU A/B, docs/perf_notes.md round 8:
+# the per-bucket compile multiplication only pays for itself once enough
+# homes shed their dead battery/PV blocks):
+BUCKETED_MIN_HOMES = 32   # below this the extra compiles dominate any win
+BUCKETED_MIN_FRAC = 0.25  # min fraction of homes with a non-superset shape
+
+
+def resolve_bucket_plan(bucketed: str, type_code) -> list[tuple[str, int, int]] | None:
+    """Resolve the ``tpu.bucketed`` tri-state against a community's type
+    codes: the list of contiguous ``(type_name, start, stop)`` buckets to
+    solve at type-specialized shapes, or ``None`` for the one-batch
+    superset path.
+
+    ``"auto"`` buckets only when the community is big enough and enough
+    homes are non-superset (see ``BUCKETED_MIN_*``); ``"true"`` forces
+    bucketing (raising if the homes are not grouped by type — slicing
+    needs the materialization order of ``homes.create_homes``);
+    ``"false"`` forces the superset batch."""
+    from dragg_tpu.homes import TYPE_CODES, type_bucket_ranges
+
+    if bucketed == "false":
+        return None
+    ranges = type_bucket_ranges(type_code)
+    if bucketed == "true":
+        if ranges is None:
+            raise ValueError(
+                "tpu.bucketed=true needs homes grouped by type (the "
+                "create_homes materialization order); this batch "
+                "interleaves types")
+        return ranges
+    if ranges is None:
+        return None
+    codes = np.asarray(type_code)
+    n = codes.size
+    non_superset = int(np.sum(codes != TYPE_CODES["pv_battery"]))
+    if n < BUCKETED_MIN_HOMES or non_superset < BUCKETED_MIN_FRAC * n:
+        return None
+    return ranges
+
+
+class _TypeBucket:
+    """One home-type bucket's compiled-shape context: the type-specialized
+    layout/static/pattern plus the bucket's slice of every per-home device
+    constant.  Array attributes are swapped for traced values while the
+    jitted entry points trace (:meth:`Engine._bound`), exactly like the
+    engine-level constants."""
+
+    ARRAY_ATTRS = ("draws", "tank", "check_mask", "home_idx")
+
+    def __init__(self, *, name, spec, lay, comm_start, n_real, start_slot,
+                 n, static, batch, draws, tank, check_mask, home_idx,
+                 band_plan, solve_backend):
+        self.name = name            # home type ("pv_battery" … "base")
+        self.spec = spec
+        self.lay = lay
+        self.comm_start = comm_start  # first home in community order
+        self.n_real = n_real          # real homes in the bucket
+        self.start_slot = start_slot  # first slot in merged output order
+        self.n = n                    # slot count (shard-padded)
+        self.static = static
+        self.batch = batch
+        self.draws = draws
+        self.tank = tank
+        self.check_mask = check_mask
+        self.home_idx = home_idx      # global community index per slot
+        self.band_plan = band_plan
+        self.solve_backend = solve_backend
+
+
+class _SupersetView:
+    """Bucket-interface view of the whole superset-shaped engine, so the
+    per-bucket step phases are the only implementation — the unbucketed
+    path is the single-bucket special case.  Array reads delegate to the
+    live engine attributes so the :meth:`Engine._bound` tracing swap flows
+    through unchanged."""
+
+    name = "superset"
+    spec = SUPERSET_SPEC
+    comm_start = 0
+    start_slot = 0
+
+    def __init__(self, eng):
+        self._eng = eng
+
+    lay = property(lambda s: s._eng.layout)
+    static = property(lambda s: s._eng.static)
+    batch = property(lambda s: s._eng.batch)
+    draws = property(lambda s: s._eng._draws)
+    tank = property(lambda s: s._eng._tank)
+    check_mask = property(lambda s: s._eng._check_mask)
+    home_idx = property(lambda s: s._eng._home_idx)
+    n = property(lambda s: s._eng.n_homes)
+    n_real = property(lambda s: s._eng.true_n_homes)
+    band_plan = property(lambda s: s._eng._band_plan)
+    solve_backend = property(lambda s: s._eng._solve_backend)
 
 
 class CommunityState(NamedTuple):
@@ -177,6 +276,8 @@ class EngineParams(NamedTuple):
                          # 1.5e-4 cost drift, perf notes round 5)
     band_kernel: str    # "auto" | "pallas" | "xla" | "cr" band factor/solve
     forecast_noise_cap: float  # max forecast-noise std, degC (see _prepare)
+    bucketed: str       # "auto" | "true" | "false" — type-bucketed shape
+                        # specialization (see resolve_bucket_plan)
     seed: int
 
 
@@ -193,36 +294,66 @@ class Engine:
         self.batch = batch
         lay = QPLayout(params.horizon)
         self.layout = lay
-        self.static = build_qp_static(batch, params.horizon, params.dt)
         self.n_homes = batch.n_homes
-        # Device-resident environment series (float32).
+        # ShardedEngine sets true_n_homes to the pre-padding population
+        # before super().__init__; unsharded engines carry no padding.
+        if not hasattr(self, "true_n_homes"):
+            self.true_n_homes = batch.n_homes
+        # Type-bucketed shape specialization (tpu.bucketed) resolves FIRST:
+        # a bucketed engine's per-home constants live in the bucket
+        # contexts, and building the superset copies too would double the
+        # device-resident per-home memory for the engine's lifetime
+        # (ShardedEngine resolves the plan BEFORE padding — buckets are
+        # shard-padded independently — and stashes it; unsharded engines
+        # resolve here).
+        if not hasattr(self, "_bucket_ranges"):
+            self._bucket_ranges = resolve_bucket_plan(
+                params.bucketed, batch.type_code)
+        self._bucketed = self._bucket_ranges is not None
+        # Device-resident environment series (float32) — shared by every
+        # bucket (replicated under a mesh).
         self._oat = jnp.asarray(np.asarray(env_oat), dtype=jnp.float32)
         self._ghi = jnp.asarray(np.asarray(env_ghi), dtype=jnp.float32)
         self._tou = jnp.asarray(np.asarray(env_tou), dtype=jnp.float32)
-        self._draws = jnp.asarray(np.asarray(batch.draws_hourly), dtype=jnp.float32)
-        self._tank = jnp.asarray(np.asarray(batch.tank_size), dtype=jnp.float32)
         # check_type mask: aggregate reductions include only selected homes
         # (the reference only simulates matching homes, dragg/aggregator.py:
         # 767-770; homes are independent, so simulating all and masking the
         # sums is behaviorally identical for the selected homes).
         if check_mask is None:
             check_mask = np.ones(batch.n_homes)
-        self._check_mask = jnp.asarray(np.asarray(check_mask), dtype=jnp.float32)
-        # Resolve the "auto" solve backend HERE, where the mesh is known:
-        # the 1 GB Sinv budget is per device shard (ShardedEngine sets
-        # _mesh_shards before this runs), and bf16 storage halves the bytes.
         from dragg_tpu.ops.admm import _schur_structure_for, resolve_backend
         from dragg_tpu.ops.banded import plan_for
 
-        plan = (plan_for(_schur_structure_for(self.static.pattern), lay.m_eq)
-                if params.admm_banded_factor else None)
-        self._band_plan = plan
-        self._solve_backend = resolve_backend(
-            params.admm_solve_backend, batch.n_homes, lay.m_eq,
-            plan is not None,
-            elem_bytes=2 if params.admm_matvec_dtype == "bf16" else 4,
-            n_shards=getattr(self, "_mesh_shards", 1),
-        )
+        if not self._bucketed:
+            # Superset-shaped per-home device constants.
+            self.static = build_qp_static(batch, params.horizon, params.dt)
+            self._draws = jnp.asarray(np.asarray(batch.draws_hourly),
+                                      dtype=jnp.float32)
+            self._tank = jnp.asarray(np.asarray(batch.tank_size),
+                                     dtype=jnp.float32)
+            self._home_idx = jnp.asarray(np.arange(batch.n_homes))
+            self._check_mask = jnp.asarray(np.asarray(check_mask),
+                                           dtype=jnp.float32)
+            # Resolve the "auto" solve backend HERE, where the mesh is
+            # known: the 1 GB Sinv budget is per device shard (ShardedEngine
+            # sets _mesh_shards before this runs), and bf16 storage halves
+            # the bytes.
+            plan = (plan_for(_schur_structure_for(self.static.pattern),
+                             lay.m_eq)
+                    if params.admm_banded_factor else None)
+            self._band_plan = plan
+            self._solve_backend = resolve_backend(
+                params.admm_solve_backend, batch.n_homes, lay.m_eq,
+                plan is not None,
+                elem_bytes=2 if params.admm_matvec_dtype == "bf16" else 4,
+                n_shards=getattr(self, "_mesh_shards", 1),
+            )
+        else:
+            # Bucket contexts carry their own static/plan/backend; the
+            # superset equivalents stay unbuilt (no dead HBM).
+            self.static = None
+            self._band_plan = None
+            self._solve_backend = None
         # Resolve the "auto" band kernel HERE too: Pallas only when it
         # compiles natively (TPU backend).  On a sharded engine the pallas
         # kernels run under shard_map over the homes axis (make_band_ops),
@@ -249,52 +380,142 @@ class Engine:
         self._solver_mesh = getattr(self, "mesh", None) \
             if getattr(self, "_mesh_shards", 1) > 1 else None
         self._solver_mesh_axis = getattr(self, "axis_name", "homes")
-        # Commit every per-home constant to the device once, so passing
-        # them into the jitted step as ARGUMENTS is pointer-cheap.  They
-        # must be arguments, not closure captures: XLA refuses to bake in
-        # constants that span processes (multi-host mesh), and argument
-        # passing keeps their NamedShardings first-class either way.
-        # (ShardedEngine re-commits these with explicit global shardings
-        # right after this constructor.)
-        self.batch = type(batch)(*[jnp.asarray(np.asarray(f)) for f in batch])
+        # The superset view makes the bucket-parameterized step phases the
+        # only implementation — the unbucketed engine is its single bucket.
+        self._ctx0 = _SupersetView(self)
+        self._buckets: list[_TypeBucket] = []
+        if self._bucketed:
+            self._build_buckets(batch, check_mask)
+            self.n_homes = sum(c.n for c in self._buckets)
+        else:
+            # Commit every per-home constant to the device once, so passing
+            # them into the jitted step as ARGUMENTS is pointer-cheap.  They
+            # must be arguments, not closure captures: XLA refuses to bake
+            # in constants that span processes (multi-host mesh), and
+            # argument passing keeps their NamedShardings first-class
+            # either way.  (ShardedEngine re-commits these with explicit
+            # global shardings right after this constructor.  Bucketed
+            # engines keep the HOST batch here — their device copies are
+            # the bucket slices.)
+            self.batch = type(batch)(*[jnp.asarray(np.asarray(f))
+                                       for f in batch])
         self._step_fn = jax.jit(self._step_entry)
         self._chunk_fn = jax.jit(self._chunk_entry)
 
+    def _build_buckets(self, batch, check_mask) -> None:
+        """Materialize the per-type bucket contexts: slice the community
+        (contiguous by construction — resolve_bucket_plan), shard-pad each
+        bucket independently, and build the type-specialized layout /
+        static / pattern / solver backend per bucket.  Buckets keep the
+        community order, so concatenating their outputs reproduces the
+        superset ordering exactly (plus per-bucket pad slots, dropped via
+        :attr:`real_home_cols`)."""
+        from dragg_tpu.homes import pad_batch, slice_batch
+        from dragg_tpu.ops.admm import _schur_structure_for, resolve_backend
+        from dragg_tpu.ops.banded import plan_for
+
+        p = self.params
+        shards = getattr(self, "_mesh_shards", 1)
+        cmask = np.asarray(check_mask, dtype=np.float64)
+        slot = 0
+        for tname, a, b in self._bucket_ranges:
+            spec = TYPE_SPECS[tname]
+            blay = QPLayout(p.horizon, spec)
+            sub = slice_batch(batch, a, b)
+            sub, pmask = pad_batch(sub, shards)
+            n_slots = sub.n_homes
+            bstatic = build_qp_static(sub, p.horizon, p.dt, spec)
+            plan = (plan_for(_schur_structure_for(bstatic.pattern), blay.m_eq)
+                    if p.admm_banded_factor else None)
+            backend = resolve_backend(
+                p.admm_solve_backend, n_slots, blay.m_eq, plan is not None,
+                elem_bytes=2 if p.admm_matvec_dtype == "bf16" else 4,
+                n_shards=shards)
+            self._buckets.append(_TypeBucket(
+                name=tname, spec=spec, lay=blay,
+                comm_start=a, n_real=b - a, start_slot=slot, n=n_slots,
+                static=bstatic,
+                batch=type(sub)(*[jnp.asarray(np.asarray(f)) for f in sub]),
+                draws=jnp.asarray(np.asarray(sub.draws_hourly), dtype=jnp.float32),
+                tank=jnp.asarray(np.asarray(sub.tank_size), dtype=jnp.float32),
+                check_mask=jnp.asarray(
+                    np.pad(cmask[a:b], (0, n_slots - (b - a))) * pmask,
+                    dtype=jnp.float32),
+                home_idx=jnp.asarray(
+                    np.pad(np.arange(a, b), (0, n_slots - (b - a)),
+                           mode="edge")),
+                band_plan=plan, solve_backend=backend,
+            ))
+            slot += n_slots
+
     # ------------------------------------------------- traced constant tree
-    _CONST_ATTRS = ("_oat", "_ghi", "_tou", "_draws", "_tank", "_check_mask")
+    _CONST_ATTRS = ("_oat", "_ghi", "_tou", "_draws", "_tank", "_check_mask",
+                    "_home_idx")
     _STATIC_ARRAYS = ("vals", "a_in", "a_wh", "kin", "kwh", "awr")
 
     def _consts(self):
         """Every device-resident constant the traced step reads, gathered
-        into one pytree that is passed INTO the jitted entry points."""
-        st = self.static
+        into one pytree that is passed INTO the jitted entry points.
+        Bucketed engines carry only the shared environment series plus the
+        per-bucket trees — the superset per-home constants are never built
+        for them (see __init__)."""
+        if self._bucketed:
+            attrs = {k: getattr(self, k) for k in ("_oat", "_ghi", "_tou")}
+            static_t: dict = {}
+            batch_t: tuple = ()
+        else:
+            attrs = {k: getattr(self, k) for k in self._CONST_ATTRS}
+            static_t = {k: getattr(self.static, k)
+                        for k in self._STATIC_ARRAYS}
+            batch_t = tuple(self.batch)
         return {
-            "attrs": {k: getattr(self, k) for k in self._CONST_ATTRS},
-            "static": {k: getattr(st, k) for k in self._STATIC_ARRAYS},
-            "batch": tuple(self.batch),
+            "attrs": attrs,
+            "static": static_t,
+            "batch": batch_t,
+            "buckets": tuple(
+                {"static": {k: getattr(c.static, k)
+                            for k in self._STATIC_ARRAYS},
+                 "batch": tuple(c.batch),
+                 "arrs": {k: getattr(c, k) for k in _TypeBucket.ARRAY_ATTRS}}
+                for c in self._buckets),
         }
 
     def _bound(self, consts):
         """Context manager that swaps the constant attributes for the traced
         values while the step functions trace, restoring the real arrays
         after.  This keeps the step-code bodies reading ``self._oat`` etc.
-        while the compiled program receives those arrays as inputs."""
+        (and the bucket contexts their slices) while the compiled program
+        receives those arrays as inputs."""
         import contextlib
 
         @contextlib.contextmanager
         def cm():
             saved = (self.static, self.batch,
-                     {k: getattr(self, k) for k in self._CONST_ATTRS})
+                     {k: getattr(self, k) for k in consts["attrs"]},
+                     [(c.static, c.batch,
+                       {k: getattr(c, k) for k in _TypeBucket.ARRAY_ATTRS})
+                      for c in self._buckets])
             try:
                 for k, v in consts["attrs"].items():
                     setattr(self, k, v)
-                self.static = self.static._replace(**consts["static"])
-                self.batch = type(self.batch)(*consts["batch"])
+                if consts["static"]:
+                    self.static = self.static._replace(**consts["static"])
+                if consts["batch"]:
+                    self.batch = type(self.batch)(*consts["batch"])
+                for c, bc in zip(self._buckets, consts["buckets"]):
+                    c.static = c.static._replace(**bc["static"])
+                    c.batch = type(c.batch)(*bc["batch"])
+                    for k, v in bc["arrs"].items():
+                        setattr(c, k, v)
                 yield
             finally:
                 self.static, self.batch = saved[0], saved[1]
                 for k, v in saved[2].items():
                     setattr(self, k, v)
+                for c, (cst, cb, carrs) in zip(self._buckets, saved[3]):
+                    c.static, c.batch = cst, cb
+                    for k, v in carrs.items():
+                        setattr(c, k, v)
 
         return cm()
 
@@ -310,7 +531,12 @@ class Engine:
     def band_bw(self) -> int | None:
         """Bandwidth of the RCM band plan the solvers factor with (None when
         the banded factorization is disabled) — the authoritative input to
-        bench.py's HBM-bandwidth model."""
+        bench.py's HBM-bandwidth model.  A bucketed engine reports the
+        widest bucket's bandwidth (per-bucket values ride bucket_info)."""
+        if self._bucketed:
+            bws = [c.band_plan.bw for c in self._buckets
+                   if c.band_plan is not None]
+            return max(bws) if bws else None
         return self._band_plan.bw if self._band_plan is not None else None
 
     @property
@@ -333,18 +559,65 @@ class Engine:
         return self._admm_band_kernel
 
     @property
-    def warm_cols(self) -> int:
+    def warm_cols(self):
         """Width of the warm-start carry columns in CommunityState — the
         ONE place this is decided (init_state sizes the leaves by it and
         aggregator._run_shape keys checkpoint invalidation on it; deriving
-        it twice is how the two silently disagree)."""
+        it twice is how the two silently disagree).  Bucketed engines
+        return a per-bucket list (each bucket's layout has its own
+        variable count)."""
+        if self._bucketed:
+            return [c.lay.n if self._carry_warm else 0 for c in self._buckets]
         return self.layout.n if self._carry_warm else 0
 
+    @property
+    def bucketed(self) -> bool:
+        """Whether the community solves as per-type buckets (resolved from
+        ``tpu.bucketed`` against the population — see resolve_bucket_plan)."""
+        return self._bucketed
+
+    def bucket_info(self) -> list[dict]:
+        """Static bucket descriptors for benchmarks/telemetry: one dict per
+        bucket with its type, community/slot ranges and compiled shape.
+        Unbucketed engines report the single superset batch."""
+        if not self._bucketed:
+            return [dict(name="superset", comm_start=0,
+                         n_real=self.true_n_homes, start_slot=0,
+                         n_slots=self.n_homes, m_eq=self.layout.m_eq,
+                         n_var=self.layout.n,
+                         nnz=self.static.pattern.nnz,
+                         band_bw=self.band_bw)]
+        return [dict(name=c.name, comm_start=c.comm_start, n_real=c.n_real,
+                     start_slot=c.start_slot, n_slots=c.n,
+                     m_eq=c.lay.m_eq, n_var=c.lay.n,
+                     nnz=c.static.pattern.nnz,
+                     band_bw=c.band_plan.bw if c.band_plan is not None
+                     else None)
+                for c in self._buckets]
+
+    @property
+    def real_home_cols(self) -> np.ndarray:
+        """Column indices of the TRUE homes in the merged per-home output
+        axis, in community order.  Superset engines pad (if at all) only at
+        the end, so this is a plain prefix; bucketed engines shard-pad each
+        bucket independently, interleaving pad slots at bucket boundaries."""
+        if not self._bucketed:
+            return np.arange(self.true_n_homes)
+        return np.concatenate([c.start_slot + np.arange(c.n_real)
+                               for c in self._buckets])
+
     # ---------------------------------------------------------------- state
-    def init_state(self) -> CommunityState:
-        """t=0 initial conditions (dragg/mpc_calc.py:267-277)."""
-        b = self.batch
-        n = self.n_homes
+    def init_state(self):
+        """t=0 initial conditions (dragg/mpc_calc.py:267-277).  Bucketed
+        engines carry one CommunityState per bucket (a tuple pytree — the
+        scan, checkpoints, and shard placement all treat it leaf-wise)."""
+        if self._bucketed:
+            return tuple(self._init_state_bucket(c) for c in self._buckets)
+        return self._init_state_bucket(self._ctx0)
+
+    def _init_state_bucket(self, ctx) -> CommunityState:
+        b = ctx.batch
+        n = ctx.n
         H = self.params.horizon
         f32 = jnp.float32
         # Warm-start carry is dead weight on the default IPM path
@@ -357,7 +630,7 @@ class Engine:
         # leaf SHAPES do change with the solver config, which
         # aggregator._run_shape records so a mismatched checkpoint is
         # invalidated instead of crashing resume.
-        nw = self.warm_cols
+        nw = ctx.lay.n if self._carry_warm else 0
         return CommunityState(
             temp_in=jnp.asarray(b.temp_in_init, dtype=f32),
             temp_wh=jnp.asarray(b.temp_wh_init, dtype=f32),
@@ -372,39 +645,47 @@ class Engine:
             key=jax.random.PRNGKey(self.params.seed),
         )
 
-    def init_factor(self) -> FactorCarry:
+    def init_factor(self):
         """Zero factor cache.  The cache lives only in chunk-local scan
         carries — NOT in CommunityState — so checkpoints never pay for the
         (n, m, m) Schur inverse (237 MB at 10k homes, ~9 GB at the
-        100k-home/H=48 target); every chunk's first step refreshes it."""
+        100k-home/H=48 target); every chunk's first step refreshes it.
+        Bucketed engines thread one carry per bucket (each at its own
+        (n_b, m_b) shape)."""
+        if self._bucketed:
+            return tuple(self._init_factor_bucket(c) for c in self._buckets)
+        return self._init_factor_bucket(self._ctx0)
+
+    def _init_factor_bucket(self, ctx) -> FactorCarry:
         if self.params.solver == "ipm":
             # The IPM has no cross-step factor cache — thread a token-sized
             # carry instead of the ADMM's (B, m, m) dead weight.
             f32 = jnp.float32
-            one = jnp.ones((self.n_homes, 1), f32)
+            one = jnp.ones((ctx.n, 1), f32)
             return FactorCarry(d=one, e_eq=one, e_box=one, c=one,
-                               Sinv=jnp.zeros((self.n_homes, 1, 1), f32))
-        return init_factor_carry(self.n_homes, self.static.pattern,
+                               Sinv=jnp.zeros((ctx.n, 1, 1), f32))
+        return init_factor_carry(ctx.n, ctx.static.pattern,
                                  matvec_dtype=self.params.admm_matvec_dtype,
-                                 solve_backend=self._solve_backend,
+                                 solve_backend=ctx.solve_backend,
                                  banded_factor=self.params.admm_banded_factor,
                                  band_kernel=self._admm_band_kernel)
 
     # ----------------------------------------------------------------- step
-    def _prepare(self, state: CommunityState, t, rp):
+    def _prepare(self, ctx, state: CommunityState, t, rp):
         """Assemble phase: environment windows, water draws, seasonal gate,
-        and the batched QP for one timestep.  ``t`` is the sim timestep
+        and the batched QP for one timestep of ONE bucket (``ctx`` — the
+        superset view when unbucketed).  ``t`` is the sim timestep
         (traced), ``rp`` the reward-price vector (H,) for this step."""
         p = self.params
-        lay = self.layout
-        b = self.batch
+        lay = ctx.lay
+        b = ctx.batch
         H, dt, s = p.horizon, p.dt, p.s
-        n = self.n_homes
+        n = ctx.n
         f32 = jnp.float32
 
         # --- Water draws (dragg/mpc_calc.py:193-204).
         hour = t // dt
-        win_hourly = lax.dynamic_slice(self._draws, (0, hour), (n, H // dt + 1))
+        win_hourly = lax.dynamic_slice(ctx.draws, (0, hour), (n, H // dt + 1))
         raw = jnp.repeat(win_hourly, dt, axis=-1) / dt
         n_raw = raw.shape[-1]
         idx = jnp.arange(H + 1)
@@ -414,12 +695,12 @@ class Engine:
         rolled = (take(-1) * prev_ok + take(0) + take(1) * next_ok) / (prev_ok + 1.0 + next_ok)
         direct = jnp.take(raw, jnp.minimum(idx, n_raw - 1), axis=-1)
         draw_size = jnp.where(idx < dt, direct, rolled)        # (n, H+1) liters
-        draw_frac = draw_size / self._tank[:, None]
+        draw_frac = draw_size / ctx.tank[:, None]
 
         # Draw-mixed initial WH temperature (dragg/mpc_calc.py:271,281).
         temp_wh_init = (
-            state.temp_wh * (self._tank - draw_size[:, 0]) + TAP_TEMP * draw_size[:, 0]
-        ) / self._tank
+            state.temp_wh * (ctx.tank - draw_size[:, 0]) + TAP_TEMP * draw_size[:, 0]
+        ) / ctx.tank
 
         # --- Environment windows (true values; dragg/mpc_calc.py:211-230).
         start = p.start_index + t
@@ -431,9 +712,10 @@ class Engine:
 
         # --- Seasonal gate on the noisy forecast (dragg/mpc_calc.py:217-223,302-309).
         # Per-home keys (not one (n, H) draw): each home's noise stream is a
-        # function of (seed, t, home index) alone, so it is invariant to the
-        # batch size — shard-padding a community must not perturb the real
-        # homes' forecasts (sharded-vs-single equivalence).
+        # function of (seed, t, GLOBAL home index — ctx.home_idx) alone, so
+        # it is invariant to the batch size AND the bucket partition —
+        # shard-padding or bucketing a community must not perturb the real
+        # homes' forecasts (sharded/bucketed-vs-single equivalence).
         #
         # Documented deviation: the reference's 1.1^k noise growth is
         # unbounded — at the H=48 BASELINE horizon step 47 carries ±88 degC
@@ -443,7 +725,7 @@ class Engine:
         # std at ``forecast_noise_cap`` (default 3 degC ~ 1.1^12, identical
         # to the reference for the first 12 horizon steps).
         key = jax.random.fold_in(state.key, t)
-        home_keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, jnp.arange(n))
+        home_keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, ctx.home_idx)
         noise_std = jnp.minimum(
             jnp.power(jnp.asarray(1.1, f32), jnp.arange(H, dtype=f32)),
             jnp.asarray(p.forecast_noise_cap, f32),
@@ -456,7 +738,7 @@ class Engine:
 
         # --- Assemble + solve the batched QP.
         qp = assemble_qp_step(
-            self.static, lay, b,
+            ctx.static, lay, b,
             oat_window=oat_w, ghi_window=ghi_w, price_total=price_total,
             draw_frac=draw_frac,
             temp_in_init=state.temp_in, temp_wh_init=temp_wh_init,
@@ -471,8 +753,10 @@ class Engine:
         )
         return qp, aux
 
-    def _solve(self, state: CommunityState, qp, factor: FactorCarry, refresh):
-        """Solve phase: the batched QP solve.
+    def _solve(self, ctx, state: CommunityState, qp, factor: FactorCarry,
+               refresh):
+        """Solve phase: one bucket's batched QP solve (``ctx`` is the
+        superset view when unbucketed).
 
         ``solver="admm"``: warm-started from state; ``refresh`` (traced
         bool) forces an exact re-equilibration + refactorization; between
@@ -495,7 +779,7 @@ class Engine:
             # the engine just forwards the cap and the knobs.
             def run_ipm(l_box, u_box, eps=p.ipm_eps):
                 return ipm_solve_qp(
-                    self.static.pattern, qp.vals, qp.b_eq, l_box, u_box,
+                    ctx.static.pattern, qp.vals, qp.b_eq, l_box, u_box,
                     qp.q, reg=p.admm_reg, iters=p.ipm_iters,
                     tail_frac=p.ipm_tail_frac, tail_iters=p.ipm_tail_iters,
                     eps_abs=eps, eps_rel=eps,
@@ -515,7 +799,7 @@ class Engine:
                 # start — x0 from the relaxed iterate measured SLOWER
                 # (20-29 iters, warm-start jamming; same measurement).
                 sol, repair_failed = self._integerize_first_action(
-                    qp, relaxed,
+                    ctx, qp, relaxed,
                     lambda l2, u2: run_ipm(l2, u2, eps=p.repair_eps))
             # Warm starts always shift the RELAXED solution: the repaired
             # iterate sits on pinned boxes that move every step, and
@@ -526,7 +810,7 @@ class Engine:
 
         def run_admm(l_box, u_box, fac, ref, x0, y0, rho0):
             return admm_solve_qp_cached(
-                self.static.pattern, qp.vals, qp.b_eq, l_box, u_box, qp.q,
+                ctx.static.pattern, qp.vals, qp.b_eq, l_box, u_box, qp.q,
                 fac, ref,
                 rho=p.admm_rho, sigma=p.admm_sigma, alpha=p.admm_alpha,
                 eps_abs=p.admm_eps, eps_rel=p.admm_eps,
@@ -538,7 +822,7 @@ class Engine:
                 refine=p.admm_refine,
                 anderson=p.admm_anderson,
                 banded_factor=p.admm_banded_factor,
-                solve_backend=self._solve_backend,
+                solve_backend=ctx.solve_backend,
                 band_kernel=self._admm_band_kernel,
                 mesh=self._solver_mesh, mesh_axis=self._solver_mesh_axis,
                 x0=x0, y_box0=y0, rho0=rho0,
@@ -554,13 +838,13 @@ class Engine:
             # comes from `relaxed` (third return), which is what makes
             # the repair safe on this warm-start-dependent family.
             sol, repair_failed = self._integerize_first_action(
-                qp, relaxed,
+                ctx, qp, relaxed,
                 lambda l2, u2: run_admm(l2, u2, fcarry, False,
                                         relaxed.x, relaxed.y_box,
                                         relaxed.rho)[0])
         return sol, fcarry, relaxed, repair_failed
 
-    def _integerize_first_action(self, qp, sol, run_solver):
+    def _integerize_first_action(self, ctx, qp, sol, run_solver):
         """Default-on MILP repair (``tpu.integer_first_action``): pin the three
         k=0 duty counts to their rounded values and re-solve, so the
         APPLIED action matches the reference's integer duty-cycle
@@ -588,8 +872,8 @@ class Engine:
         (measured: repaired warm shifts collapse ADMM's downstream solve
         rate 0.755 → 0.44, perf notes round 4).
         """
-        lay = self.layout
-        st, b = self.static, self.batch
+        lay = ctx.lay
+        st, b = ctx.static, ctx.batch
         f32 = jnp.float32
         pc = jnp.asarray(b.hvac_p_c, f32)
         ph = jnp.asarray(b.hvac_p_h, f32)
@@ -686,7 +970,7 @@ class Engine:
             )
             keep = in_band & sol.solved
             repair_failed = jnp.sum(
-                jnp.where(sol.solved & ~in_band, self._check_mask, 0.0))
+                jnp.where(sol.solved & ~in_band, ctx.check_mask, 0.0))
             x2 = sol.x.at[:, cols].set(pinned)
             # k=1 entries move by the same affine delta in the EV and the
             # applied (true-OAT) rows — the duty coefficients coincide;
@@ -715,7 +999,7 @@ class Engine:
         # chunk telemetry can detect repair coverage regressing below the
         # measured 99.9 % (ADVICE round 4).
         repair_failed = jnp.sum(
-            jnp.where(sol.solved & ~sol2.solved, self._check_mask, 0.0))
+            jnp.where(sol.solved & ~sol2.solved, ctx.check_mask, 0.0))
 
         def pick(b, a):
             k = keep.reshape(keep.shape + (1,) * (a.ndim - 1)) \
@@ -734,15 +1018,16 @@ class Engine:
             rho=pick(sol2.rho, sol.rho),
         ), repair_failed
 
-    def _finish(self, state: CommunityState, t, sol, aux: StepAux,
+    def _finish(self, ctx, state: CommunityState, t, sol, aux: StepAux,
                 warm_sol, repair_failed=0.0):
-        """Merge/collect phase: recover physical series, route unsolved homes
-        through the fallback controller, emit observables, advance state."""
+        """Merge/collect phase for one bucket: recover physical series,
+        route unsolved homes through the fallback controller, emit
+        observables, advance state."""
         p = self.params
-        lay = self.layout
-        b = self.batch
+        lay = ctx.lay
+        b = ctx.batch
         H, dt, s = p.horizon, p.dt, p.s
-        n = self.n_homes
+        n = ctx.n
         f32 = jnp.float32
         temp_wh_init = aux.temp_wh_init
         price_total = aux.price_total
@@ -811,7 +1096,7 @@ class Engine:
         _big = jnp.asarray(3.4e38, f32)
 
         def _res_max(r):
-            r = jnp.where(self._check_mask > 0, r, 0.0)
+            r = jnp.where(ctx.check_mask > 0, r, 0.0)
             return jnp.max(jnp.where(jnp.isfinite(r), r, _big))
 
         sel2 = solved[:, None]
@@ -847,9 +1132,9 @@ class Engine:
             e_batt=e_batt_next,
             p_batt_ch=p_ch0,
             p_batt_disch=p_d0,
-            agg_load=jnp.sum(p_grid0 * self._check_mask),
-            forecast_load=jnp.sum(fore * self._check_mask),
-            agg_cost=jnp.sum(cost0 * self._check_mask),
+            agg_load=jnp.sum(p_grid0 * ctx.check_mask),
+            forecast_load=jnp.sum(fore * ctx.check_mask),
+            agg_cost=jnp.sum(cost0 * ctx.check_mask),
             admm_iters=sol.iters,
             repair_failed=jnp.asarray(repair_failed, f32),
             r_prim_max=_res_max(sol.r_prim),
@@ -857,17 +1142,52 @@ class Engine:
         )
         return new_state, out
 
-    def _step(self, state: CommunityState, t, rp, refresh, factor: FactorCarry):
+    # Merge policy for per-bucket StepOutputs: per-home leaves concatenate
+    # in bucket (= community) order; the scalar reductions are sums of
+    # already-masked partial sums, and the solver telemetry scalars take
+    # the binding (max) bucket.
+    _SUM_OUTPUTS = frozenset(
+        {"agg_load", "forecast_load", "agg_cost", "repair_failed"})
+    _MAX_OUTPUTS = frozenset({"admm_iters", "r_prim_max", "r_dual_max"})
+
+    def _merge_outputs(self, outs: list) -> StepOutputs:
+        from functools import reduce
+
+        merged = {}
+        for f in StepOutputs._fields:
+            leaves = [getattr(o, f) for o in outs]
+            if f in self._SUM_OUTPUTS:
+                merged[f] = reduce(jnp.add, leaves)
+            elif f in self._MAX_OUTPUTS:
+                merged[f] = reduce(jnp.maximum, leaves)
+            else:
+                merged[f] = jnp.concatenate(leaves, axis=0)
+        return StepOutputs(**merged)
+
+    def _step_bucket(self, ctx, state_b, t, rp, refresh, factor_b):
+        """assemble → solve → merge/collect for one bucket."""
+        qp, aux = self._prepare(ctx, state_b, t, rp)
+        sol, fcarry, warm_sol, repair_failed = self._solve(
+            ctx, state_b, qp, factor_b, refresh)
+        new_state, out = self._finish(ctx, state_b, t, sol, aux, warm_sol,
+                                      repair_failed)
+        return new_state, fcarry, out
+
+    def _step(self, state, t, rp, refresh, factor):
         """One community timestep: assemble → solve → merge/collect.
         Returns (new_state, new_factor, outputs) — the factor cache is
         threaded separately from CommunityState so it never reaches
-        checkpoints (see :meth:`init_factor`)."""
-        qp, aux = self._prepare(state, t, rp)
-        sol, fcarry, warm_sol, repair_failed = self._solve(
-            state, qp, factor, refresh)
-        new_state, out = self._finish(state, t, sol, aux, warm_sol,
-                                      repair_failed)
-        return new_state, fcarry, out
+        checkpoints (see :meth:`init_factor`).  Bucketed engines step each
+        type bucket at its own shape (state/factor are per-bucket tuples)
+        and merge the outputs back into community order."""
+        if not self._bucketed:
+            return self._step_bucket(self._ctx0, state, t, rp, refresh,
+                                     factor)
+        parts = [self._step_bucket(c, s, t, rp, refresh, f)
+                 for c, s, f in zip(self._buckets, state, factor)]
+        new_states, fcarries, outs = zip(*parts)
+        return tuple(new_states), tuple(fcarries), self._merge_outputs(
+            list(outs))
 
     def _chunk(self, state: CommunityState, t0, rps):
         """Scan ``rps.shape[0]`` timesteps on device (the sim hot loop —
@@ -920,7 +1240,11 @@ class Engine:
         """Separately-jitted (prepare, solve, finish) phase functions for
         the benchmark's per-phase timers.  Splitting loses cross-phase XLA
         fusion, so the phase-time sum slightly over-estimates the fused
-        step — use for attribution, not as the headline rate."""
+        step — use for attribution, not as the headline rate.
+
+        On a bucketed engine each phase maps over the buckets (qp/aux/
+        sol/factor/warm become per-bucket tuples between phases, merged
+        outputs at the end), so the benchmark's phase flow is unchanged."""
         consts = self._consts()
 
         def entry(fn):
@@ -931,7 +1255,61 @@ class Engine:
             jitted = jax.jit(wrapped)
             return lambda *a: jitted(consts, *a)
 
-        return entry(self._prepare), entry(self._solve), entry(self._finish)
+        if not self._bucketed:
+            ctx = self._ctx0
+            return (entry(lambda *a: self._prepare(ctx, *a)),
+                    entry(lambda *a: self._solve(ctx, *a)),
+                    entry(lambda *a: self._finish(ctx, *a)))
+
+        from functools import reduce
+
+        def prep(state, t, rp):
+            pairs = [self._prepare(c, s, t, rp)
+                     for c, s in zip(self._buckets, state)]
+            qps, auxs = zip(*pairs)
+            return tuple(qps), tuple(auxs)
+
+        def solve(state, qps, factors, refresh):
+            res = [self._solve(c, s, qp, f, refresh)
+                   for c, s, qp, f in zip(self._buckets, state, qps, factors)]
+            sols, fcs, warms, rfs = zip(*res)
+            return (tuple(sols), tuple(fcs), tuple(warms),
+                    reduce(jnp.add, rfs))
+
+        def fin(state, t, sols, auxs, warms):
+            parts = [self._finish(c, s, t, so, au, w)
+                     for c, s, so, au, w in zip(self._buckets, state, sols,
+                                                auxs, warms)]
+            new_states, outs = zip(*parts)
+            return tuple(new_states), self._merge_outputs(list(outs))
+
+        return entry(prep), entry(solve), entry(fin)
+
+    def bucket_solve_fns(self):
+        """``[(type_name, fn)]`` — separately-jitted single-bucket
+        assemble+solve closures for the benchmark's per-bucket phase
+        attribution (``[]`` on an unbucketed engine).  Each fn takes the
+        full per-bucket state/factor tuples and runs ONLY its bucket, so
+        timing it isolates that bucket's share of the solve phase (the
+        bucket's assemble rides along — measured ~0.5 % of solve)."""
+        if not self._bucketed:
+            return []
+        consts = self._consts()
+        fns = []
+        for i, ctx in enumerate(self._buckets):
+            def make(i=i, ctx=ctx):
+                def wrapped(c, state, t, rp, refresh, factor):
+                    with self._bound(c):
+                        qp, _aux = self._prepare(ctx, state[i], t, rp)
+                        return self._solve(ctx, state[i], qp, factor[i],
+                                           refresh)[0]
+
+                jitted = jax.jit(wrapped)
+                return lambda state, t, rp, refresh, factor: jitted(
+                    consts, state, t, rp, refresh, factor)
+
+            fns.append((ctx.name, make()))
+        return fns
 
 
 def engine_params(config, start_index: int) -> EngineParams:
@@ -959,6 +1337,13 @@ def engine_params(config, start_index: int) -> EngineParams:
     if repair_mode not in ("project", "resolve"):
         raise ValueError(
             f"tpu.integer_repair must be project|resolve, got {repair_mode!r}")
+    # TOML booleans arrive as Python bools; normalize the tri-state to the
+    # canonical lowercase strings.
+    bucketed = str(tpu_cfg.get("bucketed", "auto")).lower()
+    if bucketed not in ("auto", "true", "false"):
+        raise ValueError(
+            f"tpu.bucketed must be auto|true|false, got "
+            f"{tpu_cfg.get('bucketed')!r}")
     return EngineParams(
         solver=solver,
         horizon=horizon,
@@ -994,6 +1379,7 @@ def engine_params(config, start_index: int) -> EngineParams:
         repair_eps=float(tpu_cfg.get("repair_eps", 1e-3)),
         band_kernel=str(tpu_cfg.get("band_kernel", "auto")),
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
+        bucketed=bucketed,
         seed=int(config["simulation"]["random_seed"]),
     )
 

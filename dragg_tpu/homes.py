@@ -240,6 +240,63 @@ class HomeBatch(NamedTuple):
         return int(self.type_code.shape[0])
 
 
+def type_bucket_ranges(type_code) -> list[tuple[str, int, int]] | None:
+    """Contiguous per-type runs of the batch, in community order:
+    ``[(type_name, start, stop), ...]``.
+
+    The population is materialized in type order (``create_homes``:
+    pv_battery, pv_only, battery_only, base), so each home type occupies
+    one contiguous slice and the type-bucketed engine can treat buckets
+    as slices plus a static column map — no scatter.  Returns ``None``
+    when some type appears in more than one run (a hand-built,
+    interleaved batch): such a community is not bucketable by slicing.
+    Empty types simply produce no range (never a zero-width bucket).
+    """
+    codes = np.asarray(type_code)
+    if codes.size == 0:
+        return None
+    ranges: list[tuple[str, int, int]] = []
+    seen: set[int] = set()
+    start = 0
+    for i in range(1, codes.size + 1):
+        if i == codes.size or codes[i] != codes[start]:
+            code = int(codes[start])
+            if code in seen:
+                return None  # type split across non-adjacent runs
+            seen.add(code)
+            ranges.append((HOME_TYPES[code], start, i))
+            start = i
+    return ranges
+
+
+def slice_batch(batch: "HomeBatch", start: int, stop: int) -> "HomeBatch":
+    """A HomeBatch view of homes ``[start:stop)`` (every per-home array
+    sliced along the leading axis)."""
+    return type(batch)(*[np.asarray(f)[start:stop] for f in batch])
+
+
+def pad_batch(batch: "HomeBatch", multiple: int):
+    """Pad every per-home array to a multiple of the shard count.
+
+    Padding replicates the last home (edge padding) so the dummy problems
+    remain well-posed (no zero tank sizes / RC constants); the returned
+    mask is 0 for padded homes so aggregate reductions are unchanged.
+    (Shared by the sharded engine's whole-batch padding and the
+    type-bucketed engine's per-bucket padding.)
+    """
+    n = batch.n_homes
+    n_pad = (-n) % multiple
+    if n_pad == 0:
+        return batch, np.ones(n)
+    padded = type(batch)(*[
+        np.pad(np.asarray(f), [(0, n_pad)] + [(0, 0)] * (np.asarray(f).ndim - 1),
+               mode="edge")
+        for f in batch
+    ])
+    mask = np.concatenate([np.ones(n), np.zeros(n_pad)])
+    return padded, mask
+
+
 def build_home_batch(all_homes: list[dict], horizon: int, dt: int, sub_steps: int) -> HomeBatch:
     """Pack home dicts into the padded superset batch.
 

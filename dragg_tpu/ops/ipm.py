@@ -82,7 +82,17 @@ def ipm_solve_qp(
     dtype = vals.dtype
 
     schur = _schur_structure_for(pat)
-    plan = plan_for(schur, m) if schur is not None else None
+    if schur is None:
+        # The lru-cached helper returns None when its density HEURISTIC
+        # says dense S formation is cheaper — tuned for the big dense test
+        # patterns, but small type-bucketed MPC patterns (base homes at
+        # H ≤ 2) can trip it while still being perfectly banded.  The IPM
+        # REQUIRES the triple lists, so build them directly; genuinely
+        # dense patterns still die in plan_for below (bandwidth cap).
+        from dragg_tpu.ops.qp import build_schur_structure
+
+        schur = build_schur_structure(pat)
+    plan = plan_for(schur, m)
     if plan is None:
         raise ValueError("ipm_solve_qp needs a banded Schur pattern")
     bw = plan.bw

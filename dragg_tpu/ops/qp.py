@@ -28,8 +28,10 @@ change shape-free per timestep; A_eq has a fixed sparsity whose values are
 per-home (static) except the water-draw mixing coefficients, which vary per
 timestep (dragg/mpc_calc.py:330-332).
 
-Variable vector per home (superset pv_battery shape; base homes get
-zero-width battery/PV via [0,0] bounds), horizon H:
+Variable vector per home (superset pv_battery shape shown; in the
+superset-shaped batch base homes get zero-width battery/PV via [0,0]
+bounds, while the type-bucketed engine drops the absent blocks from the
+layout entirely via :class:`HomeTypeSpec`), horizon H:
 
     cool[H] heat[H] wh[H] p_ch[H] p_disch[H] u_curt[H]
     T_in_ev[H+1] T_wh_ev[H+1] e_batt[H+1] T_in1 T_wh1        (n = 9H + 5)
@@ -50,34 +52,83 @@ TAP_TEMP = 15.0  # assumed cold tap water temp, degC (dragg/mpc_calc.py:181)
 BIG = jnp.inf
 
 
-class QPLayout:
-    """Index bookkeeping for the per-home variable vector and equality rows."""
+class HomeTypeSpec(NamedTuple):
+    """Which optional variable/constraint blocks a home type carries.
 
-    def __init__(self, horizon: int):
+    The reference builds a different CVXPY program per home type
+    (dragg/mpc_calc.py ``manage_home`` dispatch): base homes have no
+    battery or PV blocks at all.  A :class:`QPLayout` built on a spec
+    drops the absent blocks from the batched program instead of padding
+    them to zero-width [0, 0] boxes — the type-bucketed engine solves
+    each bucket at its own (n, m) shape (docs/architecture.md §10).
+    """
+
+    has_batt: bool   # p_ch / p_disch / e_batt columns + battery dynamics rows
+    has_curt: bool   # PV curtailment column (objective-only; no A_eq rows)
+
+
+SUPERSET_SPEC = HomeTypeSpec(has_batt=True, has_curt=True)
+
+# Home type name (dragg_tpu.homes.HOME_TYPES) → block spec.
+TYPE_SPECS: dict[str, HomeTypeSpec] = {
+    "pv_battery": SUPERSET_SPEC,
+    "pv_only": HomeTypeSpec(has_batt=False, has_curt=True),
+    "battery_only": HomeTypeSpec(has_batt=True, has_curt=False),
+    "base": HomeTypeSpec(has_batt=False, has_curt=False),
+}
+
+
+class QPLayout:
+    """Index bookkeeping for the per-home variable vector and equality rows.
+
+    Default spec is the superset (pv_battery) shape, whose indices are
+    identical to the historical fixed layout (n = 9H + 5, m_eq = 3H + 5).
+    Under a reduced :class:`HomeTypeSpec` the absent blocks' indices are
+    ``None`` so any unguarded use fails loudly instead of aliasing a live
+    column."""
+
+    def __init__(self, horizon: int, spec: HomeTypeSpec = SUPERSET_SPEC):
         H = int(horizon)
         self.H = H
-        self.i_cool = 0
-        self.i_heat = H
-        self.i_wh = 2 * H
-        self.i_pch = 3 * H
-        self.i_pd = 4 * H
-        self.i_curt = 5 * H
-        self.i_tin = 6 * H
-        self.i_twh = 7 * H + 1
-        self.i_eb = 8 * H + 2
-        self.i_tin1 = 9 * H + 3
-        self.i_twh1 = 9 * H + 4
-        self.n = 9 * H + 5
+        self.spec = spec
+        self.has_batt = bool(spec.has_batt)
+        self.has_curt = bool(spec.has_curt)
+        i = 0
+        self.i_cool = i; i += H          # noqa: E702 — index table reads as one block
+        self.i_heat = i; i += H          # noqa: E702
+        self.i_wh = i; i += H            # noqa: E702
+        if self.has_batt:
+            self.i_pch = i; i += H       # noqa: E702
+            self.i_pd = i; i += H        # noqa: E702
+        else:
+            self.i_pch = self.i_pd = None
+        if self.has_curt:
+            self.i_curt = i; i += H      # noqa: E702
+        else:
+            self.i_curt = None
+        self.i_tin = i; i += H + 1       # noqa: E702
+        self.i_twh = i; i += H + 1       # noqa: E702
+        if self.has_batt:
+            self.i_eb = i; i += H + 1    # noqa: E702
+        else:
+            self.i_eb = None
+        self.i_tin1 = i; i += 1          # noqa: E702
+        self.i_twh1 = i; i += 1          # noqa: E702
+        self.n = i
         # Equality rows.
-        self.r_tin0 = 0
-        self.r_tind = 1                  # H rows
-        self.r_twh0 = H + 1
-        self.r_twhd = H + 2              # H rows
-        self.r_tin1 = 2 * H + 2
-        self.r_twh1 = 2 * H + 3
-        self.r_eb0 = 2 * H + 4
-        self.r_ebd = 2 * H + 5           # H rows
-        self.m_eq = 3 * H + 5
+        r = 0
+        self.r_tin0 = r; r += 1          # noqa: E702
+        self.r_tind = r; r += H          # noqa: E702  (H rows)
+        self.r_twh0 = r; r += 1          # noqa: E702
+        self.r_twhd = r; r += H          # noqa: E702  (H rows)
+        self.r_tin1 = r; r += 1          # noqa: E702
+        self.r_twh1 = r; r += 1          # noqa: E702
+        if self.has_batt:
+            self.r_eb0 = r; r += 1       # noqa: E702
+            self.r_ebd = r; r += H       # noqa: E702  (H rows)
+        else:
+            self.r_eb0 = self.r_ebd = None
+        self.m_eq = r
         self.m = self.m_eq + self.n
 
 
@@ -245,13 +296,16 @@ class HomeQPStatic(NamedTuple):
     awr: jnp.ndarray          # (n_homes,) a_wh / wh_r
 
 
-def build_qp_static(batch, horizon: int, dt: int) -> HomeQPStatic:
+def build_qp_static(batch, horizon: int, dt: int,
+                    spec: HomeTypeSpec = SUPERSET_SPEC) -> HomeQPStatic:
     """Precompute the equality-constraint sparsity + per-home coefficients.
 
     ``batch`` is a HomeBatch (arrays may be numpy or jax).  Row/col index
-    arrays are identical for every home; values are per-home.
+    arrays are identical for every home; values are per-home.  ``spec``
+    selects the block layout — a battery-free spec drops the SoC pin +
+    dynamics rows and their nnz entirely (type-bucketed engine).
     """
-    lay = QPLayout(horizon)
+    lay = QPLayout(horizon, spec)
     H = lay.H
     n_homes = batch.hvac_r.shape[0]
 
@@ -300,12 +354,13 @@ def build_qp_static(batch, horizon: int, dt: int) -> HomeQPStatic:
     add(lay.r_twh1, lay.i_tin + 1, -awr)
     add(lay.r_twh1, lay.i_wh, -a_wh * pwh)
     # Battery SoC: pin + dynamics (dragg/mpc_calc.py:363-372).
-    add(lay.r_eb0, lay.i_eb, 1.0)
-    for k in range(H):
-        add(lay.r_ebd + k, lay.i_eb + k + 1, 1.0)
-        add(lay.r_ebd + k, lay.i_eb + k, -1.0)
-        add(lay.r_ebd + k, lay.i_pch + k, -che / dt)
-        add(lay.r_ebd + k, lay.i_pd + k, -1.0 / (dse * dt))
+    if lay.has_batt:
+        add(lay.r_eb0, lay.i_eb, 1.0)
+        for k in range(H):
+            add(lay.r_ebd + k, lay.i_eb + k + 1, 1.0)
+            add(lay.r_ebd + k, lay.i_eb + k, -1.0)
+            add(lay.r_ebd + k, lay.i_pch + k, -che / dt)
+            add(lay.r_ebd + k, lay.i_pd + k, -1.0 / (dse * dt))
     del ks
 
     rows_np = np.array(rows, dtype=np.int64)
@@ -377,14 +432,14 @@ def assemble_qp_step(
         temp_in_init * static.kin + static.a_in / jnp.asarray(batch.hvac_r) * oat[1]
     )
     b = b.at[:, lay.r_twh1].set(temp_wh_init * static.kwh)
-    b = b.at[:, lay.r_eb0].set(e_batt_init)
-    # battery dynamics rows rhs = 0 already
+    if lay.has_batt:
+        b = b.at[:, lay.r_eb0].set(e_batt_init)
+        # battery dynamics rows rhs = 0 already
 
     inf = jnp.full((n_homes,), BIG, dtype=dtype)
     zeros = jnp.zeros((n_homes,), dtype=dtype)
     l = jnp.zeros((n_homes, lay.n), dtype=dtype)
     u = jnp.zeros((n_homes, lay.n), dtype=dtype)
-    rate = jnp.asarray(batch.batt_max_rate) * jnp.asarray(batch.has_batt)
 
     def seg(lo, hi, i0, length):
         nonlocal l, u
@@ -394,9 +449,12 @@ def assemble_qp_step(
     seg(zeros, cool_cap, lay.i_cool, H)
     seg(zeros, heat_cap, lay.i_heat, H)
     seg(zeros, jnp.full((n_homes,), wh_cap, dtype=dtype), lay.i_wh, H)
-    seg(zeros, rate, lay.i_pch, H)
-    seg(-rate, zeros, lay.i_pd, H)
-    seg(zeros, jnp.ones((n_homes,), dtype=dtype), lay.i_curt, H)
+    if lay.has_batt:
+        rate = jnp.asarray(batch.batt_max_rate) * jnp.asarray(batch.has_batt)
+        seg(zeros, rate, lay.i_pch, H)
+        seg(-rate, zeros, lay.i_pd, H)
+    if lay.has_curt:
+        seg(zeros, jnp.ones((n_homes,), dtype=dtype), lay.i_curt, H)
     # T_in_ev[0] is pinned by equality; bounds apply to [1:] only
     # (dragg/mpc_calc.py:318-319).
     seg(-inf, inf, lay.i_tin, 1)
@@ -406,10 +464,11 @@ def assemble_qp_step(
     # problem infeasible, which routes the home to the fallback controller
     # exactly as in the reference.
     seg(jnp.asarray(batch.temp_wh_min).astype(dtype), jnp.asarray(batch.temp_wh_max).astype(dtype), lay.i_twh, H + 1)
-    seg(-inf, inf, lay.i_eb, 1)
-    cap_min = jnp.asarray(batch.batt_cap_min).astype(dtype)
-    cap_max = jnp.asarray(batch.batt_cap_max).astype(dtype)
-    seg(cap_min, cap_max, lay.i_eb + 1, H)
+    if lay.has_batt:
+        seg(-inf, inf, lay.i_eb, 1)
+        cap_min = jnp.asarray(batch.batt_cap_min).astype(dtype)
+        cap_max = jnp.asarray(batch.batt_cap_max).astype(dtype)
+        seg(cap_min, cap_max, lay.i_eb + 1, H)
     seg(jnp.asarray(batch.temp_in_min).astype(dtype), jnp.asarray(batch.temp_in_max).astype(dtype), lay.i_tin1, 1)
     seg(jnp.asarray(batch.temp_wh_min).astype(dtype), jnp.asarray(batch.temp_wh_max).astype(dtype), lay.i_twh1, 1)
 
@@ -423,20 +482,22 @@ def assemble_qp_step(
     q = q.at[:, lay.i_cool : lay.i_cool + H].set(wp * (s * jnp.asarray(batch.hvac_p_c)[:, None]).astype(dtype))
     q = q.at[:, lay.i_heat : lay.i_heat + H].set(wp * (s * jnp.asarray(batch.hvac_p_h)[:, None]).astype(dtype))
     q = q.at[:, lay.i_wh : lay.i_wh + H].set(wp * (s * jnp.asarray(batch.wh_p)[:, None]).astype(dtype))
-    q = q.at[:, lay.i_pch : lay.i_pch + H].set(wp * s)
-    q = q.at[:, lay.i_pd : lay.i_pd + H].set(wp * s)
-    # PV: p_grid -= s * pvc[k] * (1 - u_curt[k]); the constant term is
-    # dropped from q (it shifts the objective, not the argmin) and the
-    # u_curt coefficient is +w*price*s*pvc (dragg/mpc_calc.py:380-385,410-432).
-    ghi = jnp.asarray(ghi_window).astype(dtype)
-    pvc = (
-        jnp.asarray(batch.pv_area)[:, None]
-        * jnp.asarray(batch.pv_eff)[:, None]
-        * jnp.asarray(batch.has_pv)[:, None]
-        * ghi[None, :H]
-        / 1000.0
-    ).astype(dtype)
-    q = q.at[:, lay.i_curt : lay.i_curt + H].set(wp * s * pvc)
+    if lay.has_batt:
+        q = q.at[:, lay.i_pch : lay.i_pch + H].set(wp * s)
+        q = q.at[:, lay.i_pd : lay.i_pd + H].set(wp * s)
+    if lay.has_curt:
+        # PV: p_grid -= s * pvc[k] * (1 - u_curt[k]); the constant term is
+        # dropped from q (it shifts the objective, not the argmin) and the
+        # u_curt coefficient is +w*price*s*pvc (dragg/mpc_calc.py:380-385,410-432).
+        ghi = jnp.asarray(ghi_window).astype(dtype)
+        pvc = (
+            jnp.asarray(batch.pv_area)[:, None]
+            * jnp.asarray(batch.pv_eff)[:, None]
+            * jnp.asarray(batch.has_pv)[:, None]
+            * ghi[None, :H]
+            / 1000.0
+        ).astype(dtype)
+        q = q.at[:, lay.i_curt : lay.i_curt + H].set(wp * s * pvc)
     return QPStep(vals=vals, b_eq=b, l_box=l, u_box=u, q=q)
 
 
@@ -454,9 +515,11 @@ def shift_warm_start(x, lay: QPLayout):
         return v.at[:, i0 : i0 + L - 1].set(v[:, i0 + 1 : i0 + L])
 
     for i0 in (lay.i_cool, lay.i_heat, lay.i_wh, lay.i_pch, lay.i_pd, lay.i_curt):
-        x = sh(x, i0, H)
+        if i0 is not None:
+            x = sh(x, i0, H)
     for i0, L in ((lay.i_tin, H + 1), (lay.i_twh, H + 1), (lay.i_eb, H + 1)):
-        x = sh(x, i0, L)
+        if i0 is not None:
+            x = sh(x, i0, L)
     return x
 
 
@@ -484,14 +547,20 @@ class MPCSolution(NamedTuple):
 def recover_solution(x, lay: QPLayout, batch, ghi_window, price_total, s: float) -> MPCSolution:
     """Extract physical series from the stacked variable vector and rebuild
     the eliminated p_load / p_pv / p_grid / cost
-    (dragg/mpc_calc.py:342,380-432,444)."""
+    (dragg/mpc_calc.py:342,380-432,444).
+
+    Absent blocks (a reduced :class:`HomeTypeSpec`) come back as exact
+    zeros — identical to the superset solve, whose [0, 0] boxes clip the
+    dead variables to 0 in the returned (box-projected) primal."""
     H = lay.H
+    B = x.shape[0]
+    zH = jnp.zeros((B, H), dtype=x.dtype)
     cool = x[:, lay.i_cool : lay.i_cool + H]
     heat = x[:, lay.i_heat : lay.i_heat + H]
     wh = x[:, lay.i_wh : lay.i_wh + H]
-    p_ch = x[:, lay.i_pch : lay.i_pch + H]
-    p_disch = x[:, lay.i_pd : lay.i_pd + H]
-    u_curt = x[:, lay.i_curt : lay.i_curt + H]
+    p_ch = x[:, lay.i_pch : lay.i_pch + H] if lay.has_batt else zH
+    p_disch = x[:, lay.i_pd : lay.i_pd + H] if lay.has_batt else zH
+    u_curt = x[:, lay.i_curt : lay.i_curt + H] if lay.has_curt else zH
     ghi = jnp.asarray(ghi_window)[None, :H]
     pvc = (
         jnp.asarray(batch.pv_area)[:, None]
@@ -508,12 +577,14 @@ def recover_solution(x, lay: QPLayout, batch, ghi_window, price_total, s: float)
     )
     p_grid = p_load + s * (p_ch + p_disch) - s * p_pv
     cost = price_total * p_grid
+    e_batt = (x[:, lay.i_eb : lay.i_eb + H + 1] if lay.has_batt
+              else jnp.zeros((B, H + 1), dtype=x.dtype))
     return MPCSolution(
         cool=cool, heat=heat, wh=wh, p_ch=p_ch, p_disch=p_disch, u_curt=u_curt,
         p_pv=p_pv, p_load=p_load, p_grid=p_grid, cost=cost,
         temp_in_ev=x[:, lay.i_tin : lay.i_tin + H + 1],
         temp_wh_ev=x[:, lay.i_twh : lay.i_twh + H + 1],
-        e_batt=x[:, lay.i_eb : lay.i_eb + H + 1],
+        e_batt=e_batt,
         temp_in1=x[:, lay.i_tin1],
         temp_wh1=x[:, lay.i_twh1],
     )

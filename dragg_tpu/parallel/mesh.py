@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dragg_tpu.engine import CommunityState, Engine, EngineParams
+from dragg_tpu.homes import pad_batch  # noqa: F401 — re-exported API
 
 HOMES_AXIS = "homes"
 
@@ -39,30 +40,13 @@ def make_mesh(n_devices: int | None = None, axis_name: str = HOMES_AXIS,
     return Mesh(np.asarray(devices), (axis_name,))
 
 
-def pad_batch(batch, multiple: int):
-    """Pad every per-home array to a multiple of the shard count.
-
-    Padding replicates the last home (edge padding) so the dummy problems
-    remain well-posed (no zero tank sizes / RC constants); the returned mask
-    is 0 for padded homes so aggregate reductions are unchanged.
-    """
-    n = batch.n_homes
-    n_pad = (-n) % multiple
-    if n_pad == 0:
-        return batch, np.ones(n)
-    padded = type(batch)(*[
-        np.pad(np.asarray(f), [(0, n_pad)] + [(0, 0)] * (np.asarray(f).ndim - 1),
-               mode="edge")
-        for f in batch
-    ])
-    mask = np.concatenate([np.ones(n), np.zeros(n_pad)])
-    return padded, mask
-
-
-def shard_state(state: CommunityState, mesh: Mesh,
-                axis_name: str = HOMES_AXIS) -> CommunityState:
+def shard_state(state, mesh: Mesh, axis_name: str = HOMES_AXIS):
     """Place a CommunityState on the mesh: per-home leaves sharded on dim 0,
-    the PRNG key replicated."""
+    the PRNG key replicated.  A type-bucketed engine's state is a TUPLE of
+    per-bucket CommunityStates (each bucket shard-padded independently);
+    each is placed the same way."""
+    if isinstance(state, tuple) and not isinstance(state, CommunityState):
+        return tuple(shard_state(s, mesh, axis_name) for s in state)
     sharded = NamedSharding(mesh, P(axis_name))
     replicated = NamedSharding(mesh, P())
     return CommunityState(*[
@@ -102,9 +86,19 @@ class ShardedEngine(Engine):
         self._mesh_shards = n_shards
         if check_mask is None:
             check_mask = np.ones(batch.n_homes)
-        batch, pad_mask = pad_batch(batch, n_shards)
-        check_mask = np.pad(np.asarray(check_mask, dtype=np.float64),
-                            (0, batch.n_homes - self.true_n_homes)) * pad_mask
+        # Type-bucketed engines pad PER BUCKET (Engine._build_buckets, so
+        # every bucket slice divides the mesh evenly) — the plan must be
+        # resolved on the UNPADDED batch here, before the whole-batch
+        # padding would append edge-replica homes whose type codes could
+        # flip an "auto" decision.
+        from dragg_tpu.engine import resolve_bucket_plan
+
+        self._bucket_ranges = resolve_bucket_plan(params.bucketed,
+                                                  batch.type_code)
+        if self._bucket_ranges is None:
+            batch, pad_mask = pad_batch(batch, n_shards)
+            check_mask = np.pad(np.asarray(check_mask, dtype=np.float64),
+                                (0, batch.n_homes - self.true_n_homes)) * pad_mask
         super().__init__(params, batch, env_oat, env_ghi, env_tou,
                          check_mask=check_mask)
 
@@ -113,13 +107,36 @@ class ShardedEngine(Engine):
         put_s = lambda a: jax.device_put(jnp.asarray(np.asarray(a)), shard)
         put_r = lambda a: jax.device_put(jnp.asarray(np.asarray(a)), rep)
 
-        # Replicated environment series; sharded per-home device constants.
+        # Replicated environment series.
         self._oat = put_r(self._oat)
         self._ghi = put_r(self._ghi)
         self._tou = put_r(self._tou)
+        if self._bucketed:
+            # Per-home constants live in the bucket contexts (each bucket
+            # padded to a mesh multiple); commit each bucket's arrays with
+            # the homes sharding.  The engine-level superset copies stay
+            # unsharded — the bucketed trace never reads them, and jit
+            # drops unused inputs at compile.
+            for c in self._buckets:
+                st = c.static
+                c.static = type(st)(
+                    rows=st.rows, cols=st.cols, whmix_pos=st.whmix_pos,
+                    pattern=st.pattern,
+                    vals=put_s(st.vals), a_in=put_s(st.a_in),
+                    a_wh=put_s(st.a_wh), kin=put_s(st.kin),
+                    kwh=put_s(st.kwh), awr=put_s(st.awr),
+                )
+                c.batch = type(c.batch)(*[put_s(f) for f in c.batch])
+                c.draws = put_s(c.draws)
+                c.tank = put_s(c.tank)
+                c.check_mask = put_s(c.check_mask)
+                c.home_idx = put_s(c.home_idx)
+            return
+        # Sharded per-home device constants (superset batch).
         self._draws = put_s(self._draws)
         self._tank = put_s(self._tank)
         self._check_mask = put_s(self._check_mask)
+        self._home_idx = put_s(self._home_idx)
         # QP static: shared sparsity indices stay host-side numpy constants;
         # per-home coefficient arrays are sharded.
         st = self.static
@@ -134,7 +151,7 @@ class ShardedEngine(Engine):
         # sharding instead of baking replicated host constants.
         self.batch = type(batch)(*[put_s(f) for f in batch])
 
-    def init_state(self) -> CommunityState:
+    def init_state(self):
         return shard_state(super().init_state(), self.mesh, self.axis_name)
 
 

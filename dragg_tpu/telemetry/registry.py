@@ -101,6 +101,21 @@ METRICS: dict[str, tuple[str, str]] = {
     "bench.phase.merge_collect_s": ("histogram",
                                     "bench merge/collect-phase seconds "
                                     "per step"),
+    # Type-bucketed engine (tpu.bucketed): per-bucket solve-phase seconds
+    # per step, one literal per home type (separately-jitted bucket solve
+    # — engine.bucket_solve_fns; absent buckets simply never observe).
+    "bench.phase.solve_pv_battery_s": ("histogram",
+                                       "bench pv_battery-bucket solve "
+                                       "seconds per step (bucketed)"),
+    "bench.phase.solve_pv_only_s": ("histogram",
+                                    "bench pv_only-bucket solve seconds "
+                                    "per step (bucketed)"),
+    "bench.phase.solve_battery_only_s": ("histogram",
+                                         "bench battery_only-bucket solve "
+                                         "seconds per step (bucketed)"),
+    "bench.phase.solve_base_s": ("histogram",
+                                 "bench base-bucket solve seconds per "
+                                 "step (bucketed)"),
     "bench.rate_ts_per_s": ("gauge", "headline sim-timesteps/s"),
     "bench.flops_per_step": ("gauge",
                              "analytic FLOPs per sim step — the MFU "

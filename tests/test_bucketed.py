@@ -1,0 +1,228 @@
+"""Type-bucketed shape specialization (tpu.bucketed) — parity + plumbing.
+
+The bucketed engine solves each home-type bucket at a type-specialized
+(n, m) shape instead of padding every home to the superset pv_battery
+layout (docs/architecture.md §10).  Parity follows the
+tests/test_qp_parity.py convention: compare OBJECTIVES and applied
+actions, not solver iterates — per-home trajectories are identical math
+modulo fp reassociation across the different batch shapes, but
+degenerate variables (curtailment at GHI=0) may legitimately differ.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from dragg_tpu.config import default_config
+from dragg_tpu.data import load_environment, load_waterdraw_profiles
+from dragg_tpu.engine import (
+    BUCKETED_MIN_HOMES,
+    make_engine,
+    resolve_bucket_plan,
+)
+from dragg_tpu.homes import build_home_batch, create_homes, type_bucket_ranges
+from dragg_tpu.ops.qp import QPLayout, TYPE_SPECS
+
+
+# ------------------------------------------------------------ layout/plan
+def test_layout_specs_shapes():
+    """Each spec's (n, m_eq) drops exactly the absent blocks; the superset
+    spec reproduces the historical fixed layout."""
+    H = 24
+    lay = QPLayout(H)
+    assert (lay.n, lay.m_eq) == (9 * H + 5, 3 * H + 5)
+    assert lay.i_curt == 5 * H and lay.i_eb == 8 * H + 2
+    expect = {
+        "pv_battery": (9 * H + 5, 3 * H + 5),
+        "pv_only": (6 * H + 4, 2 * H + 4),
+        "battery_only": (8 * H + 5, 3 * H + 5),
+        "base": (5 * H + 4, 2 * H + 4),
+    }
+    for name, spec in TYPE_SPECS.items():
+        lay_t = QPLayout(H, spec)
+        assert (lay_t.n, lay_t.m_eq) == expect[name], name
+        if not spec.has_batt:
+            assert lay_t.i_pch is None and lay_t.i_eb is None \
+                and lay_t.r_ebd is None
+        if not spec.has_curt:
+            assert lay_t.i_curt is None
+        # The shared blocks keep their relative order: controls first,
+        # then evolution states, then the one-step deterministic temps.
+        assert lay_t.i_cool == 0 and lay_t.i_twh1 == lay_t.n - 1
+
+
+def test_resolve_bucket_plan():
+    """Tri-state resolution: auto thresholds, forced true/false, and the
+    grouped-by-type requirement."""
+    mixed = np.array([0] * 4 + [1] * 20 + [2] * 4 + [3] * 20)  # 48 homes
+    tiny = np.array([0, 1, 3])
+    all_superset = np.zeros(64, dtype=int)
+    interleaved = np.array([0, 3, 0, 3] * 16)
+
+    assert resolve_bucket_plan("false", mixed) is None
+    plan = resolve_bucket_plan("auto", mixed)
+    assert [p[0] for p in plan] == ["pv_battery", "pv_only",
+                                    "battery_only", "base"]
+    assert resolve_bucket_plan("auto", tiny) is None        # < min homes
+    assert len(tiny) < BUCKETED_MIN_HOMES
+    assert resolve_bucket_plan("auto", all_superset) is None  # no win
+    assert resolve_bucket_plan("auto", interleaved) is None
+    assert resolve_bucket_plan("true", all_superset) is not None
+    with pytest.raises(ValueError, match="grouped"):
+        resolve_bucket_plan("true", interleaved)
+    # Absent types produce no range — never a zero-width bucket.
+    assert type_bucket_ranges(np.array([1, 1, 3, 3])) == [
+        ("pv_only", 0, 2), ("base", 2, 4)]
+
+
+# ---------------------------------------------------------------- parity
+def _mixed_setup(n=64, pv=26, bat=6, pvb=6, horizon=4):
+    """The 64-home mixed community of the parity satellite (bench-mix
+    ratios)."""
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = n
+    cfg["community"]["homes_pv"] = pv
+    cfg["community"]["homes_battery"] = bat
+    cfg["community"]["homes_pv_battery"] = pvb
+    cfg["home"]["hems"]["prediction_horizon"] = horizon
+    env = load_environment(cfg, data_dir=None)
+    wd = load_waterdraw_profiles(None, seed=12)
+    homes = create_homes(cfg, 24, 1, wd)
+    batch = build_home_batch(homes, horizon, 1,
+                             int(cfg["home"]["hems"]["sub_subhourly_steps"]))
+    return cfg, env, batch
+
+
+@pytest.fixture(scope="module")
+def parity_runs():
+    """Superset vs bucketed chunk outputs on the same 64-home community
+    (module-scoped: three engine compiles, asserted by several tests)."""
+    cfg, env, batch = _mixed_setup()
+    cfg_sup = copy.deepcopy(cfg)
+    cfg_sup["tpu"]["bucketed"] = "false"
+    eng_sup = make_engine(batch, env, cfg_sup, 0)
+    assert not eng_sup.bucketed
+    eng_bkt = make_engine(batch, env, cfg, 0)  # auto → bucketed at 64 homes
+    assert eng_bkt.bucketed
+    rps = np.zeros((3, eng_sup.params.horizon), np.float32)
+    _, out_sup = eng_sup.run_chunk(eng_sup.init_state(), 0, rps)
+    _, out_bkt = eng_bkt.run_chunk(eng_bkt.init_state(), 0, rps)
+    return cfg, env, batch, eng_sup, eng_bkt, out_sup, out_bkt
+
+
+def _assert_outputs_match(out_ref, out_bkt, cols, s):
+    """Shared parity assertions: objectives + applied k=0 actions +
+    physical state, bucketed mapped back to community order."""
+    ref = {f: np.asarray(getattr(out_ref, f)) for f in out_ref._fields}
+    bkt = {}
+    for f in out_bkt._fields:
+        a = np.asarray(getattr(out_bkt, f))
+        bkt[f] = a[:, cols] if a.ndim == 2 else a
+
+    # Identical StepOutputs ordering: solvedness per home must line up
+    # exactly (a permutation would scramble it across home types).
+    np.testing.assert_array_equal(bkt["correct_solve"], ref["correct_solve"])
+
+    # Objectives (the test_qp_parity convention): per-home step cost and
+    # the aggregate, to solver tolerance.
+    np.testing.assert_allclose(bkt["cost"], ref["cost"], rtol=1e-2, atol=2e-3)
+    np.testing.assert_allclose(bkt["agg_cost"], ref["agg_cost"],
+                               rtol=1e-2, atol=5e-3)
+    np.testing.assert_allclose(bkt["agg_load"], ref["agg_load"],
+                               rtol=1e-2, atol=5e-3)
+
+    # Applied k=0 actions: duty counts are integers (integer_first_action
+    # default); bucketing must not move any action by more than one count
+    # (a rounding flip on a near-.5 relaxed value), and almost all must
+    # match exactly.
+    exact = total = 0
+    for key in ("hvac_cool_on", "hvac_heat_on", "wh_heat_on"):
+        counts_r = ref[key] * s
+        counts_b = bkt[key] * s
+        assert np.max(np.abs(counts_b - counts_r)) <= 1 + 1e-3, key
+        exact += int(np.sum(np.abs(counts_b - counts_r) < 1e-3))
+        total += counts_r.size
+    assert exact / total >= 0.95, f"only {exact}/{total} actions match"
+    np.testing.assert_allclose(bkt["p_batt_ch"], ref["p_batt_ch"],
+                               atol=2e-3)
+    np.testing.assert_allclose(bkt["p_batt_disch"], ref["p_batt_disch"],
+                               atol=2e-3)
+    # Physical state trajectories.
+    np.testing.assert_allclose(bkt["temp_in"], ref["temp_in"], atol=1e-3)
+    np.testing.assert_allclose(bkt["temp_wh"], ref["temp_wh"], atol=1e-3)
+    np.testing.assert_allclose(bkt["e_batt"], ref["e_batt"], atol=2e-3)
+
+
+def test_bucketed_matches_superset_single_device(parity_runs):
+    cfg, _env, _batch, eng_sup, eng_bkt, out_sup, out_bkt = parity_runs
+    s = eng_sup.params.s
+    cols = eng_bkt.real_home_cols
+    # Unsharded buckets carry no padding — slot order IS community order.
+    np.testing.assert_array_equal(cols, np.arange(64))
+    _assert_outputs_match(out_sup, out_bkt, cols, s)
+    # Solver telemetry scalars merge as the binding bucket; they must stay
+    # in the same ballpark as the superset solve's residuals.
+    assert float(np.max(np.asarray(out_bkt.r_prim_max))) < 1.0
+
+
+def test_bucketed_zero_blocks_are_exact(parity_runs):
+    """Battery/PV outputs of homes without those blocks are EXACT zeros —
+    identical to the superset path's clipped [0, 0] boxes."""
+    _cfg, _env, batch, _eng_sup, eng_bkt, _out_sup, out_bkt = parity_runs
+    cols = eng_bkt.real_home_cols
+    no_batt = np.asarray(batch.has_batt) == 0
+    no_pv = np.asarray(batch.has_pv) == 0
+    for f in ("p_batt_ch", "p_batt_disch", "e_batt"):
+        a = np.asarray(getattr(out_bkt, f))[:, cols]
+        assert np.all(a[:, no_batt] == 0.0), f
+    assert np.all(np.asarray(out_bkt.p_pv)[:, cols][:, no_pv] == 0.0)
+
+
+def test_bucketed_sharded_matches_superset_8dev_mesh(parity_runs):
+    """The parity satellite's 8-device leg: bucketed + per-bucket shard
+    padding on the conftest CPU mesh vs the single-device superset run.
+    Residual-max scalars keep the established 1e-3 tolerance (max over
+    non-contractive iterates amplifies per-compile fp wobble)."""
+    from dragg_tpu.parallel import make_mesh, make_sharded_engine
+
+    cfg, env, batch, eng_sup, _eng_bkt, out_sup, _out_bkt = parity_runs
+    sh = make_sharded_engine(batch, env, cfg, 0, mesh=make_mesh(8))
+    assert sh.bucketed
+    # Per-bucket shard padding: every bucket's slot count divides the mesh.
+    for b in sh.bucket_info():
+        assert b["n_slots"] % 8 == 0 and b["n_slots"] > 0
+    rps = np.zeros((3, sh.params.horizon), np.float32)
+    state = sh.init_state()
+    assert isinstance(state, tuple) and len(state) == 4
+    assert "homes" in str(state[0].temp_in.sharding.spec)
+    _, out_sh = sh.run_chunk(state, 0, rps)
+    cols = sh.real_home_cols
+    assert len(cols) == 64 and len(set(cols.tolist())) == 64
+    _assert_outputs_match(out_sup, out_sh, cols, sh.params.s)
+    for f in ("r_prim_max", "r_dual_max"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(out_sh, f)),
+            np.asarray(getattr(out_sup, f)), rtol=1e-3, atol=1e-3,
+            err_msg=f)
+
+
+def test_bucketed_checkpoint_roundtrip(parity_runs):
+    """The per-bucket state tuple survives a save/load cycle through the
+    structure-agnostic pytree checkpoint (resume carries bucketed runs)."""
+    import os
+    import tempfile
+
+    _cfg, _env, _batch, _eng_sup, eng_bkt, _o, _o2 = parity_runs
+    from dragg_tpu.checkpoint import load_pytree, save_pytree
+
+    rps = np.zeros((2, eng_bkt.params.horizon), np.float32)
+    state, _ = eng_bkt.run_chunk(eng_bkt.init_state(), 0, rps)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "state.npz")
+        save_pytree(path, state)
+        restored = load_pytree(path, eng_bkt.init_state())
+    for st, rt in zip(state, restored):
+        for name, a, b in zip(st._fields, st, rt):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)

@@ -315,7 +315,7 @@ def test_project_repair_checks_applied_wh_row():
     eng = make_engine(batch, env, cfg, 0)
     lay = eng.layout
     state = eng.init_state()
-    qp, _aux = eng._prepare(state, jnp.asarray(0),
+    qp, _aux = eng._prepare(eng._ctx0, state, jnp.asarray(0),
                             jnp.zeros((eng.params.horizon,), jnp.float32))
     from dragg_tpu.ops.ipm import ipm_solve_qp
 
@@ -337,7 +337,8 @@ def test_project_repair_checks_applied_wh_row():
     def no_solver(l2, u2):  # project mode must never call it
         raise AssertionError("project mode called the solver")
 
-    merged, _rf = eng._integerize_first_action(qp, tampered, no_solver)
+    merged, _rf = eng._integerize_first_action(eng._ctx0, qp, tampered,
+                                               no_solver)
     out_ap = np.asarray(merged.x)[:, lay.i_twh1]
     # Every home must end in-band on the APPLIED row (within the fp32
     # gate tolerance) — either via a comfort-safe pin or by keeping the

@@ -28,8 +28,7 @@ CASES = [
 ]
 
 
-@pytest.mark.parametrize("h,dt,s,n,pv,bat,pvb,seed", CASES)
-def test_engine_invariants_across_config_corners(h, dt, s, n, pv, bat, pvb, seed):
+def _run_corner(h, dt, s, n, pv, bat, pvb, seed, bucketed="auto"):
     cfg = copy.deepcopy(default_config())
     cfg["community"]["total_number_homes"] = n
     cfg["community"]["homes_pv"] = pv
@@ -39,6 +38,7 @@ def test_engine_invariants_across_config_corners(h, dt, s, n, pv, bat, pvb, seed
     cfg["agg"]["subhourly_steps"] = dt
     cfg["home"]["hems"]["prediction_horizon"] = h
     cfg["home"]["hems"]["sub_subhourly_steps"] = s
+    cfg["tpu"]["bucketed"] = bucketed
 
     env = load_environment(cfg, data_dir=None)
     wd = load_waterdraw_profiles(None, seed=seed)
@@ -65,6 +65,45 @@ def test_engine_invariants_across_config_corners(h, dt, s, n, pv, bat, pvb, seed
     assert (tw > 0).all() and (tw < 90).all()
     # At least the bulk of home-steps solve at every corner.
     assert solved.mean() > 0.5, f"solve rate {solved.mean():.2f} at {h,dt,s}"
+    return eng
+
+
+@pytest.mark.parametrize("h,dt,s,n,pv,bat,pvb,seed", CASES)
+def test_engine_invariants_across_config_corners(h, dt, s, n, pv, bat, pvb, seed):
+    _run_corner(h, dt, s, n, pv, bat, pvb, seed)
+
+
+# Type-mix corners for the bucketed engine (tpu.bucketed), including the
+# degenerate bucket shapes: all-base (one reduced bucket), all-pv_battery
+# (one superset-shaped bucket), one-home buckets, a type absent entirely,
+# and the smallest community where "auto" flips bucketing on.  The engine
+# invariants must hold and no zero-width bucket may ever compile.
+BUCKETED_CASES = [
+    # (h, dt, s, n, pv, bat, pvb, seed, bucketed, expect_bucketed)
+    (2, 1, 4, 5, 0, 0, 0, 7, "true", True),     # all-base
+    (2, 1, 6, 4, 0, 0, 4, 8, "true", True),     # all-pv_battery
+    (3, 1, 6, 4, 1, 1, 1, 9, "true", True),     # one-home buckets, all types
+    (1, 2, 2, 5, 2, 0, 2, 10, "true", True),    # battery_only absent, h*dt=2
+    (1, 1, 2, 4, 1, 1, 1, 11, "true", True),    # minimum horizon, tiny buckets
+    (2, 1, 6, 33, 13, 4, 3, 12, "auto", True),  # smallest auto-on community
+    (2, 1, 6, 33, 0, 0, 33, 13, "auto", False),  # auto off: all-superset
+]
+
+
+@pytest.mark.parametrize("h,dt,s,n,pv,bat,pvb,seed,bucketed,expect", BUCKETED_CASES)
+def test_engine_invariants_across_type_mixes(h, dt, s, n, pv, bat, pvb, seed,
+                                             bucketed, expect):
+    eng = _run_corner(h, dt, s, n, pv, bat, pvb, seed, bucketed=bucketed)
+    assert eng.bucketed == expect, (eng.bucketed, expect)
+    info = eng.bucket_info()
+    assert all(b["n_slots"] > 0 and b["n_real"] > 0 for b in info), info
+    if eng.bucketed:
+        # Only the types present in the mix become buckets — an absent
+        # type must not produce a zero-width compiled bucket.
+        present = {t for t, c in (("pv_only", pv), ("battery_only", bat),
+                                  ("pv_battery", pvb),
+                                  ("base", n - pv - bat - pvb)) if c > 0}
+        assert {b["name"] for b in info} == present
 
 
 def test_shipped_example_config_matches_defaults():

@@ -102,7 +102,7 @@ def test_closed_loop_cost_within_1pct_of_milp_oracle():
     cost_oracle = 0.0
     solved_oracle = []
     for t in range(N_STEPS):
-        qp, aux = eng._prepare(ostate, jnp.asarray(t),
+        qp, aux = eng._prepare(eng._ctx0, ostate, jnp.asarray(t),
                                jnp.zeros((H,), jnp.float32))
         A = np.asarray(densify_A(eng.static.pattern, qp.vals), np.float64)
         beq = np.asarray(qp.b_eq, np.float64)
@@ -125,7 +125,8 @@ def test_closed_loop_cost_within_1pct_of_milp_oracle():
             x=x, y_eq=jnp.zeros_like(qp.b_eq), y_box=jnp.zeros_like(x),
             r_prim=zeros, r_dual=zeros, solved=okv, infeasible=~okv,
             iters=jnp.asarray(0), rho=jnp.ones((n,), jnp.float32))
-        ostate, out = eng._finish(ostate, jnp.asarray(t), sol, aux, sol)
+        ostate, out = eng._finish(eng._ctx0, ostate, jnp.asarray(t), sol,
+                                  aux, sol)
         cost_oracle += float(np.sum(np.asarray(out.cost)))
         solved_oracle.append(np.asarray(out.correct_solve) == 1.0)
 

@@ -30,6 +30,16 @@ def main():
     ap.add_argument("--horizon-hours", type=int, default=24)
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--kernels", default="pallas,xla,cr")
+    ap.add_argument("--bucketed", choices=["auto", "true", "false"],
+                    default="false",
+                    help="tpu.bucketed for the timed engine.  Default "
+                         "false: the kernel verdicts that set the 'auto' "
+                         "band policy must stay comparable to the "
+                         "superset-shaped docs/onchip_r4 artifacts — a "
+                         "bucketed engine changes every factored shape, "
+                         "which would skew the A/B for non-kernel reasons "
+                         "(CLAUDE.md: cross-round perf A/Bs pin "
+                         "--bucketed false)")
     args = ap.parse_args()
 
     import jax
@@ -44,7 +54,7 @@ def main():
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "homes": args.homes, "horizon_h": args.horizon_hours,
-        "steps": args.steps,
+        "steps": args.steps, "bucketed": args.bucketed,
     }
 
     timings = {}
@@ -55,7 +65,8 @@ def main():
             # and sim window as the headline bench, one definition).
             eng, _np = bench_mod.build(args.homes, args.horizon_hours,
                                        1000, solver="ipm",
-                                       band_kernel=kern)
+                                       band_kernel=kern,
+                                       bucketed=args.bucketed)
             eng = eng if eng.band_kernel == kern else None
             if eng is None:
                 timings[kern] = None

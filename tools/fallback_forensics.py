@@ -57,9 +57,12 @@ def main() -> None:
     import bench
     from dragg_tpu.ops.qp import densify_A
 
+    # Superset-pinned: this tool densifies the ONE shared pattern and
+    # cross-checks every home against HiGHS on it; the bucketed engine
+    # has per-type patterns instead.
     engine, _np = bench.build(args.homes, args.horizon_hours,
                               admm_iters=1500, solver=args.solver,
-                              data_dir=args.data_dir)
+                              data_dir=args.data_dir, bucketed="false")
     pat = engine.static.pattern
     H = engine.params.horizon
     state = engine.init_state()
@@ -72,7 +75,7 @@ def main() -> None:
     for t in range(args.steps):
         import jax.numpy as jnp
 
-        qp, _aux = engine._prepare(state, jnp.asarray(t),
+        qp, _aux = engine._prepare(engine._ctx0, state, jnp.asarray(t),
                                    jnp.zeros((H,), jnp.float32))
         state, out = engine.step(state, t, np.zeros((H,), np.float32))
         cs = np.asarray(out.correct_solve)

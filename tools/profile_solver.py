@@ -45,6 +45,10 @@ def main():
     cfg["community"]["homes_battery"] = int(0.1 * B)
     cfg["community"]["homes_pv_battery"] = int(0.1 * B)
     cfg["home"]["hems"]["prediction_horizon"] = H
+    # This tool times the SUPERSET-shaped ADMM components (factor, S
+    # formation, iteration window) — pin the one-batch path so the
+    # shapes printed match the matrices timed.
+    cfg["tpu"]["bucketed"] = "false"
     env = load_environment(cfg, data_dir=None)
     wd = load_waterdraw_profiles(None, seed=12)
     homes = create_homes(cfg, 24 * 7, 1, wd)
@@ -52,7 +56,10 @@ def main():
     batch = build_home_batch(homes, H, 1, int(hems["sub_subhourly_steps"]))
     eng = make_engine(batch, env, cfg, 0)
     state = eng.init_state()
-    qp, aux = jax.jit(eng._prepare)(state, jnp.asarray(0), jnp.zeros((H,), jnp.float32))
+    from functools import partial
+
+    qp, aux = jax.jit(partial(eng._prepare, eng._ctx0))(
+        state, jnp.asarray(0), jnp.zeros((H,), jnp.float32))
     jax.block_until_ready(qp.vals)
     pat = eng.static.pattern
     m, n = pat.m, pat.n

@@ -30,6 +30,16 @@ def main():
     ap.add_argument("--days", type=int, default=2)
     ap.add_argument("--chunk", type=int, default=8)
     ap.add_argument("--solver", choices=["admm", "ipm"], default="admm")
+    ap.add_argument("--mix", default=None,
+                    help="comma fractions pv,battery,pv_battery of the "
+                         "population (default 0.4,0.1,0.1 — the bench "
+                         "mix); e.g. --mix 0,0,0 for an all-base "
+                         "bucket-heavy community or --mix 0,0,1 for "
+                         "superset-only")
+    ap.add_argument("--bucketed", choices=["auto", "true", "false"],
+                    default="auto",
+                    help="tpu.bucketed override for the scale check "
+                         "(docs/config.md)")
     ap.add_argument("--min-solve-rate", type=float, default=0.97)
     ap.add_argument("--sharded", action="store_true",
                     help="shard the home axis over every visible device "
@@ -92,11 +102,30 @@ def main():
     cfg = default_config()
     n = args.homes
     cfg["community"]["total_number_homes"] = n
-    cfg["community"]["homes_pv"] = int(0.4 * n)
-    cfg["community"]["homes_battery"] = int(0.1 * n)
-    cfg["community"]["homes_pv_battery"] = int(0.1 * n)
+    # Population mix: default is the bench mix; --mix exercises
+    # bucket-heavy (0,0,0 = all base) and superset-only (0,0,1)
+    # communities without editing config.
+    try:
+        f_pv, f_bat, f_pvb = (
+            (0.4, 0.1, 0.1) if args.mix is None
+            else tuple(float(v) for v in args.mix.split(",")))
+    except ValueError:
+        print(json.dumps({"ok": False,
+                          "error": f"--mix must be 3 comma fractions, got "
+                                   f"{args.mix!r}"}))
+        sys.exit(2)
+    if any(f < 0 for f in (f_pv, f_bat, f_pvb)) \
+            or f_pv + f_bat + f_pvb > 1.0 + 1e-9:
+        print(json.dumps({"ok": False,
+                          "error": f"--mix fractions must be >= 0 and sum "
+                                   f"<= 1, got {[f_pv, f_bat, f_pvb]}"}))
+        sys.exit(2)
+    cfg["community"]["homes_pv"] = int(f_pv * n)
+    cfg["community"]["homes_battery"] = int(f_bat * n)
+    cfg["community"]["homes_pv_battery"] = int(f_pvb * n)
     cfg["home"]["hems"]["prediction_horizon"] = args.horizon_hours
     cfg["home"]["hems"]["solver"] = args.solver
+    cfg["tpu"]["bucketed"] = args.bucketed
 
     from dragg_tpu.data import waterdraw_path
 
@@ -137,16 +166,17 @@ def main():
         state, outs = eng.run_chunk(state, t, rps)
         jax.block_until_ready(outs.agg_load)
         chunk_times.append(time.perf_counter() - t0)
-        # Sharded engines pad the home axis to a mesh multiple; validate
-        # only the real homes (replica homes are masked out of aggregates).
-        n_true = getattr(eng, "true_n_homes", n)
-        solved = np.asarray(outs.correct_solve)[:, :n_true]   # (k, n)
+        # Padded engines carry replica homes (whole-batch padding, or
+        # per-bucket padding when type-bucketed); validate only the real
+        # homes, mapped back to community order.
+        cols = eng.real_home_cols
+        solved = np.asarray(outs.correct_solve)[:, cols]   # (k, n)
         rates.append(float(solved.mean()))
         for leaf, name in zip(outs, outs._fields):
             a = np.asarray(leaf)
             assert np.all(np.isfinite(a)), f"non-finite {name} at t={t}"
-        tin = np.asarray(outs.temp_in)[:, :n_true]
-        twh = np.asarray(outs.temp_wh)[:, :n_true]
+        tin = np.asarray(outs.temp_in)[:, cols]
+        twh = np.asarray(outs.temp_wh)[:, cols]
         # Comfort bands on solved steps (unsolved steps run the bang-bang
         # fallback, which tolerates excursions by design).
         vi = np.where(solved > 0,
@@ -172,6 +202,8 @@ def main():
         "sharded": bool(args.sharded),
         "n_devices": len(jax.devices()) if args.sharded else 1,  # device-call-ok: supervised child
         "home_slots": eng.n_homes,
+        "mix": [f_pv, f_bat, f_pvb],
+        "bucketed": eng.bucketed,
         "solve_rate": round(solve_rate, 4),
         "comfort_violation_max": round(viol_max, 5),
         "timesteps_per_s": round(num_ts / sum(chunk_times), 3),
