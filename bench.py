@@ -93,7 +93,7 @@ def _log(msg: str) -> None:
 def build(n_homes: int, horizon_hours: int, admm_iters: int,
           solver: str = "admm", band_kernel: str | None = None,
           data_dir: str | None = None, semantics: str = "default",
-          bucketed: str = "auto"):
+          bucketed: str = "auto", per_home_obs: str = "true"):
     """Build THE benchmark community engine (population mix, sim window,
     solver config).  This is the one definition of the measured community —
     tools/bench_engine_kernels.py reuses it so kernel A/B verdicts are
@@ -121,6 +121,10 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
     cfg["tpu"]["admm_iters"] = admm_iters
     cfg["home"]["hems"]["solver"] = solver
     cfg["tpu"]["bucketed"] = bucketed
+    # Observatory A/B knob (round 9): "false" compiles the per-home
+    # attribution fold out of the device program so the overhead A/B in
+    # docs/perf_notes.md compares identical semantics.
+    cfg["telemetry"]["per_home"] = per_home_obs == "true"
     if band_kernel is not None:
         cfg["tpu"]["band_kernel"] = band_kernel
     if semantics != "default":
@@ -204,7 +208,8 @@ def run_measured(args) -> dict:
     engine, np = build(args.homes, args.horizon_hours, args.admm_iters,
                        solver="admm" if args.solver == "auto" else args.solver,
                        data_dir=args.data_dir, semantics=args.semantics,
-                       bucketed=args.bucketed)
+                       bucketed=args.bucketed,
+                       per_home_obs=args.per_home_obs)
     solver_used = engine.params.solver
     if args.solver == "auto":
         # Race the two solver families over SEVERAL sequential steps and
@@ -219,7 +224,8 @@ def run_measured(args) -> dict:
                                   args.admm_iters, solver="ipm",
                                   data_dir=args.data_dir,
                                   semantics=args.semantics,
-                                  bucketed=args.bucketed)
+                                  bucketed=args.bucketed,
+                                  per_home_obs=args.per_home_obs)
 
             def steps_time(eng, k=6, budget_s=60.0):
                 """Mean warm-step time over up to k steps, stopping early
@@ -270,11 +276,30 @@ def run_measured(args) -> dict:
 
     # Warmup with the SAME chunk shape as the timed run — the scan length is
     # baked into the compiled program, so a different shape would put a full
-    # recompile inside the timed window.
-    _log("warmup chunk (compile)...")
+    # recompile inside the timed window.  The warmup runs as a STAGED
+    # compile (telemetry/compile_obs): lower → compile → first-execute
+    # each get a heartbeat beat + compile.stage event with the per-bucket
+    # pattern shapes, so a supervised child that hangs here is killed
+    # with the STAGE named in its last progress payload (the round-4 10k
+    # hang was never bisected past "between build and first step"), and
+    # the persistent-cache hit/miss is recorded.  The timed chunks reuse
+    # the returned compiled executable — no second compile.
+    _log("warmup chunk (staged compile: lower -> compile -> execute)...")
+    creport = None
     with telemetry.span("bench.warmup_s"):
-        state, outs = engine.run_chunk(state, 0, rps)
-        jax.block_until_ready(outs.agg_load)
+        try:
+            from dragg_tpu.telemetry.compile_obs import staged_compile
+
+            run_chunk, state, outs, creport = staged_compile(
+                engine, state, 0, rps,
+                label=f"bench_{args.homes}x{args.horizon_hours}h")
+        except Exception as e:  # AOT quirk must never sink the benchmark
+            _log(f"staged compile failed ({e!r}); plain jit warmup")
+            run_chunk = engine.run_chunk
+            state, outs = run_chunk(state, 0, rps)
+            jax.block_until_ready(outs.agg_load)
+    if creport is not None:
+        _log(f"staged compile: {creport['stages']} cache={creport['cache']}")
     _log(f"warmup done; timing {args.chunks} chunks of {steps} steps")
 
     iters_per_step = []
@@ -283,7 +308,7 @@ def run_measured(args) -> dict:
     for c in range(args.chunks):
         fault_hook("bench_chunk")
         with telemetry.span("bench.chunk_s") as sp:
-            state, outs = engine.run_chunk(state, t_cursor, rps)
+            state, outs = run_chunk(state, t_cursor, rps)
             jax.block_until_ready(outs.agg_load)
         t_cursor += steps
         iters_per_step.append(float(np.mean(np.asarray(outs.admm_iters))))
@@ -490,7 +515,7 @@ def run_measured(args) -> dict:
     if trace_dir:
         try:
             with jax.profiler.trace(trace_dir):
-                state, outs = engine.run_chunk(state, 0, rps)
+                state, outs = run_chunk(state, 0, rps)
                 jax.block_until_ready(outs.agg_load)
             _log(f"profiler trace written to {trace_dir}")
         except Exception as e:
@@ -548,6 +573,11 @@ def run_measured(args) -> dict:
         "horizon_steps": H,
         "chunk_rates": [round(r, 3) for r in chunk_rates],
         "compile_s": round(compile_s, 1),
+        # Staged-compile attribution (telemetry/compile_obs): per-stage
+        # seconds + persistent-cache verdict (None when the AOT staging
+        # fell back to plain jit warmup).
+        "compile_stages": creport["stages"] if creport else None,
+        "compile_cache": creport["cache"] if creport else None,
         "admm_iters_per_step": round(float(np.mean(iters_per_step)), 1),
         "solve_rate": round(float(np.mean(solve_rates)), 4),
         "phase_s_per_step": {k: round(v, 4) for k, v in phases.items()} if phases else None,
@@ -583,6 +613,7 @@ def child_argv(args, platform: str, attempt: int,
         "--solver", args.solver,
         "--semantics", args.semantics,
         "--bucketed", args.bucketed,
+        "--per-home-obs", args.per_home_obs,
     ]
     if data_dir is not None:
         # "" is meaningful — it forces the synthetic generators (the
@@ -613,6 +644,12 @@ def main() -> None:
                     help="type-bucketed shape specialization (tpu.bucketed): "
                          "auto (default) buckets the bench mix; false pins "
                          "the one-batch superset path for A/Bs")
+    ap.add_argument("--per-home-obs", choices=["true", "false"],
+                    default="true", dest="per_home_obs",
+                    help="telemetry.per_home: the round-9 per-home solver "
+                         "attribution fold (histograms + worst-k on the "
+                         "StepOutputs transfer); false compiles it out — "
+                         "for the observatory overhead A/B")
     ap.add_argument("--semantics", choices=["default", "integer", "relaxation"],
                     default="default",
                     help="integer = integer_first_action repair (the shipped "

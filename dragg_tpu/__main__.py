@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="one classified liveness verdict as a JSON line "
                           "(names the failure: TUNNEL_DOWN / WEDGED) "
                           "instead of the full check table")
+    doc.add_argument("--compile-check", action="store_true",
+                     help="additionally run a tiny STAGED engine compile "
+                          "(lower/compile/first-execute stage timings + "
+                          "persistent-cache verdict) in a hard-timeouted "
+                          "subprocess — the observatory's compile-path "
+                          "self-test")
 
     sub.add_parser("bench", help="run the benchmark harness (prints one JSON line)")
 
@@ -228,7 +234,8 @@ def main(argv=None) -> int:
         from dragg_tpu.doctor import run_doctor
 
         return run_doctor(outputs_dir=args.outputs_dir,
-                          backend_timeout=args.backend_timeout)
+                          backend_timeout=args.backend_timeout,
+                          compile_check=args.compile_check)
     if args.cmd == "sweep":
         return run_sweep(args)
     if args.cmd == "dashboard":

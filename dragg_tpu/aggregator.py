@@ -47,6 +47,16 @@ _BASE_KEYS = {
 _PV_KEYS = {"p_pv_opt": "p_pv", "u_pv_curt_opt": "u_pv_curt"}
 _BATT_KEYS = {"e_batt_opt": "e_batt", "p_batt_ch": "p_batt_ch", "p_batt_disch": "p_batt_disch"}
 
+# Observatory (round 9): per-bucket conv-iters metric literals, the
+# bench.phase.solve_<type>_s precedent — absent buckets never observe.
+_CONV_ITERS_METRICS = {
+    "pv_battery": "solver.conv_iters_pv_battery",
+    "pv_only": "solver.conv_iters_pv_only",
+    "battery_only": "solver.conv_iters_battery_only",
+    "base": "solver.conv_iters_base",
+    "superset": "solver.conv_iters_superset",
+}
+
 
 class Aggregator:
     """Drop-in analog of the reference Aggregator (dragg/aggregator.py:29).
@@ -134,6 +144,9 @@ class Aggregator:
         # chunk.done onto the shared stream (and telemetry.enabled=false
         # would be overridden by a supervising parent's env export).
         self._telemetry_on = False
+        # Opt-in worst-k forensic dumps (telemetry.forensics — resolved
+        # with the rest of the [telemetry] config in _telemetry_open).
+        self._forensics_on = False
         # Persistent XLA compilation cache: a re-run of the same config
         # skips the 20-40 s cold compile entirely (docs/perf_notes.md).
         from dragg_tpu.utils.compile_cache import enable_compile_cache
@@ -280,6 +293,7 @@ class Aggregator:
         SAME host transfer as the collected series — StepOutputs carries
         it, so telemetry adds no extra device→host syncs."""
         from dragg_tpu.checkpoint import to_host
+        from dragg_tpu.engine import OBS_FIELDS
 
         n_true = getattr(self.engine, "true_n_homes", None) or self.engine.n_homes
         # Sharded engines pad the home axis (whole-batch padding at the
@@ -296,8 +310,12 @@ class Aggregator:
             # though only process 0 writes files.
             a = to_host(getattr(outs, f))
             # Replica homes are masked out of aggregates on device and
-            # dropped from per-home series here.
-            host[f] = a[:, cols] if a.ndim == 2 else a
+            # dropped from per-home series here.  Observatory leaves are
+            # per-BUCKET folds (histograms / worst-k), not per-home —
+            # their trailing axis is not the home axis, so they skip the
+            # real-home column slicing.
+            host[f] = a[:, cols] if a.ndim == 2 and f not in OBS_FIELDS \
+                else a
         n_steps = host["p_grid"].shape[0]
         for out_key, field in (*_BASE_KEYS.items(), *_PV_KEYS.items(), *_BATT_KEYS.items()):
             self.collector.add_chunk(out_key, host[field])
@@ -348,6 +366,7 @@ class Aggregator:
             telemetry.set_gauge("sim.timestep", self.timestep + n_steps)
             if n_repair_failed:
                 telemetry.inc("engine.repair_failed", n_repair_failed)
+            self._emit_observatory(host, n_steps)
         if n_repair_failed > 0:
             self.log.logger.progress(
                 f"chunk t={self.timestep}..{self.timestep + n_steps}: "
@@ -368,6 +387,131 @@ class Aggregator:
                 self.agg_setpoint = self.gen_setpoint()
                 if self.timestep < self.num_timesteps:
                     self.all_sps[self.timestep] = self.agg_setpoint
+
+    def _emit_observatory(self, host: dict, n_steps: int) -> None:
+        """Observatory emits for one chunk (round 9): fold the device-side
+        per-bucket histograms / worst-k capture (engine._per_home_obs —
+        riding the SAME host transfer as the series above) into
+        ``solver.convergence`` / ``solver.worst`` / ``solver.diverged``
+        events and the per-bucket conv-iters metrics, plus the opt-in
+        forensic dump (``telemetry.forensics``)."""
+        if not getattr(self.engine, "obs_enabled", False):
+            return
+        ch = np.asarray(host["conv_hist"])            # (T, nb, RBINS)
+        if ch.size == 0:
+            return
+        t0, t1 = self.timestep, self.timestep + n_steps
+        binfo = self.engine.bucket_info()
+        isum = np.asarray(host["iters_sum"])          # (T, nb)
+        dc = np.asarray(host["diverged_count"])       # (T, nb)
+        ih = np.asarray(host["iters_hist"])
+        for bi, b in enumerate(binfo):
+            rhist = ch[:, bi, :].sum(axis=0)
+            n_obs = float(rhist.sum())
+            mean_iters = float(isum[:, bi].sum()) / max(n_obs, 1.0)
+            telemetry.emit(
+                "solver.convergence", t0=t0, t1=t1, bucket=b["name"],
+                n_homes=b["n_real"],
+                rprim_hist=[int(v) for v in rhist],
+                iters_hist=[int(v) for v in ih[:, bi, :].sum(axis=0)],
+                mean_iters=round(mean_iters, 2),
+                diverged=int(dc[:, bi].sum()))
+            telemetry.observe(_CONV_ITERS_METRICS[b["name"]], mean_iters)  # telemetry-name-ok: per-bucket literal from _CONV_ITERS_METRICS, each registered
+        total_div = float(dc.sum())
+        if total_div:
+            telemetry.inc("solver.diverged_homes", total_div)
+            telemetry.emit(
+                "solver.diverged", t0=t0, t1=t1, total=int(total_div),
+                by_bucket={b["name"]: int(dc[:, bi].sum())
+                           for bi, b in enumerate(binfo)
+                           if dc[:, bi].sum() > 0})
+        # Global worst-k across the chunk, from the per-(step, bucket)
+        # device captures (idx −1 = an under-filled bucket slot).
+        wi = np.asarray(host["worst_idx"])            # (T, nb·k)
+        wrp = np.asarray(host["worst_rp"])
+        wrd = np.asarray(host["worst_rd"])
+        wit = np.asarray(host["worst_iters"])
+        wb = np.asarray(host["worst_bucket"])
+        ti, si = np.nonzero(wi >= 0)
+        if ti.size == 0:
+            return
+        k = int(self.engine.params.obs_worst_k)
+        # The device fold reports non-finite residuals as the finite
+        # f32-max sentinel (engine._per_home_obs, r_prim_max convention),
+        # so ranking and the JSON emits below stay NaN-free; the where is
+        # a belt-and-braces guard for hand-constructed outputs —
+        # np.argsort would sort a NaN LAST regardless of sign, dropping
+        # exactly the diverged homes this capture exists to surface.
+        rank = wrp[ti, si]
+        rank = np.where(np.isfinite(rank), rank, np.float32(3.4e38))
+        order = np.argsort(-rank, kind="stable")
+        # Dedup by home, keeping each home's worst step: the device
+        # captures per (step, bucket), so one home diverging all chunk
+        # would otherwise fill every slot and hide the k−1 next-worst
+        # homes the event (and the forensic dump) exist to name.
+        entries, seen = [], set()
+        for t, s in zip(ti[order], si[order]):
+            home = int(wi[t, s])
+            if home in seen:
+                continue
+            seen.add(home)
+            entries.append(
+                dict(home=home,
+                     bucket=binfo[int(wb[t, s])]["name"],
+                     t=t0 + int(t),
+                     r_prim=float(wrp[t, s]), r_dual=float(wrd[t, s]),
+                     iters=int(wit[t, s])))
+            if len(entries) >= k:
+                break
+        telemetry.emit("solver.worst", t0=t0, t1=t1, homes=entries)
+        telemetry.set_gauge("solver.worst_rprim", entries[0]["r_prim"])
+        if self._forensics_on:
+            self._write_forensics(t0, t1, entries)
+
+    def _write_forensics(self, t0: int, t1: int, entries: list[dict]) -> None:
+        """Opt-in (``telemetry.forensics``) per-chunk dump of everything an
+        offline HiGHS cross-check (tools/milp_gap.py pattern) needs to
+        rebuild the worst-k homes' exact QPs WITHOUT a full-community
+        re-run: the home's full synthesis config, its scalar carried state
+        at chunk START (engine.state_slice), the worst step's t, and the
+        chunk's reward prices.  Reconstruction = re-run ≤ one checkpoint
+        interval for ONE home from the snapshot, not 10k homes from t=0."""
+        if self.run_dir is None:
+            return
+        state0 = getattr(self, "_chunk_state0", None)
+        dump = {
+            "t0": t0, "t1": t1, "case": self.case,
+            "start_index": int(self.engine.params.start_index),
+            "solver": self.engine.params.solver,
+            "horizon": int(self.engine.params.horizon),
+            "integer_first_action": bool(
+                self.engine.params.integer_first_action),
+            "integer_repair": self.engine.params.integer_repair,
+            "buckets": self.engine.bucket_info(),
+            "reward_prices": [float(v) for v in self.all_rps[t0:t1]],
+            "note": ("state_at_chunk_start is the scan carry at t0; "
+                     "replaying t0..t for one home reproduces the exact "
+                     "(t, state, QP coefficients) of the worst step"),
+            "homes": [
+                {**e,
+                 "name": self.all_homes[e["home"]]["name"],
+                 "type": self.all_homes[e["home"]]["type"],
+                 "state_at_chunk_start": (
+                     self.engine.state_slice(state0, e["home"])
+                     if state0 is not None else None),
+                 "config": self.all_homes[e["home"]]}
+                for e in entries
+            ],
+        }
+        fdir = os.path.join(self.run_dir, "forensics")
+        try:
+            os.makedirs(fdir, exist_ok=True)
+            path = os.path.join(fdir, f"chunk_t{t0:08d}.json")
+            with open(path + ".tmp", "w") as f:
+                json.dump(dump, f, indent=1, default=str)
+            os.replace(path + ".tmp", path)
+        except OSError:
+            pass  # forensics must never kill the run
 
     def _log_home_failures(self, correct_solve: np.ndarray) -> None:
         """Per-home failure logs — the analog of the reference's per-home
@@ -813,6 +957,17 @@ class Aggregator:
             fault_hook("sim_chunk")
             n_steps = min(self.checkpoint_interval, self.num_timesteps - t)
             rps = np.zeros((n_steps, H), dtype=np.float32)
+            # Chunk-start carry, kept one chunk for the opt-in forensic
+            # state snapshots (_write_forensics).  Only when forensics is
+            # on — pinning a second full scan carry (plans + warm starts,
+            # ~35 MB at 10k×48h) every chunk is pure waste otherwise.
+            self._chunk_state0 = state if self._forensics_on else None
+            # Stage-named beat BEFORE the chunk: the first chunk is where
+            # the scan program compiles, so a supervised run that stalls
+            # there is attributed to the compile, not a slow simulation
+            # (the supervisor surfaces the last payload on failure.*).
+            beat({"stage": ("first_chunk(compile+execute)" if chunks == 0
+                            else "chunk_execute"), "timestep": t})
             t0 = time.perf_counter()
             with self._maybe_profile(chunks):
                 state, outs = self.engine.run_chunk(state, t, rps)
@@ -1018,6 +1173,7 @@ class Aggregator:
 
         if not tcfg["enabled"] or jax.process_index() != 0:
             return False
+        self._forensics_on = bool(tcfg.get("forensics", False))
         tdir = tcfg["dir"] or os.environ.get(telemetry.ENV_DIR) \
             or self.run_dir
         telemetry.init_run(tdir)

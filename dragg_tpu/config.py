@@ -212,6 +212,17 @@ _DEFAULT: dict[str, Any] = {
                           # $DRAGG_TELEMETRY_DIR, else the run directory —
                           # supervised runs export the env var so parent
                           # and child share one stream)
+        # Observatory layer (round 9 — docs/telemetry.md "Observatory").
+        "per_home": True,  # fold per-home solver attribution on device
+                           # (fixed-bin residual/iteration histograms +
+                           # worst-k capture riding the StepOutputs
+                           # transfer); false compiles the fold out —
+                           # device program identical to pre-round-9
+        "worst_k": 8,      # worst-homes captured per bucket per step
+        "forensics": False,  # per-chunk worst-k forensic dumps to
+                             # <run_dir>/forensics/ (home config + chunk-
+                             # start state — offline QP reconstruction
+                             # without a full re-run)
     },
     # dragg_tpu-specific knobs (no reference analog).
     "tpu": {

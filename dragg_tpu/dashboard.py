@@ -223,6 +223,79 @@ class Dashboard:
                 "tailed_events": len(events), "by_event": by_event,
                 "last": last}
 
+    # ------------------------------------------------- observatory panels
+    @staticmethod
+    def convergence_panel_html(events: list[dict]) -> str:
+        """Convergence panel (round-9 observatory): the latest
+        ``solver.convergence`` record per bucket rendered as a text-bar
+        residual histogram, plus the latest worst-homes capture.
+        Empty string when the stream carries no observatory events."""
+        latest: dict[str, dict] = {}
+        worst = None
+        for rec in events:
+            if rec.get("event") == "solver.convergence":
+                latest[str(rec.get("bucket"))] = rec
+            elif rec.get("event") == "solver.worst":
+                worst = rec
+        if not latest and worst is None:
+            return ""
+        parts = ["<h3>Solver convergence (latest chunk)</h3>"]
+        for bucket, rec in latest.items():
+            hist = rec.get("rprim_hist") or []
+            peak = max(hist) if hist else 0
+            bars = "".join(
+                "▁▂▃▄▅▆▇█"[min(7, int(8 * v / peak))] if peak else "▁"
+                for v in hist)
+            parts.append(
+                f"<div><code>{html.escape(bucket)}</code> "
+                f"t={rec.get('t0')}..{rec.get('t1')} "
+                f"r_prim <code>{html.escape(bars)}</code> "
+                f"(bins 10⁻⁷…10¹ + diverged) "
+                f"mean_iters={rec.get('mean_iters')} "
+                f"diverged={rec.get('diverged')}</div>")
+        if worst is not None and worst.get("homes"):
+            rows = "".join(
+                f"<tr><td>{h.get('home')}</td>"
+                f"<td>{html.escape(str(h.get('bucket')))}</td>"
+                f"<td>{h.get('t')}</td><td>{h.get('r_prim'):.3g}</td>"
+                f"<td>{h.get('r_dual'):.3g}</td><td>{h.get('iters')}</td>"
+                f"</tr>"
+                for h in worst["homes"])
+            parts.append(
+                "<h4>Worst homes</h4><table border=1 cellpadding=3 "
+                "style='border-collapse:collapse'><tr><th>home</th>"
+                "<th>bucket</th><th>t</th><th>r_prim</th><th>r_dual</th>"
+                f"<th>iters</th></tr>{rows}</table>")
+        return "\n".join(parts)
+
+    @staticmethod
+    def compile_timeline_html(events: list[dict]) -> str:
+        """Compile timeline: every ``compile.stage`` / ``compile.done``
+        in the tail as one chronological table (stage, seconds, pattern
+        shapes, cache verdict)."""
+        rows = []
+        for rec in events:
+            if rec.get("event") == "compile.stage":
+                rows.append((rec.get("mono"), rec.get("label"),
+                             rec.get("stage"), rec.get("s"),
+                             str(rec.get("buckets", ""))[:80], ""))
+            elif rec.get("event") == "compile.done":
+                rows.append((rec.get("mono"), rec.get("label"), "done",
+                             rec.get("total_s"), "",
+                             f"cache={rec.get('cache')}"))
+        if not rows:
+            return ""
+        body = "".join(
+            f"<tr><td>{m}</td><td>{html.escape(str(l))}</td>"
+            f"<td>{html.escape(str(st))}</td><td>{s}</td>"
+            f"<td><code>{html.escape(b)}</code></td>"
+            f"<td>{html.escape(note)}</td></tr>"
+            for m, l, st, s, b, note in rows)
+        return ("<h3>Compile timeline</h3><table border=1 cellpadding=3 "
+                "style='border-collapse:collapse'><tr><th>mono</th>"
+                "<th>label</th><th>stage</th><th>s</th><th>pattern</th>"
+                f"<th></th></tr>{body}</table>")
+
     def live_html(self, query: str = "") -> str:
         runs = self.live_runs()
         run = self._select_run(runs, query)
@@ -235,7 +308,13 @@ class Dashboard:
             body = "<p>(no telemetry streams found)</p>"
         else:
             snap = self.metrics_snapshot(run)
-            events = self.tail_events(run["events"])
+            # One tail read serves both: the observatory panels need a
+            # deeper window (solver.convergence / compile.stage records
+            # are sparser than chunk noise), the event table the last 50.
+            panel_events = self.tail_events(run["events"], limit=400)
+            events = panel_events[-50:]
+            panels = (self.convergence_panel_html(panel_events)
+                      + self.compile_timeline_html(panel_events))
             rows = "\n".join(
                 "<tr><td>{}</td><td>{}</td><td><code>{}</code></td></tr>"
                 .format(
@@ -254,6 +333,7 @@ class Dashboard:
                 f"<h3>Metrics</h3><pre>"
                 f"{html.escape(json.dumps(snap, indent=1, default=str)[:8000])}"
                 f"</pre>"
+                f"{panels}"
                 f"<h3>Last {len(events)} events</h3>"
                 f"<table border=1 cellpadding=4 style='border-collapse:"
                 f"collapse'><tr><th>mono</th><th>event</th><th>fields</th>"

@@ -115,6 +115,32 @@ def _check_telemetry() -> dict:
         return {"status": FAIL, "error": repr(e)}
 
 
+def _check_staged_compile(timeout_s: float) -> dict:
+    """Opt-in (``--compile-check``): a tiny engine's chunk compile run
+    through the STAGED path (telemetry/compile_obs: lower → compile →
+    first-execute, persistent-cache verdict) in a hard-timeouted
+    subprocess — proves the stage-attribution machinery works in this
+    environment and reports where compile time goes.  A hang here names
+    the stuck stage instead of wedging doctor."""
+    code = ("import json\n"
+            "from dragg_tpu.telemetry.compile_obs import selftest\n"
+            "print('STAGED ' + json.dumps(selftest()))\n")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        line = next((l for l in (proc.stdout or "").splitlines()
+                     if l.startswith("STAGED ")), None)
+        if proc.returncode != 0 or line is None:
+            return {"status": FAIL, "error": (proc.stderr or "")[-300:]}
+        rep = json.loads(line[len("STAGED "):])
+        return {"status": OK if rep.get("ok") else FAIL,
+                "stages": rep.get("stages"), "cache": rep.get("cache")}
+    except subprocess.TimeoutExpired:
+        return {"status": FAIL,
+                "error": f"staged compile hung >{timeout_s:.0f}s"}
+
+
 def _check_outputs(outputs_dir: str) -> dict:
     try:
         os.makedirs(outputs_dir, exist_ok=True)
@@ -157,7 +183,7 @@ def run_classify(backend_timeout: float = 60.0, stream=None) -> int:
 
 
 def run_doctor(outputs_dir: str = "outputs", backend_timeout: float = 60.0,
-               stream=None) -> int:
+               stream=None, compile_check: bool = False) -> int:
     stream = stream or sys.stdout
     config_res, cfg = _check_config()
     backend_res = _check_backend(backend_timeout)
@@ -173,6 +199,9 @@ def run_doctor(outputs_dir: str = "outputs", backend_timeout: float = 60.0,
         "outputs_writable": _check_outputs(outputs_dir),
         "telemetry": _check_telemetry(),
     }
+    if compile_check:
+        checks["staged_compile"] = _check_staged_compile(
+            max(backend_timeout, 300.0))
     # Pallas only matters when a TPU backend is up — and its self-test
     # compiles a kernel, so it runs in a SUBPROCESS with the same hard
     # timeout as the backend probe (a tunnel can wedge between probes).

@@ -60,6 +60,32 @@ WINTER_MAX_OAT = 30.0  # season switch threshold, degC (dragg/mpc_calc.py:303)
 BUCKETED_MIN_HOMES = 32   # below this the extra compiles dominate any win
 BUCKETED_MIN_FRAC = 0.25  # min fraction of homes with a non-superset shape
 
+# --- Observatory layer (round 9): fixed histogram binning for the per-home
+# solver attribution folded ON DEVICE inside the scan (engine._per_home_obs)
+# and piggybacked on the StepOutputs host transfer.  The bins are FIXED
+# LITERALS (not config) so chunk histograms are summable across runs and
+# rounds without bin-edge bookkeeping; docs/telemetry.md documents them.
+#
+# Residual bins: index 0 = r_prim < 1e-7, then half-decade log10 bins over
+# [1e-7, 10) (values >= 10 clip into the last log bin), and a final bin for
+# certified-diverged / non-finite homes.
+OBS_RES_LOG_LO = -7.0
+OBS_RES_LOG_STEP = 0.5
+OBS_RES_BINS = 18  # 1 underflow + 16 half-decade bins + 1 diverged
+# Iteration bins: per-home convergence iterations (solver conv_iters),
+# bin i = (edge[i-1], edge[i]]-ish via searchsorted; last bin = > 512.
+OBS_ITER_EDGES = (2, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256,
+                  384, 512)
+OBS_ITER_BINS = len(OBS_ITER_EDGES) + 1
+
+# StepOutputs fields carrying the per-bucket observatory fold — shaped
+# (n_buckets, bins) / (n_buckets * k,) per step, NOT per home, so the
+# aggregator's real_home_cols slicing must skip them (aggregator._collect_chunk).
+OBS_FIELDS = frozenset({
+    "conv_hist", "iters_hist", "iters_sum", "diverged_count",
+    "worst_idx", "worst_rp", "worst_rd", "worst_iters", "worst_bucket",
+})
+
 
 def resolve_bucket_plan(bucketed: str, type_code) -> list[tuple[str, int, int]] | None:
     """Resolve the ``tpu.bucketed`` tri-state against a community's type
@@ -105,7 +131,10 @@ class _TypeBucket:
 
     def __init__(self, *, name, spec, lay, comm_start, n_real, start_slot,
                  n, static, batch, draws, tank, check_mask, home_idx,
-                 band_plan, solve_backend):
+                 band_plan, solve_backend, ordinal=0):
+        self.ordinal = ordinal      # position in engine._buckets (= the
+                                    # bucket_info() row the observatory's
+                                    # worst_bucket codes index)
         self.name = name            # home type ("pv_battery" … "base")
         self.spec = spec
         self.lay = lay
@@ -134,6 +163,7 @@ class _SupersetView:
     spec = SUPERSET_SPEC
     comm_start = 0
     start_slot = 0
+    ordinal = 0
 
     def __init__(self, eng):
         self._eng = eng
@@ -183,6 +213,21 @@ class StepOutputs(NamedTuple):
     * ``cost`` follows the reference's per-path convention: s * price *
       p_grid on optimal steps (dragg/mpc_calc.py:500 — the raw QP variable),
       price * p_grid on fallback steps (dragg/mpc_calc.py:594).
+
+    Known bounded inconsistency (ADVICE r5 #2, documented rather than
+    adjusted): under ``integer_repair="project"`` the projection pins the
+    k=0 duty counts and moves the k=1 temperatures by the closed-form
+    affine deltas, but the k=1 DUTY plan — which ``forecast_p_grid``
+    (``mpc.p_grid[:, 1]``) is affine in — stays the relaxed optimum, so
+    the reported forecast reflects the relaxed plan where "resolve" mode's
+    second solve would re-optimize it against the pinned k=0 state.  The
+    drift is bounded by the one-count-per-appliance pin delta propagated
+    one step through the thermal dynamics (≲ kin·a_in⁻¹·|ΔT₁| plus the WH
+    analog — fractions of a kW at the shipped parameters), is telemetry-
+    only (nothing applied to the plant reads it; the k=1 plan is
+    re-optimized from scratch next step), and re-deriving the k=1 duties
+    in closed form is underdetermined (heat vs cool vs WH split).  The
+    observatory's forensic dumps record the relaxed-plan provenance.
     """
 
     p_grid: jnp.ndarray           # (n,)
@@ -220,6 +265,28 @@ class StepOutputs(NamedTuple):
                                   # divergence is visible, not NaN
     r_dual_max: jnp.ndarray       # () max final dual residual (same
                                   # masking/sentinel convention)
+    # --- Observatory fold (round 9; see OBS_* constants).  Per-BUCKET
+    # shapes, not per-home — merged by concatenation on axis 0, so a
+    # bucketed engine reports (n_buckets, bins) / (n_buckets · k,) and the
+    # unbucketed engine the single-bucket special case.  All computed on
+    # device inside the scan from the solver's per-home residual /
+    # conv_iters / diverged vectors BEFORE the masked reductions above
+    # discard them — zero extra device→host syncs (they ride the same
+    # StepOutputs transfer _collect_chunk already makes).  With
+    # ``telemetry.per_home = false`` every leaf is zero-width and the
+    # traced program is unchanged from the pre-observatory engine.
+    conv_hist: jnp.ndarray        # (n_buckets, OBS_RES_BINS) r_prim counts
+    iters_hist: jnp.ndarray       # (n_buckets, OBS_ITER_BINS) conv_iters
+    iters_sum: jnp.ndarray        # (n_buckets,) masked sum of conv_iters
+    diverged_count: jnp.ndarray   # (n_buckets,) certified-diverged homes
+    worst_idx: jnp.ndarray        # (n_buckets·k,) community home index of
+                                  # the bucket's worst-k by r_prim (−1 =
+                                  # empty slot: k exceeded the real homes)
+    worst_rp: jnp.ndarray         # (n_buckets·k,) their r_prim
+    worst_rd: jnp.ndarray         # (n_buckets·k,) their r_dual
+    worst_iters: jnp.ndarray      # (n_buckets·k,) their conv_iters
+    worst_bucket: jnp.ndarray     # (n_buckets·k,) bucket ordinal (the
+                                  # bucket_info() row naming the type)
 
 
 class StepAux(NamedTuple):
@@ -279,6 +346,13 @@ class EngineParams(NamedTuple):
     bucketed: str       # "auto" | "true" | "false" — type-bucketed shape
                         # specialization (see resolve_bucket_plan)
     seed: int
+    # Observatory (round 9; trailing defaults keep direct constructions
+    # valid).  obs_per_home is STATIC: false compiles the per-home fold
+    # out of the program entirely (zero-width StepOutputs leaves), so the
+    # disabled-mode device cost is bit-identical to the pre-observatory
+    # engine ([telemetry] per_home / worst_k — docs/config.md).
+    obs_per_home: bool = True
+    obs_worst_k: int = 8
 
 
 class Engine:
@@ -418,7 +492,7 @@ class Engine:
         shards = getattr(self, "_mesh_shards", 1)
         cmask = np.asarray(check_mask, dtype=np.float64)
         slot = 0
-        for tname, a, b in self._bucket_ranges:
+        for ordinal, (tname, a, b) in enumerate(self._bucket_ranges):
             spec = TYPE_SPECS[tname]
             blay = QPLayout(p.horizon, spec)
             sub = slice_batch(batch, a, b)
@@ -444,7 +518,7 @@ class Engine:
                 home_idx=jnp.asarray(
                     np.pad(np.arange(a, b), (0, n_slots - (b - a)),
                            mode="edge")),
-                band_plan=plan, solve_backend=backend,
+                band_plan=plan, solve_backend=backend, ordinal=ordinal,
             ))
             slot += n_slots
 
@@ -605,6 +679,32 @@ class Engine:
             return np.arange(self.true_n_homes)
         return np.concatenate([c.start_slot + np.arange(c.n_real)
                                for c in self._buckets])
+
+    @property
+    def obs_enabled(self) -> bool:
+        """Whether the per-home observatory fold compiled into the step
+        (``telemetry.per_home``) — the aggregator's emit gate."""
+        return self.params.obs_per_home
+
+    def state_slice(self, state, home_idx: int) -> dict:
+        """ONE home's scalar carried state as host floats — the forensic
+        dump's chunk-start snapshot (aggregator._write_forensics).  Pulls
+        only the (n,) leaves (temp_in/temp_wh/e_batt/counter), never the
+        (n, H) plans or warm starts, so an opt-in dump at 10k homes moves
+        kilobytes, not the full carry."""
+        if self._bucketed:
+            for ctx, st in zip(self._buckets, state):
+                if ctx.comm_start <= home_idx < ctx.comm_start + ctx.n_real:
+                    local = home_idx - ctx.comm_start
+                    break
+            else:
+                return {}
+        else:
+            if not 0 <= home_idx < self.true_n_homes:
+                return {}
+            st, local = state, home_idx
+        return {f: float(np.asarray(getattr(st, f))[local])
+                for f in ("temp_in", "temp_wh", "e_batt", "counter")}
 
     # ---------------------------------------------------------------- state
     def init_state(self):
@@ -986,6 +1086,10 @@ class Engine:
                 r_prim=sol.r_prim, r_dual=sol.r_dual,
                 solved=sol.solved, infeasible=sol.infeasible,
                 iters=sol.iters, rho=sol.rho,
+                # Attribution stays the RELAXED solve's: the projection is
+                # closed-form (no iterations) and divergence is a property
+                # of the relaxation.
+                conv_iters=sol.conv_iters, diverged=sol.diverged,
             ), repair_failed
 
         l2 = qp.l_box.at[:, cols].set(pinned)
@@ -1016,7 +1120,73 @@ class Engine:
             infeasible=sol.infeasible,
             iters=sol.iters + sol2.iters,
             rho=pick(sol2.rho, sol.rho),
+            # Per-home attribution keeps the RELAXED solve's verdicts (the
+            # pinned re-solve runs at the loose repair_eps and its counts
+            # would conflate repair cost with convergence behavior).
+            conv_iters=sol.conv_iters, diverged=sol.diverged,
         ), repair_failed
+
+    def _per_home_obs(self, ctx, sol) -> dict:
+        """Observatory fold for one bucket: the solver's per-home residual
+        / conv_iters / diverged vectors → fixed-bin histograms + the
+        bucket's worst-k capture, all on device (O(bins + k) extra bytes
+        on the existing StepOutputs transfer; see the OBS_* constants).
+        Disabled (``telemetry.per_home = false``): zero-width leaves, so
+        the compiled program carries no observatory work at all."""
+        f32 = jnp.float32
+        if not self.params.obs_per_home:
+            z = jnp.zeros((0,), f32)
+            return dict(conv_hist=jnp.zeros((1, 0), f32),
+                        iters_hist=jnp.zeros((1, 0), f32),
+                        iters_sum=z, diverged_count=z,
+                        worst_idx=jnp.zeros((0,), jnp.int32),
+                        worst_rp=z, worst_rd=z, worst_iters=z,
+                        worst_bucket=jnp.zeros((0,), jnp.int32))
+        mask = ctx.check_mask > 0
+        rp, rd = sol.r_prim, sol.r_dual
+        # Solvers built by this repo always attach the per-home extras;
+        # the fallbacks keep hand-constructed ADMMSolutions (tests) legal.
+        cit = (sol.conv_iters if sol.conv_iters is not None
+               else jnp.broadcast_to(sol.iters, rp.shape)).astype(jnp.int32)
+        div = (sol.diverged if sol.diverged is not None else sol.infeasible)
+        fin = jnp.isfinite(rp)
+        w = jnp.where(mask, 1.0, 0.0).astype(f32)
+        logr = jnp.log10(jnp.clip(jnp.where(fin, rp, 1.0), 1e-30, 1e30))
+        rbin = jnp.clip(
+            jnp.floor((logr - OBS_RES_LOG_LO) / OBS_RES_LOG_STEP)
+            .astype(jnp.int32) + 1, 0, OBS_RES_BINS - 2)
+        rbin = jnp.where(div | ~fin, OBS_RES_BINS - 1, rbin)
+        rhist = jnp.zeros((OBS_RES_BINS,), f32).at[rbin].add(w)
+        ibin = jnp.searchsorted(jnp.asarray(OBS_ITER_EDGES, jnp.int32), cit,
+                                side="left").astype(jnp.int32)
+        ihist = jnp.zeros((OBS_ITER_BINS,), f32).at[ibin].add(w)
+        iters_sum = jnp.sum(jnp.where(mask, cit.astype(f32), 0.0))
+        div_count = jnp.sum(jnp.where(mask, div.astype(f32), 0.0))
+        # Worst-k by final primal residual: non-finite residuals rank as —
+        # AND are reported as — the f32-max sentinel (same convention as
+        # r_prim_max: divergence stays visible and finite, never a NaN
+        # that would poison downstream isfinite checks / strict-JSON
+        # event streams); masked / pad slots score −1 so they fill slots
+        # only when the bucket has fewer than k real homes — marked
+        # idx = −1 for the host to drop.
+        k = min(self.params.obs_worst_k, ctx.n)
+        big = jnp.asarray(3.4e38, f32)
+        rp_s = jnp.where(fin, rp, big)
+        rd_s = jnp.where(jnp.isfinite(rd), rd, big)
+        score = jnp.where(mask, rp_s, -1.0)
+        top_s, top_ix = lax.top_k(score, k)
+        return dict(
+            conv_hist=rhist[None, :],
+            iters_hist=ihist[None, :],
+            iters_sum=iters_sum[None],
+            diverged_count=div_count[None],
+            worst_idx=jnp.where(top_s >= 0, ctx.home_idx[top_ix],
+                                -1).astype(jnp.int32),
+            worst_rp=rp_s[top_ix].astype(f32),
+            worst_rd=rd_s[top_ix].astype(f32),
+            worst_iters=cit[top_ix].astype(f32),
+            worst_bucket=jnp.full((k,), ctx.ordinal, jnp.int32),
+        )
 
     def _finish(self, ctx, state: CommunityState, t, sol, aux: StepAux,
                 warm_sol, repair_failed=0.0):
@@ -1139,6 +1309,7 @@ class Engine:
             repair_failed=jnp.asarray(repair_failed, f32),
             r_prim_max=_res_max(sol.r_prim),
             r_dual_max=_res_max(sol.r_dual),
+            **self._per_home_obs(ctx, sol),
         )
         return new_state, out
 
@@ -1381,6 +1552,10 @@ def engine_params(config, start_index: int) -> EngineParams:
         forecast_noise_cap=float(tpu_cfg.get("forecast_noise_cap", 3.0)),
         bucketed=bucketed,
         seed=int(config["simulation"]["random_seed"]),
+        obs_per_home=bool(
+            config.get("telemetry", {}).get("per_home", True)),
+        obs_worst_k=max(1, int(
+            config.get("telemetry", {}).get("worst_k", 8))),
     )
 
 

@@ -51,6 +51,11 @@ def assemble_community_qp(horizon_hours: int = 4, n_homes: int = 6,
     cfg["community"]["homes_battery"] = homes_battery
     cfg["community"]["homes_pv_battery"] = homes_pv_battery
     cfg["home"]["hems"]["prediction_horizon"] = horizon_hours
+    # This fixture extracts ONE superset-shaped QP via the engine's
+    # whole-batch attributes (_draws/_tank/_oat/...), which a bucketed
+    # engine keeps per bucket instead — pin the superset path (round-8
+    # `auto` would otherwise bucket large mixed fixtures).
+    cfg["tpu"]["bucketed"] = "false"
     seed = int(cfg["simulation"]["random_seed"])
     env = load_environment(cfg)
     dt = env.dt

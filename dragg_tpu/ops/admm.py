@@ -145,6 +145,15 @@ class ADMMSolution(NamedTuple):
     infeasible: jnp.ndarray  # (B,) bool — certified primal-infeasible (OSQP §3.4)
     iters: jnp.ndarray    # scalar iterations executed
     rho: jnp.ndarray      # (B,) final per-home rho (for warm starting)
+    # Observatory extras (round 9) — trailing defaults so existing
+    # construction sites (tests included) stay valid.  ``conv_iters`` is
+    # the iteration at which each home first satisfied the loop-internal
+    # convergence check (the full budget if it never did) — the per-home
+    # attribution the community-wide scalar ``iters`` cannot give;
+    # ``diverged`` is the per-home certified-divergence verdict (ADMM: the
+    # OSQP infeasibility certificate; IPM: the divergence freeze).
+    conv_iters: jnp.ndarray | None = None  # (B,) int32
+    diverged: jnp.ndarray | None = None    # (B,) bool
 
 
 def _pad_gather(vals, src):
@@ -537,9 +546,11 @@ def _admm_impl(
 
     def chunk(carry):
         if K_aa > 0:
-            state, rho_b, F, it, _, pinf, best_done, best_r, last_improve, aa = carry
+            (state, rho_b, F, it, _, pinf, best_done, best_r, last_improve,
+             conv_it, aa) = carry
         else:
-            state, rho_b, F, it, _, pinf, best_done, best_r, last_improve = carry
+            (state, rho_b, F, it, _, pinf, best_done, best_r, last_improve,
+             conv_it) = carry
         x0_, z0_, nu_prev, y_box_prev = state
         aa_entry = jnp.concatenate([state[1], state[3]], axis=1) if K_aa > 0 else None
         applied_entry = aa[4] if K_aa > 0 else None
@@ -554,6 +565,11 @@ def _admm_impl(
         pinf = pinf | new_pinf
         done = ok | pinf
         it = it + check_every
+        # Per-home attribution: the check-window iteration at which each
+        # home FIRST read done (−1 = not yet; resolved to the final budget
+        # after the loop).  Residual checks run per window, so this has
+        # check_every granularity — same resolution the loop itself has.
+        conv_it = jnp.where((conv_it < 0) & done, it, conv_it)
         # Progress = another home finished OR ANY unfinished home's residual
         # is still descending (per-home best tracking: a single straggler
         # making steady progress at large B must keep the loop alive, and
@@ -585,8 +601,9 @@ def _admm_impl(
                                     r_tot, done, rho_changed)
             state = (x, s_next[:, :n], nu, s_next[:, n:])
             return (state, rho_b, F, it, jnp.all(done), pinf, best_done,
-                    best_r, last_improve, aa)
-        return state, rho_b, F, it, jnp.all(done), pinf, best_done, best_r, last_improve
+                    best_r, last_improve, conv_it, aa)
+        return (state, rho_b, F, it, jnp.all(done), pinf, best_done, best_r,
+                last_improve, conv_it)
 
     def cond(carry):
         it, all_done, last_improve = carry[3], carry[4], carry[8]
@@ -602,11 +619,13 @@ def _admm_impl(
     state = (x, z_box, nu, y_box)
     pinf0 = jnp.zeros((B,), dtype=bool)
     carry0 = (state, rho_b, F, jnp.asarray(0), jnp.asarray(False), pinf0,
-              jnp.asarray(-1), jnp.full((B,), jnp.inf, dtype=dtype), jnp.asarray(0))
+              jnp.asarray(-1), jnp.full((B,), jnp.inf, dtype=dtype), jnp.asarray(0),
+              jnp.full((B,), -1, dtype=jnp.int32))
     if K_aa > 0:
         carry0 = (*carry0, aa_init())
     out = lax.while_loop(cond, chunk, carry0)
     state, rho_b, F, it, _, pinf = out[0], out[1], out[2], out[3], out[4], out[5]
+    conv_it = out[9]
     x, z_box, nu, y_box = state
     r_prim, r_dual, _, _, ok = residuals(x, z_box, nu, y_box)
 
@@ -625,6 +644,8 @@ def _admm_impl(
         x=x_out, y_eq=e_eq * nu / c, y_box=e_box * y_box / c,
         r_prim=r_prim, r_dual=r_dual, solved=ok & ~pinf, infeasible=pinf,
         iters=it, rho=rho_b,
+        conv_iters=jnp.where(conv_it < 0, it, conv_it).astype(jnp.int32),
+        diverged=pinf,
     )
     return sol, FactorCarry(d=d, e_eq=e_eq, e_box=e_box, c=c, Sinv=F[1])
 

@@ -308,7 +308,7 @@ def _make_loop(data, shared, eps_abs, eps_rel):
         return converged_from(r_dual, r_prim, x, s_l, s_u, z_l, z_u)
 
     def body(carry):
-        i, _, x, y, s_l, s_u, z_l, z_u = carry
+        i, _, x, y, s_l, s_u, z_l, z_u, cit = carry
         # Residuals FIRST (factor-independent), shared by the freeze check
         # and the Newton-step construction — one pair of gather matvecs
         # per iteration instead of two.
@@ -407,7 +407,12 @@ def _make_loop(data, shared, eps_abs, eps_rel):
         s_u = jnp.where(fin_ok, s_u_n, s_u)
         z_l = jnp.where(fin_ok, z_l_n, z_l)
         z_u = jnp.where(fin_ok, z_u_n, z_u)
-        return i + 1, jnp.all(frozen), x, y, s_l, s_u, z_l, z_u
+        # Per-home attribution: iterations the home was still LIVE for
+        # (frozen — converged or certified-diverged — homes take zero-
+        # length steps and stop accumulating).  Pre-step ``frozen`` means
+        # a home frozen at iteration j reads cit = j.
+        return (i + 1, jnp.all(frozen), x, y, s_l, s_u, z_l, z_u,
+                cit + (~frozen).astype(cit.dtype))
 
     return body, converged
 
@@ -464,10 +469,11 @@ def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
     # ``frozen`` can only grow: a frozen home does not move, so it stays
     # converged.  (all_frozen lags one iteration — it is computed from the
     # PRE-step iterate — which only costs one extra sweep, not correctness.)
-    i_done, _, x, y, s_l, s_u, z_l, z_u = lax.while_loop(
+    cit = jnp.zeros((B,), jnp.int32)
+    i_done, _, x, y, s_l, s_u, z_l, z_u, cit = lax.while_loop(
         lambda c: (c[0] < iters) & ~c[1],
         body,
-        (jnp.asarray(0), jnp.asarray(False), x, y, s_l, s_u, z_l, z_u),
+        (jnp.asarray(0), jnp.asarray(False), x, y, s_l, s_u, z_l, z_u, cit),
     )
 
     if do_tail:
@@ -485,7 +491,7 @@ def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
                             band_solve_fn=so, add_diag_fn=ad,
                             factor_solve_fn=fs)
 
-        def tail_phase(data_l, x, y, s_l, s_u, z_l, z_u):
+        def tail_phase(data_l, x, y, s_l, s_u, z_l, z_u, cit):
             """Rank, gather, and finish the worst-k stragglers of one
             (local) batch; scatter the improved iterates back."""
             _, conv2 = _make_loop(data_l, shared_t, eps_abs, eps_rel)
@@ -505,7 +511,7 @@ def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
             g = lambda a: a[idx]
             data2 = tuple(g(a) for a in data_l)
             body3, _ = _make_loop(data2, shared_t, eps_abs, eps_rel)
-            i2, _, x2, y2, s_l2, s_u2, z_l2, z_u2 = lax.while_loop(
+            i2, _, x2, y2, s_l2, s_u2, z_l2, z_u2, cit2 = lax.while_loop(
                 lambda c: (c[0] < tail_iters) & ~c[1],
                 body3,
                 # Seed all-frozen from the phase-1 state: a warm
@@ -513,33 +519,34 @@ def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
                 # the tail loop entirely instead of paying one dead
                 # zero-step iteration.
                 (jnp.asarray(0), jnp.all(frozen),
-                 g(x), g(y), g(s_l), g(s_u), g(z_l), g(z_u)),
+                 g(x), g(y), g(s_l), g(s_u), g(z_l), g(z_u), g(cit)),
             )
             return (x.at[idx].set(x2), y.at[idx].set(y2),
                     s_l.at[idx].set(s_l2), s_u.at[idx].set(s_u2),
-                    z_l.at[idx].set(z_l2), z_u.at[idx].set(z_u2), i2)
+                    z_l.at[idx].set(z_l2), z_u.at[idx].set(z_u2),
+                    cit.at[idx].set(cit2), i2)
 
         if mesh is None:
-            x, y, s_l, s_u, z_l, z_u, i2 = tail_phase(
-                data, x, y, s_l, s_u, z_l, z_u)
+            x, y, s_l, s_u, z_l, z_u, cit, i2 = tail_phase(
+                data, x, y, s_l, s_u, z_l, z_u, cit)
             i_done = i_done + i2
         else:
             from jax.sharding import PartitionSpec as P
 
             h = P(shared["mesh_axis"])  # leading home axis on every array
 
-            def wrapped(data_l, x, y, s_l, s_u, z_l, z_u):
-                out = tail_phase(data_l, x, y, s_l, s_u, z_l, z_u)
-                return out[:6] + (out[6][None],)  # per-shard iter count
+            def wrapped(data_l, x, y, s_l, s_u, z_l, z_u, cit):
+                out = tail_phase(data_l, x, y, s_l, s_u, z_l, z_u, cit)
+                return out[:7] + (out[7][None],)  # per-shard iter count
 
             from dragg_tpu.utils.compat import shard_map_partial
 
-            it_specs = (h,) * 6
-            x, y, s_l, s_u, z_l, z_u, i2s = shard_map_partial(mesh)(
+            it_specs = (h,) * 7
+            x, y, s_l, s_u, z_l, z_u, cit, i2s = shard_map_partial(mesh)(
                 wrapped,
                 in_specs=(tuple(h for _ in data),) + it_specs,
                 out_specs=it_specs + (h,),
-            )(data, x, y, s_l, s_u, z_l, z_u)
+            )(data, x, y, s_l, s_u, z_l, z_u, cit)
             i_done = i_done + jnp.max(i2s)
 
     # --- Final residuals in UNSCALED units (ADMM-convention norms).
@@ -549,7 +556,8 @@ def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
     row_cols, col_rows = shared["row_cols"], shared["col_rows"]
     mv = lambda xx: jnp.sum(vp_r * xx[:, row_cols], axis=2)
     mvt = lambda yy: jnp.sum(vp_c * yy[:, col_rows], axis=2)
-    r_prim = jnp.max(jnp.abs((mv(x) - bs) / e_eq), axis=1)
+    mvx = mv(x)
+    r_prim = jnp.max(jnp.abs((mvx - bs) / e_eq), axis=1)
     box_viol = jnp.maximum(
         jnp.where(fin_l, ls - x, 0.0), jnp.where(fin_u, x - us, 0.0)
     )
@@ -560,6 +568,17 @@ def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
     gap_u = gap / jnp.maximum(jnp.abs(jnp.sum(qs * x, axis=1)), 1.0)
     ok = ((r_prim <= 10 * eps_abs) & (r_dual <= 10 * eps_abs)
           & (gap_u <= jnp.maximum(10 * eps_rel, 1e-6)) & ~inverted)
+
+    # Per-home certified divergence, mirroring the loop-internal freeze
+    # criterion (converged_from): scaled-space primal residual stalled
+    # far above tolerance WHILE the box duals blew past the freeze
+    # threshold — the primal-infeasible signature, distinct from a home
+    # that is merely unconverged at the budget.
+    rp_scaled = jnp.max(jnp.abs(bs - mvx), axis=1)
+    zmax = jnp.maximum(jnp.max(z_l * fin_l, axis=1),
+                       jnp.max(z_u * fin_u, axis=1))
+    diverged = (rp_scaled > 100 * jnp.maximum(eps_abs, 1e-6)) \
+        & (zmax > shared["freeze_zmax"])
 
     x_out = jnp.clip(d * x, l_box, u_box)
     x_out = jnp.where(fixed, fixval, x_out)
@@ -573,4 +592,6 @@ def _run_phases(B, m, dtype, cap, tail_frac, tail_iters, mesh,
         infeasible=jnp.zeros((B,), bool),
         iters=i_done,
         rho=jnp.ones((B,), dtype),
+        conv_iters=cit,
+        diverged=diverged & ~ok,
     )

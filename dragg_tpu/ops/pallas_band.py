@@ -98,7 +98,8 @@ _VMEM_BUDGET = _vmem_budget_from_env()
 
 
 def _auto_blocks(m: int, bwp1: int, n_band_bufs: int, n_vec_bufs: int,
-                 itemsize: int, B: int) -> tuple[int, int]:
+                 itemsize: int, B: int,
+                 lane_block: int | None = None) -> tuple[int, int]:
     """Choose (lane_block, b_chunk) from the call shape so the kernel fits
     the scoped-VMEM budget with no env overrides (VERDICT r4 next-3: the
     flagship H=48 shape must not OOM out of the box).
@@ -116,9 +117,18 @@ def _auto_blocks(m: int, bwp1: int, n_band_bufs: int, n_vec_bufs: int,
     """
     half = _VMEM_BUDGET // 2
     per_home = 2 * (n_band_bufs * m * bwp1 + n_vec_bufs * m) * itemsize
-    lb = 512
-    while lb > 128 and per_home * lb > half:
-        lb -= 128
+    if lane_block is not None:
+        # An explicit lane-block override (arg or DRAGG_LANE_BLOCK): the
+        # chunk below must align to THIS block, not the auto one —
+        # chunks pad up to lane-block multiples, so a chunk sized against
+        # a smaller auto block breaks the scoped-VMEM model it was
+        # derived from (ADVICE r5 #1: LANE_BLOCK=512 at m=149 yielded a
+        # 256-multiple chunk padded to 512 multiples).
+        lb = lane_block
+    else:
+        lb = 512
+        while lb > 128 and per_home * lb > half:
+            lb -= 128
     # Full-output half: bound homes per pallas_call to a lane_block
     # multiple; 0 = no chunking needed.  When even lb homes' output
     # exceeds the half-budget (tiny DRAGG_VMEM_BUDGET_MB A/Bs), chunk at
@@ -138,10 +148,16 @@ def _blocks_for(m: int, bwp1: int, n_band_bufs: int, n_vec_bufs: int,
                 itemsize: int, B: int,
                 lane_block: int | None, b_chunk: int | None) -> tuple[int, int]:
     """Resolve (lane_block, b_chunk): explicit args win, then env
-    overrides, then the auto policy for whichever remains unset."""
+    overrides, then the auto policy for whichever remains unset.  An
+    auto-policy b_chunk is always computed AGAINST the resolved lane
+    block — an overridden lane block with an auto chunk must not size the
+    chunk from the auto block it replaced (ADVICE r5 #1: the chunk pads
+    up to lane-block multiples, so misalignment silently re-inflates the
+    scoped-VMEM footprint the chunk was chosen to bound)."""
+    lb_override = lane_block or _ENV_LANE_BLOCK or None
     auto_lb, auto_ck = _auto_blocks(m, bwp1, n_band_bufs, n_vec_bufs,
-                                    itemsize, B)
-    lb = lane_block or _ENV_LANE_BLOCK or auto_lb
+                                    itemsize, B, lane_block=lb_override)
+    lb = lb_override or auto_lb
     if b_chunk is None:
         ck = auto_ck if _ENV_B_CHUNK is None else _ENV_B_CHUNK
     else:

@@ -241,15 +241,22 @@ def run_supervised(argv: list[str], deadline_s: float, *,
         except OSError:
             pass
     telemetry.observe("supervisor.child_s", elapsed)
+    # The child's LAST heartbeat payload rides the verdict events: an
+    # instrumented child beats a stage name before each risky phase
+    # (telemetry/compile_obs stages, bench's build stages), so a
+    # stall-killed compile is attributed to "compile:compile at pattern X"
+    # in the stream, not just COMPILE_HANG (round-9 observatory).
     telemetry.emit("supervisor.exit", label=label or argv[0], rc=rc,
                    ok=result.ok, failure=failure, timed_out=timed_out,
-                   stalled=stalled, elapsed_s=round(elapsed, 3))
+                   stalled=stalled, elapsed_s=round(elapsed, 3),
+                   progress=progress)
     if failure is not None:
         # The taxonomy kind IS the event type — wedge forensics grep one
         # stream for "failure." instead of three ad-hoc transcripts.
         telemetry.emit("failure." + failure,  # telemetry-name-ok: kind from taxonomy.FAILURE_KINDS, each registered literally
                        source="supervisor", label=label or argv[0],
-                       rc=rc, elapsed_s=round(elapsed, 3))
+                       rc=rc, elapsed_s=round(elapsed, 3),
+                       progress=progress)
     if log:
         log(f"<<< {label or argv[0]} rc={rc} "
             f"{'ok' if result.ok else result.failure} "

@@ -1,0 +1,167 @@
+"""Staged compile observability (round 9 — the observatory tentpole).
+
+The round-4 10k engine-compile hang was never bisected: the bench child
+logged "constructing engine" and then nothing for 900 s, so the
+supervisor could only classify COMPILE_HANG — not WHICH stage (trace?
+XLA compile? first device execution?) or which bucket pattern was in
+flight, and the abandoned compile wedged the tunnel for every later
+process (CLAUDE.md).  :func:`staged_compile` splits the jit boundary
+into explicit AOT stages —
+
+    lower          trace the chunk program (host-side, shape-dependent)
+    compile        XLA compilation of the lowered module
+    first_execute  the compiled program's first device run
+
+— with, per stage: a heartbeat beat BEFORE the stage starts (so a
+supervised child that hangs inside it leaves the stage name + per-bucket
+pattern shapes as its last progress payload, and the supervisor's
+stall-kill verdict names the stage instead of just COMPILE_HANG), a
+``fault_hook("compile_<stage>")`` site (chaos tests inject hangs
+deterministically), a ``compile.stage`` event + ``compile.stage_s``
+metric, and persistent-cache hit/miss detection on the compile stage
+(entry-count delta in the enabled cache dir — a "hit" names the warm
+path, so a 58.9 s cold bucketed compile is distinguishable from a 2 s
+cache load in the artifacts).
+
+The compiled executable is returned as a ``runner`` with
+``engine.run_chunk``'s signature: callers that keep using it (bench's
+timed chunks) never pay a second jit trace/compile of the same shape.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from dragg_tpu import telemetry
+
+STAGES = ("lower", "compile", "first_execute")
+
+
+def _cache_entries() -> int | None:
+    """Entry count of the enabled persistent compile cache (None = cache
+    off / unreadable).  Counting files is the honest observable: JAX does
+    not expose hit/miss, but a compile that wrote no new entry on an
+    enabled cache was served from it."""
+    from dragg_tpu.utils.compile_cache import enabled_cache_dir
+
+    d = enabled_cache_dir()
+    if not d:
+        return None
+    try:
+        return len(os.listdir(d))
+    except OSError:
+        return None
+
+
+def staged_compile(engine, state, t0: int, rps, label: str = "chunk"):
+    """Lower → compile → first-execute ``engine``'s chunk program with
+    per-stage telemetry/heartbeat/fault instrumentation (module
+    docstring).  Returns ``(runner, state_out, outs, report)`` where
+    ``runner(state, t0, rps)`` re-runs the SAME compiled executable
+    (chunk shape fixed) and ``report`` = {label, stages: {name: s},
+    cache: hit|miss|unknown, total_s, buckets}."""
+    import jax
+    import jax.numpy as jnp
+
+    from dragg_tpu.resilience.faults import fault_hook
+    from dragg_tpu.resilience.heartbeat import beat
+
+    buckets = [dict(name=b["name"], n_slots=b["n_slots"], m_eq=b["m_eq"],
+                    n_var=b["n_var"]) for b in engine.bucket_info()]
+    bdesc = ",".join(f"{b['name']}[{b['n_slots']}x{b['m_eq']}]"
+                     for b in buckets)
+    consts = engine._consts()
+    args = (consts, state, jnp.asarray(t0),
+            jnp.asarray(rps, dtype=jnp.float32))
+    stages: dict[str, float] = {}
+
+    def begin(stage: str) -> float:
+        # Beat BEFORE the stage: if it hangs, this is the child's last
+        # progress payload — the supervisor surfaces it on the
+        # failure.COMPILE_HANG event (stage + pattern attribution).
+        beat({"stage": f"compile:{stage}", "label": label, "buckets": bdesc})
+        fault_hook(f"compile_{stage}")
+        return time.perf_counter()
+
+    def end(stage: str, t_begin: float) -> None:
+        s = time.perf_counter() - t_begin
+        stages[stage] = round(s, 3)
+        telemetry.observe("compile.stage_s", s)
+        telemetry.emit("compile.stage", label=label, stage=stage,
+                       s=round(s, 3), buckets=bdesc)
+
+    tb = begin("lower")
+    lowered = engine._chunk_fn.lower(*args)
+    end("lower", tb)
+
+    n_before = _cache_entries()
+    tb = begin("compile")
+    compiled = lowered.compile()
+    end("compile", tb)
+    n_after = _cache_entries()
+    if n_before is None or n_after is None:
+        cache = "unknown"
+    elif n_after > n_before:
+        cache = "miss"
+    else:
+        # No new entry: a true hit — unless the compile finished under
+        # the persistence floor (jax_persistent_cache_min_compile_time_secs,
+        # 0.1 s per utils/compile_cache), where XLA writes nothing either
+        # way and hit vs sub-floor-cold is indistinguishable.
+        try:
+            import jax
+
+            floor = float(jax.config.jax_persistent_cache_min_compile_time_secs)
+        except Exception:
+            floor = 0.1
+        cache = "hit" if stages["compile"] >= floor else "unknown"
+
+    tb = begin("first_execute")
+    state_out, outs = compiled(*args)
+    jax.block_until_ready(outs.agg_load)
+    end("first_execute", tb)
+    beat({"stage": "compile:done", "label": label})
+
+    total = sum(stages.values())
+    telemetry.emit("compile.done", label=label, total_s=round(total, 3),
+                   cache=cache, stages=dict(stages), buckets=buckets)
+
+    def runner(state, t0, rps):
+        return compiled(consts, state, jnp.asarray(t0),
+                        jnp.asarray(rps, dtype=jnp.float32))
+
+    report = dict(label=label, stages=dict(stages), cache=cache,
+                  total_s=round(total, 3), buckets=buckets)
+    return runner, state_out, outs, report
+
+
+def selftest(n_homes: int = 4, horizon: int = 2, steps: int = 2) -> dict:
+    """Tiny end-to-end staged compile (doctor ``--compile-check`` runs
+    this in a hard-timeouted subprocess): builds a minimal community
+    engine, stages its chunk compile, and returns the report with an
+    ``ok`` verdict.  Synthetic data, any backend."""
+    import numpy as np
+
+    from dragg_tpu.config import default_config
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = n_homes
+    cfg["community"]["homes_pv"] = 0
+    cfg["home"]["hems"]["prediction_horizon"] = horizon
+    env = load_environment(cfg, data_dir=None)
+    wd = load_waterdraw_profiles(None, seed=12)
+    homes = create_homes(cfg, 24, 1, wd)
+    batch = build_home_batch(
+        homes, max(1, horizon), 1,
+        int(cfg["home"]["hems"]["sub_subhourly_steps"]))
+    engine = make_engine(batch, env, cfg, 0)
+    rps = np.zeros((steps, engine.params.horizon), np.float32)
+    _runner, _state, outs, report = staged_compile(
+        engine, engine.init_state(), 0, rps, label="selftest")
+    report["ok"] = (all(s in report["stages"] for s in STAGES)
+                    and bool(np.isfinite(float(np.asarray(outs.agg_load)[0]))))
+    return report

@@ -39,12 +39,31 @@ EVENTS: dict[str, str] = {
     "supervisor.launch": "supervised child launched (label, pid, "
                          "deadline_s, stall_s)",
     "supervisor.exit": "supervised child exited (label, rc, ok, failure, "
-                       "timed_out, stalled, elapsed_s)",
+                       "timed_out, stalled, elapsed_s, progress = the "
+                       "child's last heartbeat payload — names the stage "
+                       "a stall-killed child was in)",
     "degrade.transition": "degradation policy moved platforms "
                           "(from_platform, to_platform, "
                           "resumed_from_timestep, failure)",
     "telemetry.selftest": "doctor plumbing check event (written to a "
                           "throwaway dir only)",
+    # Observatory layer (round 9): per-home solver attribution folded on
+    # device (engine._per_home_obs) and emitted per chunk by the
+    # aggregator, plus the staged-compile spans (telemetry/compile_obs).
+    "solver.convergence": "one bucket's per-chunk convergence attribution "
+                          "(t0, t1, bucket, n_homes, rprim_hist, "
+                          "iters_hist, mean_iters, diverged — histogram "
+                          "bin edges in docs/telemetry.md)",
+    "solver.worst": "the chunk's worst-k homes by final primal residual "
+                    "(t0, t1, homes = [{home, bucket, t, r_prim, r_dual, "
+                    "iters}])",
+    "solver.diverged": "a chunk contained certified-diverged homes (t0, "
+                       "t1, total, by_bucket)",
+    "compile.stage": "one staged-compile stage closed (label, stage = "
+                     "lower|compile|first_execute, s, buckets = pattern "
+                     "shape keys)",
+    "compile.done": "a staged compile finished (label, total_s, cache = "
+                    "hit|miss|unknown, stages = {name: s}, buckets)",
     # The resilience failure taxonomy as event types (one per kind in
     # taxonomy.FAILURE_KINDS; ``source`` says which layer classified it:
     # "probe" or "supervisor", ``detail``/``label`` locate it).
@@ -123,6 +142,34 @@ METRICS: dict[str, tuple[str, str]] = {
                              "unknown"),
     "probe.elapsed_s": ("histogram", "liveness probe wall seconds"),
     "supervisor.child_s": ("histogram", "supervised child wall seconds"),
+    # Observatory layer (round 9): one per-bucket literal per home type
+    # (the bench.phase.solve_<type>_s precedent) — mean per-home
+    # convergence iterations per chunk, from the device-side fold.
+    "solver.conv_iters_pv_battery": ("histogram",
+                                     "mean per-home convergence iterations "
+                                     "per chunk, pv_battery bucket"),
+    "solver.conv_iters_pv_only": ("histogram",
+                                  "mean per-home convergence iterations "
+                                  "per chunk, pv_only bucket"),
+    "solver.conv_iters_battery_only": ("histogram",
+                                       "mean per-home convergence "
+                                       "iterations per chunk, battery_only "
+                                       "bucket"),
+    "solver.conv_iters_base": ("histogram",
+                               "mean per-home convergence iterations per "
+                               "chunk, base bucket"),
+    "solver.conv_iters_superset": ("histogram",
+                                   "mean per-home convergence iterations "
+                                   "per chunk, unbucketed superset batch"),
+    "solver.diverged_homes": ("counter",
+                              "cumulative certified-diverged home-steps "
+                              "(per-home divergence flag from the solver)"),
+    "solver.worst_rprim": ("gauge",
+                           "worst home's final primal residual in the "
+                           "latest chunk"),
+    "compile.stage_s": ("histogram",
+                        "staged-compile stage wall seconds (stage name on "
+                        "the paired compile.stage event)"),
 }
 
 

@@ -61,6 +61,14 @@ def _host_fingerprint() -> str:
     return platform.machine() or "unknown"
 
 
+def enabled_cache_dir() -> str | None:
+    """The directory a prior :func:`enable_compile_cache` activated in
+    this process (None = persistent cache off) — the staged-compile
+    hit/miss heuristic reads entry counts here
+    (dragg_tpu/telemetry/compile_obs.py)."""
+    return _ENABLED_DIR
+
+
 def enable_compile_cache(config: dict | None = None) -> str | None:
     """Idempotently enable JAX's persistent compilation cache; returns the
     cache directory, or None when disabled (``tpu.compile_cache = false``)

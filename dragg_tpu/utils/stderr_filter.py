@@ -25,6 +25,15 @@ untouched, as does every other byte of stderr.
 
 Install only in CLI/bench entry processes (never under pytest — the
 fd-2 dup would fight pytest's capture machinery).
+
+Subprocess caveat (ADVICE r5 #3): children spawned AFTER install inherit
+fd 2 = the filter pipe's write end.  At parent exit :func:`drain`
+restores the real fd 2 and joins the pump with a bounded timeout — a
+still-running child keeps the pipe's write side open, so the pump never
+sees EOF, the join expires, and the child's remaining stderr dies with
+the parent.  Spawners in a filtered process should therefore pass
+``stderr=real_stderr_fd()`` (or a file, as bench.py's supervisor does)
+so the child bypasses the parent-lifetime pipe entirely.
 """
 
 from __future__ import annotations
@@ -34,6 +43,16 @@ import re
 import threading
 
 _INSTALLED = False
+_REAL_ERR_FD: int | None = None
+
+
+def real_stderr_fd() -> int | None:
+    """The saved UNFILTERED stderr fd while the filter is installed
+    (None = filter not installed; use plain fd 2 / None).  Pass as the
+    ``stderr=`` of subprocess spawns from a filtered process — see the
+    module docstring's subprocess caveat.  The fd stays valid for the
+    process lifetime (drain() restores fd 2 FROM it, never closes it)."""
+    return _REAL_ERR_FD
 
 # One loader line names one feature; benign iff it is an LLVM tuning
 # preference.  Keep the match tight: file tag + exact phrase + pref name.
@@ -74,11 +93,14 @@ def install_aot_mismatch_filter() -> bool:
         return False
     try:
         real_err = os.dup(2)
+        os.set_inheritable(real_err, True)  # usable as a child's stderr=
         rd, wr = os.pipe()
         os.dup2(wr, 2)
         os.close(wr)
     except OSError:
         return False
+    global _REAL_ERR_FD
+    _REAL_ERR_FD = real_err
 
     def pump() -> None:
         buf = b""

@@ -1,0 +1,365 @@
+"""The round-9 observatory layer: per-home solver attribution (device-side
+fixed-bin histograms + worst-k riding the StepOutputs transfer), staged
+compile telemetry (telemetry/compile_obs), and the bench trend gate
+(tools/bench_trend.py).
+
+Parity follows the round-7/round-8 precedent: sharded-vs-single float
+telemetry gets tolerance (per-compile fp wobble near bin edges can move
+a home one half-decade bin), while structural invariants (counts,
+totals, index validity) are exact.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dragg_tpu import telemetry
+from dragg_tpu.config import default_config
+from dragg_tpu.data import load_environment, load_waterdraw_profiles
+from dragg_tpu.engine import (
+    OBS_ITER_BINS,
+    OBS_RES_BINS,
+    make_engine,
+)
+from dragg_tpu.homes import build_home_batch, create_homes
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mixed_setup(n=64, pv=26, bat=6, pvb=6, horizon=4):
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = n
+    cfg["community"]["homes_pv"] = pv
+    cfg["community"]["homes_battery"] = bat
+    cfg["community"]["homes_pv_battery"] = pvb
+    cfg["home"]["hems"]["prediction_horizon"] = horizon
+    env = load_environment(cfg, data_dir=None)
+    wd = load_waterdraw_profiles(None, seed=12)
+    homes = create_homes(cfg, 24, 1, wd)
+    batch = build_home_batch(homes, horizon, 1,
+                             int(cfg["home"]["hems"]["sub_subhourly_steps"]))
+    return cfg, env, batch
+
+
+@pytest.fixture(scope="module")
+def obs_runs():
+    """Bucketed single-device vs 8-device-mesh chunk outputs on the same
+    64-home mixed community, observatory enabled (module-scoped: two
+    engine compiles shared by the parity/structure tests)."""
+    from dragg_tpu.parallel import make_mesh, make_sharded_engine
+
+    cfg, env, batch = _mixed_setup()
+    eng = make_engine(batch, env, cfg, 0)  # auto → bucketed at 64 homes
+    assert eng.bucketed and eng.obs_enabled
+    sh = make_sharded_engine(batch, env, cfg, 0, mesh=make_mesh(8))
+    rps = np.zeros((3, eng.params.horizon), np.float32)
+    _, out = eng.run_chunk(eng.init_state(), 0, rps)
+    _, out_sh = sh.run_chunk(sh.init_state(), 0, rps)
+    return eng, sh, out, out_sh
+
+
+def _per_bucket_worst(eng, out):
+    """Worst-capture slots regrouped per bucket ordinal (k varies with
+    bucket slot counts): {ordinal: (idx, rp, iters)} per step."""
+    wb = np.asarray(out.worst_bucket)
+    wi = np.asarray(out.worst_idx)
+    wrp = np.asarray(out.worst_rp)
+    wit = np.asarray(out.worst_iters)
+    by_ord = {}
+    for o in range(len(eng.bucket_info())):
+        sel = wb[0] == o  # static per-step layout: same columns every step
+        by_ord[o] = (wi[:, sel], wrp[:, sel], wit[:, sel])
+    return by_ord
+
+
+def test_obs_structure_single_device(obs_runs):
+    """Structural invariants of the device-side fold: every real home is
+    counted exactly once per (step, bucket) histogram, worst indices are
+    valid community indices inside their bucket's range, and worst
+    residuals are consistent with the histogram's observations."""
+    eng, _sh, out, _out_sh = obs_runs
+    binfo = eng.bucket_info()
+    ch = np.asarray(out.conv_hist)        # (T, nb, RBINS)
+    ih = np.asarray(out.iters_hist)       # (T, nb, IBINS)
+    isum = np.asarray(out.iters_sum)
+    dc = np.asarray(out.diverged_count)
+    T, nb, _ = ch.shape
+    assert nb == len(binfo) == 4
+    assert ch.shape[2] == OBS_RES_BINS and ih.shape[2] == OBS_ITER_BINS
+    for bi, b in enumerate(binfo):
+        # Exactly n_real observations per step in BOTH histograms.
+        np.testing.assert_array_equal(ch[:, bi].sum(axis=1),
+                                      np.full(T, b["n_real"]))
+        np.testing.assert_array_equal(ih[:, bi].sum(axis=1),
+                                      np.full(T, b["n_real"]))
+        assert np.all(dc[:, bi] <= b["n_real"])
+        assert np.all(isum[:, bi] >= 0)
+    by_ord = _per_bucket_worst(eng, out)
+    for bi, b in enumerate(binfo):
+        wi, wrp, _wit = by_ord[bi]
+        assert wi.shape[1] == min(eng.params.obs_worst_k, b["n_slots"])
+        filled = wi >= 0
+        # Unsharded buckets carry no padding, so every slot is real.
+        assert np.all(filled[:, :min(b["n_real"], wi.shape[1])])
+        lo, hi = b["comm_start"], b["comm_start"] + b["n_real"]
+        assert np.all((wi[filled] >= lo) & (wi[filled] < hi))
+        for t in range(T):
+            f = filled[t]
+            # No home captured twice, residuals sorted descending.
+            assert len(set(wi[t, f].tolist())) == int(f.sum())
+            assert np.all(np.diff(wrp[t, f]) <= 1e-6)
+
+
+def test_obs_sharded_matches_single(obs_runs):
+    """Sharded-vs-single parity for the fold: counts are exact where the
+    quantity is discrete and robust (totals, divergence), tolerant where
+    per-compile fp wobble can move a home across a half-decade bin edge
+    or add a solver iteration (round-7 residual-wobble precedent)."""
+    eng, sh, out, out_sh = obs_runs
+    ch, ch_sh = np.asarray(out.conv_hist), np.asarray(out_sh.conv_hist)
+    assert ch.shape == ch_sh.shape
+    binfo = eng.bucket_info()
+    for bi, b in enumerate(binfo):
+        # Shard padding must be invisible: totals still count n_real.
+        np.testing.assert_array_equal(ch_sh[:, bi].sum(axis=1),
+                                      ch[:, bi].sum(axis=1))
+        np.testing.assert_array_equal(
+            np.asarray(out_sh.diverged_count)[:, bi],
+            np.asarray(out.diverged_count)[:, bi])
+        # Residual histograms: earth-mover distance between the per-step
+        # distributions stays within a few bin-edge crossings.
+        for t in range(ch.shape[0]):
+            emd = np.abs(np.cumsum(ch[t, bi]) - np.cumsum(ch_sh[t, bi])).sum()
+            assert emd <= max(2, 0.05 * b["n_real"]), (b["name"], t, emd)
+        # Mean iterations per home: within one iteration of each other.
+        isum = np.asarray(out.iters_sum)[:, bi] / b["n_real"]
+        isum_sh = np.asarray(out_sh.iters_sum)[:, bi] / b["n_real"]
+        np.testing.assert_allclose(isum_sh, isum, atol=1.0)
+    # The binding worst home per bucket agrees to residual tolerance
+    # (identity can swap between near-tied homes; magnitude cannot).
+    w, w_sh = _per_bucket_worst(eng, out), _per_bucket_worst(sh, out_sh)
+    for bi in range(len(binfo)):
+        top = np.where(w[bi][0][:, 0] >= 0, w[bi][1][:, 0], 0.0)
+        top_sh = np.where(w_sh[bi][0][:, 0] >= 0, w_sh[bi][1][:, 0], 0.0)
+        np.testing.assert_allclose(top_sh, top, rtol=1e-3, atol=1e-3)
+
+
+def test_obs_disabled_compiles_out():
+    """``telemetry.per_home = false`` removes the fold from the program:
+    zero-width observatory leaves, simulation outputs unchanged."""
+    cfg, env, batch = _mixed_setup(n=8, pv=2, bat=1, pvb=1)
+    cfg["tpu"]["bucketed"] = "false"
+    cfg_off = copy.deepcopy(cfg)
+    cfg_off["telemetry"]["per_home"] = False
+    eng_on = make_engine(batch, env, cfg, 0)
+    eng_off = make_engine(batch, env, cfg_off, 0)
+    assert eng_on.obs_enabled and not eng_off.obs_enabled
+    rps = np.zeros((2, eng_on.params.horizon), np.float32)
+    _, out_on = eng_on.run_chunk(eng_on.init_state(), 0, rps)
+    _, out_off = eng_off.run_chunk(eng_off.init_state(), 0, rps)
+    assert np.asarray(out_off.conv_hist).size == 0
+    assert np.asarray(out_off.worst_idx).size == 0
+    assert np.asarray(out_on.conv_hist).size > 0
+    np.testing.assert_array_equal(np.asarray(out_off.agg_load),
+                                  np.asarray(out_on.agg_load))
+    np.testing.assert_array_equal(np.asarray(out_off.correct_solve),
+                                  np.asarray(out_on.correct_solve))
+
+
+def test_aggregator_emits_observatory_events(tmp_path):
+    """A tiny run's events.jsonl carries the new event family with the
+    documented shapes, and the opt-in forensic dump reconstructs the
+    worst homes' identity + chunk-start state."""
+    telemetry.close_run()
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = 6
+    cfg["community"]["homes_pv"] = 1
+    cfg["community"]["homes_battery"] = 1
+    cfg["simulation"]["end_datetime"] = "2015-01-01 12"
+    cfg["home"]["hems"]["prediction_horizon"] = 2
+    cfg["telemetry"]["enabled"] = True
+    cfg["telemetry"]["dir"] = str(tmp_path)
+    cfg["telemetry"]["forensics"] = True
+    from dragg_tpu.aggregator import Aggregator
+
+    agg = Aggregator(cfg, data_dir=None, outputs_dir=str(tmp_path / "out"))
+    try:
+        agg.run()
+    finally:
+        telemetry.close_run()
+    recs = [json.loads(l) for l in open(tmp_path / telemetry.EVENTS_FILE)]
+    conv = [r for r in recs if r["event"] == "solver.convergence"]
+    assert conv, "no solver.convergence events"
+    n_steps = conv[0]["t1"] - conv[0]["t0"]
+    assert len(conv[0]["rprim_hist"]) == OBS_RES_BINS
+    assert len(conv[0]["iters_hist"]) == OBS_ITER_BINS
+    assert sum(conv[0]["rprim_hist"]) == conv[0]["n_homes"] * n_steps
+    worst = [r for r in recs if r["event"] == "solver.worst"]
+    assert worst and worst[0]["homes"]
+    for h in worst[0]["homes"]:
+        assert 0 <= h["home"] < 6
+        assert conv[0]["t0"] <= h["t"] < conv[0]["t1"]
+        assert {"bucket", "r_prim", "r_dual", "iters"} <= set(h)
+    fdir = os.path.join(agg.run_dir, "forensics")
+    dumps = sorted(os.listdir(fdir))
+    assert dumps
+    dump = json.load(open(os.path.join(fdir, dumps[0])))
+    assert dump["solver"] == cfg["home"]["hems"]["solver"]
+    assert len(dump["reward_prices"]) == dump["t1"] - dump["t0"]
+    for h in dump["homes"]:
+        assert h["name"] and h["config"]["type"] == h["type"]
+        assert set(h["state_at_chunk_start"]) == {
+            "temp_in", "temp_wh", "e_batt", "counter"}
+
+
+def test_staged_compile_selftest_and_events(tmp_path):
+    """compile_obs.selftest: all three stages timed, a cache verdict, a
+    finite first-execute output, and the compile.* events on the
+    stream."""
+    telemetry.close_run()
+    telemetry.init_run(str(tmp_path))
+    try:
+        from dragg_tpu.telemetry.compile_obs import STAGES, selftest
+
+        rep = selftest()
+    finally:
+        telemetry.close_run()
+    assert rep["ok"], rep
+    assert set(rep["stages"]) == set(STAGES)
+    assert rep["cache"] in ("hit", "miss", "unknown")
+    recs = [json.loads(l) for l in open(tmp_path / telemetry.EVENTS_FILE)]
+    stages = [r for r in recs if r["event"] == "compile.stage"]
+    assert [r["stage"] for r in stages] == list(STAGES)
+    assert all("[" in r["buckets"] for r in stages)  # pattern shapes
+    done = [r for r in recs if r["event"] == "compile.done"]
+    assert len(done) == 1 and done[0]["cache"] == rep["cache"]
+
+
+@pytest.mark.slow
+def test_compile_stall_names_stage_and_pattern(tmp_path):
+    """The acceptance chaos scenario: an injected hang inside the XLA
+    compile stage is stall-killed by the supervisor and the resulting
+    failure.COMPILE_HANG event names the stuck STAGE and the bucket
+    pattern shapes — not just the taxonomy kind (the round-4 gap)."""
+    from dragg_tpu.resilience.supervisor import run_supervised
+
+    telemetry.close_run()
+    telemetry.init_run(str(tmp_path))
+    child = ("import sys; sys.path.insert(0, %r)\n"
+             "from dragg_tpu.resilience.heartbeat import beat\n"
+             "beat({'stage': 'setup'})\n"
+             "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+             "from dragg_tpu.telemetry.compile_obs import selftest\n"
+             "selftest()\n" % ROOT)
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DRAGG_FAULT_INJECT"] = "hang@compile_compile"
+    try:
+        # stall_s must outlast the beat-free setup (import + tiny engine
+        # build, ~10 s here; more under full-suite load) but be far
+        # under the hang's duration.
+        res = run_supervised([sys.executable, "-c", child],
+                             deadline_s=600.0, stall_s=45.0,
+                             label="obs-chaos", env=env)
+    finally:
+        telemetry.close_run()
+    assert not res.ok and res.stalled
+    recs = [json.loads(l) for l in open(tmp_path / telemetry.EVENTS_FILE)]
+    fails = [r for r in recs if r["event"] == "failure.COMPILE_HANG"]
+    assert fails, [r["event"] for r in recs]
+    prog = fails[0]["progress"]
+    assert prog["stage"] == "compile:compile"
+    assert "[" in prog["buckets"]  # "<type>[<slots>x<m_eq>]" shapes
+
+
+# ------------------------------------------------------------ bench trend
+def _trend(tmp_path, artifacts, extra=()):
+    """Run tools/bench_trend.py over explicit artifact files; returns
+    (rc, parsed JSON line)."""
+    paths = []
+    for i, obj in enumerate(artifacts):
+        p = tmp_path / f"BENCH_r{i + 1:02d}.json"
+        p.write_text(json.dumps(obj))
+        paths.append(str(p))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "bench_trend.py"),
+         *paths, *extra],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+    line = proc.stdout.strip().splitlines()[-1]
+    return proc.returncode, json.loads(line)
+
+
+def _bench_line(value, solve, ordinal, **over):
+    rec = dict(metric="m", platform="cpu", solver="ipm", value=value,
+               phase_s_per_step={"solve": solve})
+    rec.update(over)
+    return {"tail": "junk\n" + json.dumps(rec) + "\n"}
+
+
+def test_bench_trend_verdicts_and_gate(tmp_path):
+    """Improvement/stable/regression against the threshold, and --gate
+    exits 1 exactly when a comparable pair regresses."""
+    arts = [_bench_line(2.0, 0.50, 1),
+            _bench_line(2.1, 0.48, 2),              # within ±10 % → stable
+            _bench_line(3.0, 0.30, 3),              # improvement
+            _bench_line(2.0, 0.45, 4)]              # regression
+    rc, trend = _trend(tmp_path, arts)
+    assert rc == 0  # no gate
+    verdicts = [(r["rate_verdict"], r["solve_verdict"])
+                for r in trend["rows"]]
+    assert verdicts == [("stable", "stable"),
+                        ("improvement", "improvement"),
+                        ("regression", "regression")]
+    rc, trend = _trend(tmp_path, arts, extra=("--gate",))
+    assert rc == 1 and trend["n_regressions"] == 1
+    rc, _ = _trend(tmp_path, arts[:3], extra=("--gate",))
+    assert rc == 0
+
+
+def test_bench_trend_comparability_rules(tmp_path):
+    """Semantics/data flips split the hard key (no cross-comparison);
+    a bucketed flip compares but is annotated (CLAUDE.md round-8 rule);
+    era defaults fill missing fields on old artifacts."""
+    arts = [
+        _bench_line(2.0, 0.50, 1),                  # era default: relaxation
+        _bench_line(1.0, 0.90, 2, semantics="integer"),  # workload change
+        _bench_line(1.4, 0.54, 3, semantics="integer", bucketed=True),
+    ]
+    rc, trend = _trend(tmp_path, arts, extra=("--gate",))
+    # r1→r2 must NOT pair (semantics flip would read as a regression);
+    # r2→r3 pairs with the bucketed-flip note.
+    assert rc == 0, trend
+    assert len(trend["rows"]) == 1
+    row = trend["rows"][0]
+    assert row["key"]["semantics"] == "integer"
+    assert row["solve_verdict"] == "improvement"
+    assert any("bucketed" in n for n in row["notes"])
+
+
+def test_bench_trend_committed_series():
+    """The committed BENCH_r01–r05 artifacts reproduce the known
+    trajectory: the r02→r03 1000-home window improved, the r04→r05
+    semantics flip (relaxation → integer) is NOT treated as a perf
+    signal, r01 (failed round) is skipped, and the gate passes."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "bench_trend.py"),
+         "--gate"],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    trend = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert trend["n_regressions"] == 0
+    keys = {(r["key"]["metric"], r["from_source"], r["to_source"])
+            for r in trend["rows"]}
+    assert ("sim_timesteps_per_s_1000homes_24h_horizon",
+            "BENCH_r02.json", "BENCH_r03.json") in keys
+    # The 10k r04 (relaxation era) and r05 (integer) must not pair.
+    assert not any("BENCH_r04.json" in (r["from_source"],)
+                   and "BENCH_r05.json" == r["to_source"]
+                   for r in trend["rows"])
+    assert any(s["source"] == "BENCH_r01.json" for s in trend["skipped"])

@@ -6,9 +6,35 @@ import numpy as np
 import jax
 
 from dragg_tpu.data import load_environment, load_waterdraw_profiles
-from dragg_tpu.engine import make_engine
+from dragg_tpu.engine import OBS_FIELDS, make_engine
 from dragg_tpu.homes import build_home_batch, create_homes
 from dragg_tpu.parallel import make_mesh, make_sharded_engine, pad_batch
+
+
+def _assert_obs_leaf_parity(name: str, ref_a, sh_a) -> None:
+    """Observatory leaves (engine.OBS_FIELDS) are per-BUCKET folds, not
+    per-home series, and they are DISCONTINUOUS in the residuals (fixed
+    bin edges, near-tied top-k), so the same per-compile fp wobble the
+    residual maxima tolerate can legitimately move a single count across
+    a bin edge or swap tied worst-k slots between layouts.  The all-leaves
+    tests therefore hold them to exact STRUCTURAL parity only (shape,
+    histogram totals, divergence counts); distribution-level parity with
+    wobble tolerance is
+    tests/test_observatory.py::test_obs_sharded_matches_single.  The
+    worst-k leaves are not even shape-comparable here: k clamps to the
+    bucket SLOT count (min(obs_worst_k, ctx.n)), which shard padding
+    legitimately inflates (6 real homes → 8 slots on the 8-device mesh),
+    so they are covered only by the dedicated test above."""
+    if name in ("conv_hist", "iters_hist"):
+        np.testing.assert_array_equal(
+            sh_a.sum(axis=2), ref_a.sum(axis=2),
+            err_msg=f"StepOutputs.{name} total observations diverged "
+                    f"between sharded and single")
+    elif name == "diverged_count":
+        np.testing.assert_array_equal(
+            sh_a, ref_a,
+            err_msg="StepOutputs.diverged_count diverged between sharded "
+                    "and single")
 
 
 def _setup(tiny_config):
@@ -101,6 +127,9 @@ def test_sharded_engine_all_leaves_fixed_iters(tiny_config):
         ref_out._fields, ref_out, sh_out
     ):
         ref_a, sh_a = np.asarray(ref_leaf), np.asarray(sh_leaf)
+        if name in OBS_FIELDS:
+            _assert_obs_leaf_parity(name, ref_a, sh_a)
+            continue
         if name not in per_home:       # (T, n_padded) → real homes only
             sh_a = sh_a[:, :n]
         # The telemetry residual maxima amplify per-compile fp wobble
@@ -152,6 +181,9 @@ def test_sharded_engine_all_leaves_ipm(tiny_config):
                 "repair_failed", "r_prim_max", "r_dual_max"}
     for name, ref_leaf, sh_leaf in zip(ref_out._fields, ref_out, sh_out):
         ref_a, sh_a = np.asarray(ref_leaf), np.asarray(sh_leaf)
+        if name in OBS_FIELDS:
+            _assert_obs_leaf_parity(name, ref_a, sh_a)
+            continue
         if name not in per_home:
             sh_a = sh_a[:, :n]
         tol = 1e-3 if name in ("r_prim_max", "r_dual_max") else 1e-4

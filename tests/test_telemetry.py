@@ -57,7 +57,8 @@ def test_registry_and_docs_cover_each_other():
                   if m.split(".")[0] in ("run", "chunk", "span", "bench",
                                          "probe", "heartbeat", "supervisor",
                                          "degrade", "failure", "telemetry",
-                                         "engine", "sim")}
+                                         "engine", "sim", "solver",
+                                         "compile")}
     registered = set(telemetry.EVENTS) | set(telemetry.METRICS) \
         | {"telemetry.enabled", "telemetry.dir", "span.s"}
     stray = {d for d in documented if d not in registered

@@ -1,0 +1,276 @@
+"""Cross-round bench trend table + regression gate.
+
+Folds the committed ``BENCH_r*.json`` artifacts (and, optionally,
+telemetry ``metrics.json`` snapshots) into per-config trend rows with
+threshold-based verdicts, so the growing artifact series detects
+regressions structurally instead of by eyeball (ROADMAP: "as fast as the
+hardware allows" needs round-over-round evidence, not one-off A/Bs).
+
+Comparability rules (CLAUDE.md "Round-5 semantic defaults"):
+
+* entries are compared ONLY within an identical hard key
+  ``(metric, platform, solver, semantics, data)`` — a semantics flip
+  (relaxation vs integer) or environment flip (synthetic vs bundled)
+  changes the measured workload, so rate deltas across them are not
+  perf signals;
+* artifacts that predate a field get the era's documented default:
+  missing ``semantics`` → "relaxation", missing ``data`` → "synthetic"
+  (rounds ≤ 4 measured the relaxation on synthetic weather);
+* ``bucketed`` is a SOFT key: ``tpu.bucketed`` is an engine default that
+  legitimately changed round 8 (−39.7 % solve phase at the 512-home
+  mix, docs/perf_notes.md), so a flip does not break comparability —
+  the verdict row is annotated with the flip instead, and readers
+  wanting a solver-only A/B pin ``--bucketed false`` at measurement
+  time (CLAUDE.md).
+
+Verdicts: per consecutive comparable pair, the headline rate (higher is
+better) and the steady-state solve phase (lower is better) each read
+``improvement`` / ``regression`` / ``stable`` against ``--threshold``
+(default 10 % — BENCH chunk rates drift across sim windows by problem
+hardness, perf_notes round 8, so sub-threshold deltas are noise).
+
+Usage:
+    python tools/bench_trend.py [artifacts...] [--threshold 0.1] [--gate]
+
+Default artifacts: ``BENCH_r*.json`` at the repo root, in round order.
+``--gate`` exits 1 when any comparable pair regresses — wired into
+tools/run_ci_locally.sh so a committed artifact that regresses a
+like-for-like config fails local CI.  Prints a human table, then
+exactly one machine-readable JSON line (repo bench convention).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HARD_KEY = ("metric", "platform", "solver", "semantics", "data")
+
+
+def _round_ordinal(path: str, fallback: int) -> int:
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else fallback
+
+
+def _iter_result_dicts(obj):
+    """Bench result dicts inside one parsed JSON object (wrapper ``tail``
+    strings included) or raw text."""
+    if isinstance(obj, dict):
+        if "metric" in obj and "value" in obj:
+            yield obj
+        elif "tail" in obj:  # the committed BENCH_r* wrapper format
+            yield from _iter_text_results(str(obj.get("tail", "")))
+        elif "histograms" in obj or "gauges" in obj:
+            yield {"_snapshot": obj}
+    elif isinstance(obj, list):
+        for item in obj:
+            yield from _iter_result_dicts(item)
+
+
+def _iter_text_results(text: str):
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            yield rec
+
+
+def load_artifact(path: str, ordinal: int) -> list[dict]:
+    """Every normalized bench entry found in one artifact file."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return [dict(source=path, ordinal=ordinal, skipped=f"unreadable: {e}")]
+    found = []
+    try:
+        parsed = json.loads(text)
+    except ValueError:
+        parsed = None
+    for rec in (_iter_result_dicts(parsed) if parsed is not None
+                else _iter_text_results(text)):
+        found.append(_normalize(rec, path, ordinal))
+    if not found:
+        return [dict(source=path, ordinal=ordinal,
+                     skipped="no bench result line (failed round?)")]
+    return found
+
+
+def _normalize(rec: dict, source: str, ordinal: int) -> dict:
+    if "_snapshot" in rec:  # telemetry metrics.json snapshot
+        snap = rec["_snapshot"]
+        gauges = snap.get("gauges", {})
+        hists = snap.get("histograms", {})
+        pfx = "bench.phase."
+        phases = {k[len(pfx):-len("_s")]: (v or {}).get("mean")
+                  for k, v in hists.items() if k.startswith(pfx)}
+        return dict(source=source, ordinal=ordinal,
+                    metric="metrics_snapshot", platform="?", solver="?",
+                    semantics="?", data="?", bucketed=False,
+                    fallback=False,
+                    value=float(gauges.get("bench.rate_ts_per_s", 0.0)),
+                    solve_rate=gauges.get("engine.solve_rate"),
+                    compile_s=None, phases=phases)
+    phases = rec.get("phase_s_per_step") or {}
+    return dict(
+        source=source, ordinal=ordinal,
+        metric=rec.get("metric"),
+        platform=rec.get("platform", "?"),
+        solver=rec.get("solver", "?"),
+        # Era defaults for pre-field artifacts (module docstring).
+        semantics=rec.get("semantics", "relaxation"),
+        data=rec.get("data", "synthetic"),
+        bucketed=bool(rec.get("bucketed", False)),
+        fallback=bool(rec.get("fallback", False)),
+        value=float(rec.get("value") or 0.0),
+        solve_rate=rec.get("solve_rate"),
+        compile_s=rec.get("compile_s"),
+        error=rec.get("error"),
+        phases=phases,
+    )
+
+
+def solve_phase_s(entry: dict) -> float | None:
+    """One steady-state solve-phase scalar per entry: the honest ipm key
+    when present, else the cached (steady-state) factor path, else the
+    refresh path (the only key very old artifacts carry)."""
+    ph = entry.get("phases") or {}
+    for key in ("solve", "solve_cached", "solve_refresh"):
+        if ph.get(key) is not None:
+            return float(ph[key])
+    return None
+
+
+def _verdict(delta: float | None, threshold: float,
+             higher_is_better: bool) -> str | None:
+    if delta is None:
+        return None
+    signed = delta if higher_is_better else -delta
+    if signed > threshold:
+        return "improvement"
+    if signed < -threshold:
+        return "regression"
+    return "stable"
+
+
+def build_trend(entries: list[dict], threshold: float) -> dict:
+    """Group by hard key, order by round, verdict every consecutive
+    pair."""
+    groups: dict[tuple, list[dict]] = {}
+    for e in entries:
+        if e.get("skipped") or e.get("error") or e["value"] <= 0:
+            continue
+        groups.setdefault(tuple(e[k] for k in HARD_KEY), []).append(e)
+    rows = []
+    for key, group in sorted(groups.items(), key=lambda kv: str(kv[0])):
+        group.sort(key=lambda e: e["ordinal"])
+        for prev, cur in zip(group, group[1:]):
+            d_rate = ((cur["value"] - prev["value"]) / prev["value"]
+                      if prev["value"] else None)
+            sp, sc = solve_phase_s(prev), solve_phase_s(cur)
+            d_solve = (sc - sp) / sp if (sp and sc is not None) else None
+            notes = []
+            # Soft flag like `bucketed`: the platform hard key already
+            # reflects the executed backend, but a degraded-ladder
+            # artifact deserves lower trust than a requested-platform one.
+            dg = [lbl for lbl, e in (("from", prev), ("to", cur))
+                  if e["fallback"]]
+            if dg:
+                notes.append(
+                    f"fallback artifact ({','.join(dg)}): the TPU→CPU "
+                    f"ladder degraded — this side measured the fallback "
+                    f"platform, not the requested one")
+            if prev["bucketed"] != cur["bucketed"]:
+                notes.append(
+                    f"tpu.bucketed resolution changed "
+                    f"{prev['bucketed']}→{cur['bucketed']} (engine default "
+                    f"— round-8 shape specialization; pin --bucketed false "
+                    f"for a solver-only A/B)")
+            rows.append(dict(
+                key={k: prev[k] for k in HARD_KEY},
+                from_source=os.path.basename(prev["source"]),
+                to_source=os.path.basename(cur["source"]),
+                rate=[prev["value"], cur["value"]],
+                rate_delta=round(d_rate, 4) if d_rate is not None else None,
+                rate_verdict=_verdict(d_rate, threshold, True),
+                solve_s=[sp, sc],
+                solve_delta=(round(d_solve, 4) if d_solve is not None
+                             else None),
+                solve_verdict=_verdict(d_solve, threshold, False),
+                notes=notes,
+            ))
+    skipped = [dict(source=os.path.basename(e["source"]),
+                    reason=e.get("skipped") or e.get("error")
+                    or "zero value")
+               for e in entries
+               if e.get("skipped") or e.get("error")
+               or (e.get("value", 0) or 0) <= 0]
+    regressions = [r for r in rows
+                   if "regression" in (r["rate_verdict"],
+                                       r["solve_verdict"])]
+    return dict(threshold=threshold, rows=rows, skipped=skipped,
+                n_regressions=len(regressions))
+
+
+def _fmt_pct(d: float | None) -> str:
+    return f"{d * 100:+.1f}%" if d is not None else "—"
+
+
+def print_table(trend: dict, out=sys.stderr) -> None:
+    print(f"bench trend (threshold ±{trend['threshold']*100:.0f}%)",
+          file=out)
+    for r in trend["rows"]:
+        k = r["key"]
+        print(f"  {k['metric']} [{k['platform']}/{k['solver']}/"
+              f"{k['semantics']}/{k['data']}] "
+              f"{r['from_source']} → {r['to_source']}", file=out)
+        print(f"    rate  {r['rate'][0]:.3f} → {r['rate'][1]:.3f} "
+              f"({_fmt_pct(r['rate_delta'])}) {r['rate_verdict']}",
+              file=out)
+        if r["solve_verdict"] is not None:
+            print(f"    solve {r['solve_s'][0]:.4f} → {r['solve_s'][1]:.4f}"
+                  f" s/step ({_fmt_pct(r['solve_delta'])}) "
+                  f"{r['solve_verdict']}", file=out)
+        for n in r["notes"]:
+            print(f"    note: {n}", file=out)
+    for s in trend["skipped"]:
+        print(f"  {s['source']}: skipped ({s['reason']})", file=out)
+    if not trend["rows"]:
+        print("  (no comparable pairs)", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("artifacts", nargs="*",
+                    help="bench artifacts / metrics snapshots (default: "
+                         "the committed BENCH_r*.json series)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative delta below which a change is 'stable'")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when any comparable pair regresses")
+    args = ap.parse_args(argv)
+
+    paths = args.artifacts or sorted(
+        glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+    entries = []
+    for i, p in enumerate(paths):
+        entries.extend(load_artifact(p, _round_ordinal(p, i)))
+    trend = build_trend(entries, args.threshold)
+    print_table(trend)
+    print(json.dumps({"tool": "bench_trend", **trend}))
+    return 1 if (args.gate and trend["n_regressions"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,6 +105,8 @@ def main():
                "horizon": args.horizon, "stages": {}}
     for name in args.stages.split(","):
         name = name.strip()
+        if not name:
+            continue
         code = STAGES[name].format(homes=args.homes, horizon=args.horizon)
         t0 = time.monotonic()
         try:
@@ -135,7 +137,11 @@ def main():
                     "the failure is the wedge trigger; restart the tunnel "
                     "before retrying")
                 break
-    results["all_ok"] = all(s["ok"] for s in results["stages"].values())
+    # ≥1 stage required: all() over an empty dict is vacuously True, and
+    # the runbook greps '"all_ok": true' — a no-stage artifact must not
+    # read as a clean pass (ADVICE r5 #4).
+    results["all_ok"] = bool(results["stages"]) and \
+        all(s["ok"] for s in results["stages"].values())
     print(json.dumps(results))
 
 

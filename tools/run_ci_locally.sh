@@ -50,6 +50,11 @@ step "test/smoke-bench" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   bash -c 'python bench.py --smoke | tee /tmp/bench_smoke.json &&
            python -c "import json; r=json.load(open(\"/tmp/bench_smoke.json\")); assert r[\"value\"]>0"'
 
+# --- job: bench-trend gate (round 9): the committed BENCH_r*.json series
+#     must show no like-for-like regression (comparability rules per
+#     CLAUDE.md; tools/bench_trend.py docstring)
+step "test/bench-trend-gate" python tools/bench_trend.py --gate
+
 # --- job: docker (not executable here — no daemon; recorded, not faked)
 if command -v docker >/dev/null 2>&1 && docker info >/dev/null 2>&1; then
   step "docker/build" docker build -t dragg-tpu:ci .

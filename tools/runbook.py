@@ -50,6 +50,14 @@ def stages(out: str) -> list[dict]:
         return not (r.get("json") or {}).get("all_ok", False)
 
     return [
+        # 0. Staged-compile canary (round-9 observatory): the SMALLEST
+        #    possible engine compile run through the staged path (doctor
+        #    --compile-check → telemetry/compile_obs), so the very first
+        #    on-chip artifact of a pass carries lower/compile/execute
+        #    stage timings + the persistent-cache verdict — and a hang
+        #    here is stage-attributed before any big compile is risked.
+        dict(name="doctor_compile_check", timeout=900,
+             argv=[PY, "-m", "dragg_tpu", "doctor", "--compile-check"]),
         # 1. HANG BISECTION FIRST (VERDICT r4 next-1): the 10k engine
         #    compile has never completed on the axon backend and the
         #    abandoned attempt wedges the tunnel; a completed 10k
