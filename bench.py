@@ -194,7 +194,13 @@ def run_measured(args) -> dict:
     # feature-mismatch ERROR (tuning prefs only — see stderr_filter.py);
     # drop exactly that signature, keep real ISA mismatches loud.
     install_aot_mismatch_filter()
-    cache_dir = enable_compile_cache()
+    # Solver-scoped persistent cache (utils/compile_cache round 10): an
+    # explicit solver keys the cache dir by family so sweeps across
+    # families never LRU-evict each other; "auto" races two families in
+    # one process and stays in the shared scope.
+    scope_cfg = (None if args.solver == "auto"
+                 else {"home": {"hems": {"solver": args.solver}}})
+    cache_dir = enable_compile_cache(scope_cfg)
     _log(f"compile cache: {cache_dir}")
     _log(f"initializing backend (platform={args.platform})...")
     dev = jax.devices()[0]  # device-call-ok: supervised child
@@ -304,6 +310,8 @@ def run_measured(args) -> dict:
 
     iters_per_step = []
     solve_rates = []
+    fallback_home_steps = []  # reluqp: homes that needed the rho bank's
+                              # exact-refactorization tail (0 elsewhere)
     t_cursor = steps
     for c in range(args.chunks):
         fault_hook("bench_chunk")
@@ -313,6 +321,8 @@ def run_measured(args) -> dict:
         t_cursor += steps
         iters_per_step.append(float(np.mean(np.asarray(outs.admm_iters))))
         solve_rates.append(float(np.mean(np.asarray(outs.correct_solve))))
+        fallback_home_steps.append(
+            float(np.sum(np.asarray(outs.bank_fallback_count))))
         _log(f"chunk {c}: {steps / sp.s:.3f} ts/s, "
              f"mean solver iters {iters_per_step[-1]:.0f}, "
              f"solve rate {solve_rates[-1]:.4f}")
@@ -424,9 +434,12 @@ def run_measured(args) -> dict:
         phases = None
         _log(f"phase profiling failed: {e!r}")
 
-    # --- FLOPs + MFU (analytic model of the ADMM's dominant dense ops; the
-    # IPM's band scans have no dense-matmul FLOPs worth modeling — its MFU
-    # is reported as None).
+    # --- FLOPs + MFU, per family: the ADMM and IPM get analytic models of
+    # their dominant ops (the IPM's band scans have no dense matmuls — its
+    # hbm_util is the binding metric); reluqp gets the EXACT dense-matmul
+    # iteration count (ops.reluqp.iteration_flops — its whole inner loop
+    # IS dense matmul, so flops_per_step/MFU is finally a real measurement
+    # rather than a floor).
     # XLA's cost_analysis counts the ADMM while_loop body ONCE, not per
     # iteration, so it can't drive MFU; use an analytic model of the
     # dominant dense ops instead (documented in docs/perf_notes.md):
@@ -443,19 +456,39 @@ def run_measured(args) -> dict:
     # for buckets that freeze earlier).
     K = max(1, engine.params.admm_refactor_every)
     mean_iters = float(np.mean(iters_per_step))
-    flops_iter = sum(6.0 * b["n_slots"] * b["m_eq"] ** 2 for b in binfo)
-    flops_factor = sum((1 / 3 + 1 + 1) * b["n_slots"] * b["m_eq"] ** 3
-                       for b in binfo)
-    flops_per_step = mean_iters * flops_iter + flops_factor / K
     mfu = peak = None
     for key, val in PEAK_FLOPS:
         if key in str(device_kind).lower():
             peak = val
             break
-    if peak and solver_used == "admm":
-        mfu = (flops_per_step * rate) / peak
     hbm_util = bytes_per_step = None
-    if solver_used != "admm":
+    if solver_used == "admm":
+        flops_iter = sum(6.0 * b["n_slots"] * b["m_eq"] ** 2 for b in binfo)
+        flops_factor = sum((1 / 3 + 1 + 1) * b["n_slots"] * b["m_eq"] ** 3
+                           for b in binfo)
+        flops_per_step = mean_iters * flops_iter + flops_factor / K
+        if peak:
+            mfu = (flops_per_step * rate) / peak
+    elif solver_used == "reluqp":
+        # EXACT dense-matmul count, not an analytic floor: every inner
+        # iteration is the three batched einsums of the x-update —
+        # ops.reluqp.iteration_flops, pinned against a hand count in
+        # tests/test_reluqp.py — times the MEASURED iteration count, plus
+        # the rho-bank rebuild amortized over the refresh cadence (the
+        # same (1/3+1+1)·m³ per-factor model as the ADMM, times the bank
+        # size).  This is the first family whose flops_per_step/MFU is
+        # real MXU work rather than an analytic floor (ISSUE 6).
+        from dragg_tpu.ops.reluqp import bank_factor_flops, iteration_flops
+
+        R = engine.params.reluqp_bank
+        flops_iter = sum(b["n_slots"] * iteration_flops(b["m_eq"], b["n_var"])
+                         for b in binfo)
+        flops_factor = sum(b["n_slots"] * bank_factor_flops(b["m_eq"], R)
+                           for b in binfo)
+        flops_per_step = mean_iters * flops_iter + flops_factor / K
+        if peak:
+            mfu = (flops_per_step * rate) / peak
+    else:
         # IPM FLOPs floor (VPU elementwise, per iteration per home): band
         # factor ≈ 2·m·(bw+1)², ~10 forward/backward solve passes at
         # 2·m·(bw+1) MACs each, and ~6 sparse A matvecs at 2·nnz.  The
@@ -585,6 +618,16 @@ def run_measured(args) -> dict:
         "mfu": round(mfu, 4) if mfu is not None else None,
         "hbm_bytes_per_step_est": bytes_per_step,
         "hbm_util": round(hbm_util, 4) if hbm_util is not None else None,
+        # reluqp only: whether the pre-factorized path sufficed, or some
+        # home-steps entered the rho bank's fallback exact-refactorization
+        # tail (ops/reluqp.py; summed over the timed chunks — the per-step
+        # counts ride StepOutputs.bank_fallback_count).
+        "reluqp_bank_fallback_home_steps": (
+            int(sum(fallback_home_steps)) if solver_used == "reluqp"
+            else None),
+        "reluqp_bank_fallback": (
+            bool(sum(fallback_home_steps) > 0) if solver_used == "reluqp"
+            else None),
     }
     # Mirror the headline artifact onto the unified stream and persist
     # the metrics snapshot (no-op on the memory-only bus) so a run dir
@@ -632,12 +675,16 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=16, help="timesteps per timed chunk")
     ap.add_argument("--chunks", type=int, default=3, help="number of timed chunks")
     ap.add_argument("--admm-iters", type=int, default=1000)
-    ap.add_argument("--solver", choices=["auto", "admm", "ipm"], default="ipm",
+    ap.add_argument("--solver", choices=["auto", "admm", "ipm", "reluqp"],
+                    default="ipm",
                     help="ipm (default): the measured-fastest family in "
                          "every recorded regime (docs/perf_notes.md "
                          "'Solver default decision') — skipping the race "
-                         "saves half a constrained TPU window; auto: race "
-                         "both over several warm steps and keep the winner")
+                         "saves half a constrained TPU window; reluqp: the "
+                         "pre-factorized dense-matmul family (MXU work by "
+                         "construction — ops/reluqp.py); auto: race "
+                         "admm/ipm over several warm steps and keep the "
+                         "winner")
     ap.add_argument("--platform", choices=["auto", "tpu", "cpu"], default="auto")
     ap.add_argument("--bucketed", choices=["auto", "true", "false"],
                     default="auto",

@@ -742,6 +742,14 @@ class Aggregator:
             "num_timesteps": self.num_timesteps,
             "n_homes": len(self.all_homes) if self.all_homes else
                        self.config["community"]["total_number_homes"],
+            # Solver family (config.resolve_solver_family): warm_rho is a
+            # continuous per-home rho under admm but a bank-snapped value
+            # under reluqp, and the two families' warm carries are not
+            # interchangeable semantics even at identical leaf shapes — a
+            # checkpoint written under one family must start fresh under
+            # the other, not silently cross-seed it (round 10).
+            "solver": (self.engine.params.solver
+                       if self.engine is not None else None),
             # Sharded engines pad the home axis, so the carry leaves are
             # sized by the SLOT count — a checkpoint from a different
             # device count / sharding mode must start fresh, not crash in

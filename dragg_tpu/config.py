@@ -72,6 +72,37 @@ def configured_solver(config: dict) -> str:
     return str(config["home"]["hems"].get("solver", "ipm"))
 
 
+# Batched solver families this framework implements (round 10 adds the
+# pre-factorized dense-matmul "reluqp" — ops/reluqp.py), plus the mapping
+# from the reference's solver names (the GLPK_MI/ECOS/GUROBI table,
+# dragg/mpc_calc.py:141-145, and the shipped config.toml default
+# "GLPK_MI") onto them, so an unmodified reference config runs: the MILP
+# semantics are covered by the relaxation + integer_first_action contract
+# (ops/qp.py), and ECOS — itself an interior-point code — maps to the IPM.
+SOLVER_FAMILIES = ("ipm", "admm", "reluqp")
+REFERENCE_SOLVER_MAP = {
+    "glpk_mi": "ipm", "glpk": "ipm", "gurobi": "ipm", "ecos": "ipm",
+}
+
+
+def resolve_solver_family(config: dict) -> str:
+    """The batched solver family the config selects — ``configured_solver``
+    lowered and mapped through :data:`REFERENCE_SOLVER_MAP`.  Raises
+    ``ConfigError`` for names in neither table.  The engine, the compile
+    cache's solver scoping (utils/compile_cache.py), and checkpoint
+    invalidation (aggregator._run_shape) all resolve through here so the
+    three can never disagree about which family a config runs."""
+    name = configured_solver(config).lower()
+    name = REFERENCE_SOLVER_MAP.get(name, name)
+    if name not in SOLVER_FAMILIES:
+        raise ConfigError(
+            f"home.hems.solver must be one of {'|'.join(SOLVER_FAMILIES)} "
+            f"(or a reference solver name "
+            f"{'|'.join(sorted(REFERENCE_SOLVER_MAP))}), got "
+            f"{config['home']['hems'].get('solver')!r}")
+    return name
+
+
 def load_config(path: str | None = None) -> dict:
     """Load and validate a TOML config.
 
@@ -243,6 +274,22 @@ _DEFAULT: dict[str, Any] = {
         "admm_solve_backend": "auto",  # in-loop KKT solve: "dense_inv" |
                                        # "band" (no (B,m,m) array — the
                                        # 100k-home memory regime) | "auto"
+        # ReLU-QP family (hems.solver="reluqp", round 10 — ops/reluqp.py):
+        # per-type pre-factorized dense-matmul ADMM.  The rho schedule is a
+        # geometric bank centered on reluqp_rho with ratio
+        # reluqp_rho_factor; in-loop rho adaptation is an index switch into
+        # the bank (never a refactorization).
+        "reluqp_rho": 0.1,        # bank center rho (matches admm_rho)
+        "reluqp_rho_factor": 6.0,  # geometric spacing between bank entries
+        "reluqp_bank": 5,         # bank size R — (B, R, m, m) pre-inverted
+                                  # Schur operators per refresh
+        "reluqp_iters": 2000,     # banked-loop iteration cap
+        "reluqp_tail_iters": 300,  # fallback exact-refactorization tail
+                                   # budget for homes the banked loop left
+                                   # unconverged (0 disables; 300 = the
+                                   # measured rescue depth for warm steps
+                                   # jammed by a stale bank — see
+                                   # ops/reluqp.py tail_iters)
         "ipm_warm_start": False,  # seed the IPM from the receding-horizon
                                   # shift — measured PESSIMIZATION (+55%
                                   # steady-state iterations, warm-start

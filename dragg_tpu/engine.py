@@ -265,6 +265,13 @@ class StepOutputs(NamedTuple):
                                   # divergence is visible, not NaN
     r_dual_max: jnp.ndarray       # () max final dual residual (same
                                   # masking/sentinel convention)
+    bank_fallback_count: jnp.ndarray  # () homes that entered the reluqp
+                                  # rho bank's fallback exact-
+                                  # refactorization tail this step
+                                  # (masked count; always 0.0 for the
+                                  # families without a bank) — bench.py
+                                  # reports whether the pre-factorized
+                                  # path sufficed from this
     # --- Observatory fold (round 9; see OBS_* constants).  Per-BUCKET
     # shapes, not per-home — merged by concatenation on axis 0, so a
     # bucketed engine reports (n_buckets, bins) / (n_buckets · k,) and the
@@ -306,8 +313,9 @@ class StepAux(NamedTuple):
 class EngineParams(NamedTuple):
     """Static (Python-side) engine configuration."""
 
-    solver: str         # "admm" | "ipm" (home.hems.solver — the reference's
-                        # solver field, dragg/mpc_calc.py:141-145 analog)
+    solver: str         # "admm" | "ipm" | "reluqp" (home.hems.solver — the
+                        # reference's solver field, dragg/mpc_calc.py:141-145
+                        # analog; registry: config.SOLVER_FAMILIES)
     horizon: int        # H — decision steps (hems horizon * dt)
     dt: int             # steps per hour
     s: float            # sub_subhourly_steps (duty-cycle denominator)
@@ -353,6 +361,15 @@ class EngineParams(NamedTuple):
     # engine ([telemetry] per_home / worst_k — docs/config.md).
     obs_per_home: bool = True
     obs_worst_k: int = 8
+    # ReLU-QP family (round 10; trailing defaults keep direct
+    # constructions valid).  The shared ADMM knobs (sigma/alpha/eps/reg/
+    # patience) are reused — the iteration is the same OSQP splitting;
+    # only the operator representation and rho handling differ.
+    reluqp_rho: float = 0.1        # rho-bank center
+    reluqp_rho_factor: float = 6.0  # geometric bank spacing
+    reluqp_bank: int = 5           # bank size R
+    reluqp_iters: int = 2000       # banked-loop iteration cap
+    reluqp_tail_iters: int = 300   # fallback exact-refactor tail budget
 
 
 class Engine:
@@ -764,6 +781,14 @@ class Engine:
             one = jnp.ones((ctx.n, 1), f32)
             return FactorCarry(d=one, e_eq=one, e_box=one, c=one,
                                Sinv=jnp.zeros((ctx.n, 1, 1), f32))
+        if self.params.solver == "reluqp":
+            # The reluqp carry holds the full pre-inverted rho bank
+            # (B, R, m, m) — refreshed on the same admm_refactor_every
+            # cadence as the ADMM's FactorCarry (ops/reluqp.py).
+            from dragg_tpu.ops.reluqp import init_reluqp_carry
+
+            return init_reluqp_carry(ctx.n, ctx.static.pattern,
+                                     bank=self.params.reluqp_bank)
         return init_factor_carry(ctx.n, ctx.static.pattern,
                                  matvec_dtype=self.params.admm_matvec_dtype,
                                  solve_backend=ctx.solve_backend,
@@ -868,6 +893,11 @@ class Engine:
         cross-step factor cache (the carry passes through untouched).
         Warm starts are opt-in (``tpu.ipm_warm_start`` → x0 from the
         receding-horizon shift) and measured neutral — docs/perf_notes.md.
+
+        ``solver="reluqp"``: the pre-factorized dense-matmul ADMM
+        (ops/reluqp.py) — the carry is a :class:`ReLUQPCarry` holding the
+        full pre-inverted rho bank; warm-start/refresh contract matches
+        the ADMM's.
         """
         p = self.params
         if p.solver == "ipm":
@@ -907,6 +937,46 @@ class Engine:
             # dependent solvers (ADMM: downstream solve rate 0.755→0.44
             # before this split — docs/perf_notes.md round 4).
             return sol, factor, relaxed, repair_failed
+
+        if p.solver == "reluqp":
+            # The pre-factorized dense-matmul family (ops/reluqp.py): the
+            # carry holds the rho BANK; ``refresh`` re-equilibrates and
+            # rebuilds every bank inverse, between refreshes the in-loop
+            # rho adaptation is a bank-index gather and the final polish
+            # refines against the exact current S.  Warm-start contract
+            # is the ADMM's (relaxed solution shifts — see below).
+            from dragg_tpu.ops.reluqp import reluqp_solve_qp_cached
+
+            def run_reluqp(l_box, u_box, fac, ref, x0, y0, rho_w):
+                return reluqp_solve_qp_cached(
+                    ctx.static.pattern, qp.vals, qp.b_eq, l_box, u_box,
+                    qp.q, fac, ref,
+                    rho0=p.reluqp_rho, rho_factor=p.reluqp_rho_factor,
+                    bank=p.reluqp_bank,
+                    sigma=p.admm_sigma, alpha=p.admm_alpha,
+                    eps_abs=p.admm_eps, eps_rel=p.admm_eps,
+                    reg=p.admm_reg,
+                    iters=p.reluqp_iters,
+                    patience=p.admm_patience,
+                    tail_iters=p.reluqp_tail_iters,
+                    x0=x0, y_box0=y0, rho_warm=rho_w,
+                )
+
+            relaxed, fcarry = run_reluqp(qp.l_box, qp.u_box, factor,
+                                         refresh, state.warm_x,
+                                         state.warm_y_box, state.warm_rho)
+            sol, repair_failed = relaxed, jnp.float32(0.0)
+            if p.integer_first_action:
+                # Pinned re-solve warm-starts from the relaxed solution
+                # and reuses the just-built bank; the NEXT step's warm
+                # start comes from `relaxed` (same contract as the ADMM —
+                # this family is warm-start-dependent too).
+                sol, repair_failed = self._integerize_first_action(
+                    ctx, qp, relaxed,
+                    lambda l2, u2: run_reluqp(l2, u2, fcarry, False,
+                                              relaxed.x, relaxed.y_box,
+                                              relaxed.rho)[0])
+            return sol, fcarry, relaxed, repair_failed
 
         def run_admm(l_box, u_box, fac, ref, x0, y0, rho0):
             return admm_solve_qp_cached(
@@ -1088,8 +1158,9 @@ class Engine:
                 iters=sol.iters, rho=sol.rho,
                 # Attribution stays the RELAXED solve's: the projection is
                 # closed-form (no iterations) and divergence is a property
-                # of the relaxation.
+                # of the relaxation (the rho-bank fallback verdict too).
                 conv_iters=sol.conv_iters, diverged=sol.diverged,
+                bank_fallback=sol.bank_fallback,
             ), repair_failed
 
         l2 = qp.l_box.at[:, cols].set(pinned)
@@ -1122,8 +1193,10 @@ class Engine:
             rho=pick(sol2.rho, sol.rho),
             # Per-home attribution keeps the RELAXED solve's verdicts (the
             # pinned re-solve runs at the loose repair_eps and its counts
-            # would conflate repair cost with convergence behavior).
+            # would conflate repair cost with convergence behavior; the
+            # rho-bank fallback verdict likewise stays the relaxation's).
             conv_iters=sol.conv_iters, diverged=sol.diverged,
+            bank_fallback=sol.bank_fallback,
         ), repair_failed
 
     def _per_home_obs(self, ctx, sol) -> dict:
@@ -1309,6 +1382,9 @@ class Engine:
             repair_failed=jnp.asarray(repair_failed, f32),
             r_prim_max=_res_max(sol.r_prim),
             r_dual_max=_res_max(sol.r_dual),
+            bank_fallback_count=(
+                jnp.sum(jnp.where(sol.bank_fallback, ctx.check_mask, 0.0))
+                if sol.bank_fallback is not None else jnp.float32(0.0)),
             **self._per_home_obs(ctx, sol),
         )
         return new_state, out
@@ -1318,7 +1394,8 @@ class Engine:
     # already-masked partial sums, and the solver telemetry scalars take
     # the binding (max) bucket.
     _SUM_OUTPUTS = frozenset(
-        {"agg_load", "forecast_load", "agg_cost", "repair_failed"})
+        {"agg_load", "forecast_load", "agg_cost", "repair_failed",
+         "bank_fallback_count"})
     _MAX_OUTPUTS = frozenset({"admm_iters", "r_prim_max", "r_dual_max"})
 
     def _merge_outputs(self, outs: list) -> StepOutputs:
@@ -1489,21 +1566,12 @@ def engine_params(config, start_index: int) -> EngineParams:
     dt = int(config["agg"]["subhourly_steps"])
     tpu_cfg = config.get("tpu", {})
     horizon = max(1, int(hems["prediction_horizon"]) * dt)
-    # Reference solver names (the GLPK_MI/ECOS/GUROBI table,
-    # dragg/mpc_calc.py:141-145, and the shipped config.toml default
-    # "GLPK_MI") map onto the batched families so an unmodified reference
-    # config runs: the MILP semantics are covered by the relaxation +
-    # rounding contract (ops/qp.py), and ECOS — itself an interior-point
-    # code — maps to the IPM.
-    from dragg_tpu.config import configured_solver
+    # Solver-family resolution (registry + reference-name mapping) lives in
+    # config.resolve_solver_family so the engine, the compile cache's
+    # solver scoping, and checkpoint invalidation agree on the family.
+    from dragg_tpu.config import resolve_solver_family
 
-    solver = configured_solver(config).lower()
-    if solver in ("glpk_mi", "glpk", "gurobi", "ecos"):
-        solver = "ipm"
-    if solver not in ("admm", "ipm"):
-        raise ValueError(
-            f"home.hems.solver must be ipm|admm (or a reference solver name "
-            f"GLPK_MI|ECOS|GUROBI), got {hems.get('solver')!r}")
+    solver = resolve_solver_family(config)
     repair_mode = str(tpu_cfg.get("integer_repair", "project"))
     if repair_mode not in ("project", "resolve"):
         raise ValueError(
@@ -1556,6 +1624,11 @@ def engine_params(config, start_index: int) -> EngineParams:
             config.get("telemetry", {}).get("per_home", True)),
         obs_worst_k=max(1, int(
             config.get("telemetry", {}).get("worst_k", 8))),
+        reluqp_rho=float(tpu_cfg.get("reluqp_rho", 0.1)),
+        reluqp_rho_factor=float(tpu_cfg.get("reluqp_rho_factor", 6.0)),
+        reluqp_bank=max(1, int(tpu_cfg.get("reluqp_bank", 5))),
+        reluqp_iters=int(tpu_cfg.get("reluqp_iters", 2000)),
+        reluqp_tail_iters=int(tpu_cfg.get("reluqp_tail_iters", 300)),
     )
 
 

@@ -1,2 +1,3 @@
 from dragg_tpu.ops.qp import QPLayout, HomeQPStatic, build_qp_static, assemble_qp_step  # noqa: F401
 from dragg_tpu.ops.admm import admm_solve, ADMMSolution  # noqa: F401
+from dragg_tpu.ops.reluqp import reluqp_solve_qp, ReLUQPCarry  # noqa: F401

@@ -154,6 +154,11 @@ class ADMMSolution(NamedTuple):
     # OSQP infeasibility certificate; IPM: the divergence freeze).
     conv_iters: jnp.ndarray | None = None  # (B,) int32
     diverged: jnp.ndarray | None = None    # (B,) bool
+    # ReLU-QP extra (round 10) — which homes entered the rho bank's
+    # fallback exact-refactorization tail (ops/reluqp.py; None for the
+    # families without a bank).  Trailing default keeps every existing
+    # construction site valid.
+    bank_fallback: jnp.ndarray | None = None  # (B,) bool
 
 
 def _pad_gather(vals, src):

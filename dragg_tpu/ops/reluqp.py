@@ -1,0 +1,518 @@
+"""ReLU-QP-style pre-factorized dense-matmul ADMM for the per-home MPC QPs.
+
+Third solver family (``hems.solver = "reluqp"``), after ReLU-QP (Bishop,
+Bouman, Tracy, Manchester — PAPERS.md, arxiv 2311.18056): an OSQP-style
+ADMM iteration whose KKT system is factorized ONCE per (bucket pattern,
+rho) into an explicit dense inverse, so every iteration of the inner loop
+is a fixed sequence of batched dense matmuls plus an elementwise clamp —
+exactly a ReLU-network forward pass.  No triangular solves, no
+data-dependent branching, no in-loop refactorization: rho adaptation is
+an INDEX SWITCH into a small geometric bank of pre-inverted Schur
+operators, never a new factorization.
+
+Differences from the existing families on the same problems:
+
+* ``ops/admm.py`` adapts a continuous per-home rho and pays an O(Bm³)
+  batched refactorization whenever any home's rho moves (gated to every
+  ``rho_update_every`` check windows exactly because that cost dominated
+  at B = 10⁴).  Here the factor for every admissible rho already exists,
+  so the adaptation is free and can run every check window.
+* The hot-loop matvecs are batched dense ``jnp.einsum`` contractions over
+  an explicitly materialized (B, m, n) Â — MXU work — instead of the
+  gather-padded sparse form (VPU work).  That trades ~n/K more FLOPs for
+  matrix-unit throughput; on CPU the sparse form wins and the A/B in
+  docs/perf_notes.md records that honestly.
+* Equality elimination is retained from the ADMM (the dynamics rows are
+  hard equalities; only the box block is split), so the pre-factorized
+  operator is the m×m Schur complement S(ρ) = Â D(ρ)⁻¹ Âᵀ — at the
+  type-bucketed shapes (m ≤ 3H+5; round 8) a full bank of R dense
+  inverses is affordable where the paper's (n+m)² KKT inverse is not.
+
+Structure of one iteration (σ, α as in OSQP; D = diag(P̂ + σ + ρŵ²)):
+
+    rhs = σ x − q̂ + ŵ∘(ρ z − y)                     elementwise
+    ν   = S(ρ)⁻¹ (Â (D⁻¹ rhs) − b̂)                  2 dense matmuls
+    x⁺  = D⁻¹ (rhs − Âᵀ ν)                          1 dense matmul
+    z⁺  = clip(α ŵ x⁺ + (1−α) z + y/ρ, l̂, û)        the "ReLU" clamp
+    y⁺  = y + ρ (α ŵ x⁺ + (1−α) z − z⁺)             elementwise
+
+The bank is carried across MPC timesteps in :class:`ReLUQPCarry`
+(refreshed on the engine's ``admm_refactor_every`` cadence, exactly like
+the ADMM's :class:`~dragg_tpu.ops.admm.FactorCarry`; between refreshes
+only the water-mix band of Â drifts and the final polish refines against
+the exact current S).  Homes still unconverged when the banked loop
+exits get ONE fallback exact refactorization at their current rho plus a
+bounded tail of iterations — the only O(Bm³) work the family can do
+inside a step, reported per home in ``ADMMSolution.bank_fallback`` so
+benchmarks can state whether the pre-factorized path sufficed.
+
+Parity/failure semantics match the other families: solutions whose
+residuals fail tolerance come back ``solved=False`` and the engine
+routes them to the fallback controller; primal infeasibility is
+certified with the OSQP §3.4 test.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dragg_tpu.ops.admm import (
+    ADMMSolution,
+    _pad_gather,
+    _schur_structure_for,
+    ruiz_equilibrate_sparse,
+)
+from dragg_tpu.ops.qp import SparsePattern, scatter_schur, schur_contrib
+
+
+class ReLUQPCarry(NamedTuple):
+    """Cross-timestep cache for the reluqp family: the Ruiz/cost scalings
+    plus the full pre-inverted rho bank, carried through the simulation
+    scan on the same refresh cadence as the ADMM's FactorCarry.  The bank
+    axis (R) is axis 1, so every leaf keeps the home batch on axis 0 and
+    shards over the mesh like any other per-home tensor."""
+
+    d: jnp.ndarray          # (B, n) column scaling
+    e_eq: jnp.ndarray       # (B, m) equality-row scaling
+    e_box: jnp.ndarray      # (B, n) box-row scaling
+    c: jnp.ndarray          # (B, 1) cost scaling
+    Sinv_bank: jnp.ndarray  # (B, R, m, m) pre-inverted Schur operators,
+                            # one per bank rho (geometric schedule)
+
+
+def bank_rhos(rho0: float, rho_factor: float, bank: int) -> np.ndarray:
+    """The geometric rho schedule, centered on ``rho0``: bank entry r is
+    ``rho0 * rho_factor**(r - bank//2)``.  Pure host-side helper so
+    config docs, tests, and the solver agree on the schedule."""
+    return float(rho0) * float(rho_factor) ** (
+        np.arange(int(bank), dtype=np.float64) - int(bank) // 2)
+
+
+def iteration_flops(m: int, n: int) -> float:
+    """EXACT dense-matmul FLOPs of one reluqp iteration for one home —
+    the three batched einsums of the x-update (module docstring):
+
+        Â (D⁻¹ rhs):  m·n multiply-adds  → 2·m·n
+        S⁻¹ t:        m·m multiply-adds  → 2·m²
+        Âᵀ ν:         n·m multiply-adds  → 2·n·m
+
+    Elementwise work (D⁻¹, clamp, y-update) is excluded — it is O(n) and
+    not matmul FLOPs.  This is the number ``bench.py`` multiplies by the
+    measured iteration count, so reluqp's ``flops_per_step`` is an exact
+    count of the dense iteration rather than an analytic floor
+    (tests/test_reluqp.py pins it against a hand count)."""
+    return 4.0 * m * n + 2.0 * m * m
+
+
+def bank_factor_flops(m: int, bank: int) -> float:
+    """Dense FLOPs of (re)building the rho bank for one home: per bank
+    entry one Cholesky (m³/3), one triangular solve of m RHS (m³), and
+    the Gram product L⁻ᵀL⁻¹ (m³) — the same per-factor model the ADMM
+    uses, times the bank size.  S formation itself runs on the sparse
+    triple lists (negligible FLOPs)."""
+    return float(bank) * (1.0 / 3.0 + 1.0 + 1.0) * float(m) ** 3
+
+
+def equilibrated_spd_inverse(S: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Condition-checked explicit inverse of a batch of (already Ruiz-
+    equilibrated) SPD matrices — the ONE sanctioned route to the dense
+    rho-bank operators (``tools/lint.py`` rejects direct
+    ``np.linalg.inv``/``jnp.linalg.inv`` outside ``ops/`` for exactly
+    this reason: an unequilibrated, unchecked inverse of a KKT-sized
+    operand silently amplifies float32 conditioning error into the hot
+    loop).
+
+    Cholesky-based (never a generic LU inverse): S = LLᵀ, S⁻¹ = L⁻ᵀL⁻¹.
+    Homes whose factorization fails the finiteness check — the practical
+    float32 condition test: cond(S) beyond ~1/eps makes the Cholesky
+    produce non-finite or the inverse overflow — are retried once with a
+    relative Tikhonov bump ``1e-6·max|S|`` on the diagonal.  Returns
+    ``(Sinv, ok)`` with ``ok`` false for homes that failed even the
+    bumped factorization (their rows are identity-scaled so downstream
+    matmuls stay finite; the residual check then flags them unsolved)."""
+    B, m, _ = S.shape
+    dtype = S.dtype
+    eye = jnp.eye(m, dtype=dtype)
+
+    def try_inv(Sx):
+        L = jnp.linalg.cholesky(Sx)
+        Linv = lax.linalg.triangular_solve(
+            L, jnp.broadcast_to(eye, Sx.shape), left_side=True, lower=True)
+        Sinv = jnp.einsum("bkm,bkn->bmn", Linv, Linv,
+                          precision=lax.Precision.HIGHEST)
+        ok = jnp.all(jnp.isfinite(Sinv), axis=(1, 2))
+        return Sinv, ok
+
+    Sinv, ok = try_inv(S)
+    bump = 1e-6 * jnp.max(jnp.abs(S), axis=(1, 2))
+    S2 = jnp.where(ok[:, None, None], S,
+                   S + jnp.maximum(bump, 1e-12)[:, None, None] * eye)
+    Sinv2, ok2 = try_inv(S2)
+    out = jnp.where(ok[:, None, None], Sinv,
+                    jnp.where(ok2[:, None, None], Sinv2, eye[None]))
+    return out, ok | ok2
+
+
+def init_reluqp_carry(B: int, pat: SparsePattern, bank: int,
+                      dtype=jnp.float32) -> ReLUQPCarry:
+    """Zero-filled carry for t=0 (the first step must pass refresh=True),
+    shaped for ``bank`` rho entries."""
+    return ReLUQPCarry(
+        d=jnp.ones((B, pat.n), dtype=dtype),
+        e_eq=jnp.ones((B, pat.m), dtype=dtype),
+        e_box=jnp.ones((B, pat.n), dtype=dtype),
+        c=jnp.ones((B, 1), dtype=dtype),
+        Sinv_bank=jnp.zeros((B, bank, pat.m, pat.m), dtype=dtype),
+    )
+
+
+def _reluqp_impl(
+    pat: SparsePattern,
+    vals: jnp.ndarray,       # (B, nnz) A_eq values
+    b_eq: jnp.ndarray,       # (B, m)
+    l_box: jnp.ndarray,      # (B, n)
+    u_box: jnp.ndarray,      # (B, n)
+    q: jnp.ndarray,          # (B, n)
+    *,
+    rho0: float = 0.1,
+    rho_factor: float = 6.0,
+    bank: int = 5,
+    sigma: float = 1e-6,
+    alpha: float = 1.6,
+    eps_abs: float = 1e-4,
+    eps_rel: float = 1e-4,
+    reg: float = 1e-3,
+    iters: int = 2000,
+    check_every: int = 25,
+    ruiz_iters: int = 10,
+    patience: int = 4,
+    tail_iters: int = 300,   # fallback exact-refactorization tail budget
+                             # for homes the banked loop left unconverged
+                             # (0 disables the fallback path entirely).
+                             # 300, not less: warm-started steps on a
+                             # STALE bank can jam borderline homes (the
+                             # stale operator biases the dual residual),
+                             # and the measured rescue needs ~cold-start
+                             # depth — 100 left 3/64 homes unsolved at
+                             # the 64-home mixed fixture, 300 solves all
+                             # (tests/test_reluqp.py equivalence suite)
+    x0: jnp.ndarray | None = None,
+    y_box0: jnp.ndarray | None = None,
+    rho_warm: jnp.ndarray | None = None,  # (B,) unscaled rho hint — snapped
+                                          # to the nearest bank entry
+    carry_in: ReLUQPCarry | None = None,
+    refresh=None,            # traced bool — recompute scalings + bank
+) -> tuple[ADMMSolution, ReLUQPCarry]:
+    """Solve B problems  min 1/2 x'(reg I)x + q'x  s.t. A_eq x = b_eq,
+    l <= x <= u  with the pre-factorized dense iteration (module
+    docstring).  Warm-startable in UNSCALED units like the ADMM."""
+    B = vals.shape[0]
+    m_eq, n = pat.m, pat.n
+    dtype = vals.dtype
+    R = int(bank)
+
+    rows = np.asarray(pat.rows)
+    cols = np.asarray(pat.cols)
+    col_rows = jnp.asarray(pat.col_rows)
+    col_src = jnp.asarray(pat.col_src)
+    schur = _schur_structure_for(pat)
+
+    if carry_in is None:
+        d, e_eq, e_box, c = ruiz_equilibrate_sparse(pat, vals, q,
+                                                    iters=ruiz_iters)
+    else:
+        d, e_eq, e_box, c = lax.cond(
+            refresh,
+            lambda: ruiz_equilibrate_sparse(pat, vals, q, iters=ruiz_iters),
+            lambda: (carry_in.d, carry_in.e_eq, carry_in.e_box, carry_in.c),
+        )
+    vals_s = e_eq[:, jnp.asarray(rows)] * vals * d[:, jnp.asarray(cols)]
+    vp_c_raw = _pad_gather(vals, col_src)          # unscaled, certificates
+    w = e_box * d
+    qs = c * d * q
+    bs = e_eq * b_eq
+    ls = e_box * l_box
+    us = e_box * u_box
+    p_diag = c * d * d * reg
+
+    # The dense scaled Â — materialized per call (it is transient; only
+    # the bank persists in the carry).  Both hot-loop matvec directions
+    # become batched dense einsums over it: MXU work by construction.
+    A_dense = jnp.zeros((B, m_eq, n), dtype=dtype).at[:, rows, cols].add(vals_s)
+
+    def mv(x):
+        return jnp.einsum("bmn,bn->bm", A_dense, x,
+                          precision=lax.Precision.HIGHEST)
+
+    def mvt(y):
+        return jnp.einsum("bmn,bm->bn", A_dense, y,
+                          precision=lax.Precision.HIGHEST)
+
+    def mvt_raw(y):
+        """A_eqᵀ y with UNSCALED values (infeasibility certificate —
+        check-window work, not the MXU hot loop)."""
+        return jnp.sum(vp_c_raw * y[:, col_rows], axis=2)
+
+    bank_arr = (jnp.asarray(rho0, dtype)
+                * jnp.asarray(rho_factor, dtype)
+                ** (jnp.arange(R, dtype=dtype) - R // 2))  # (R,)
+
+    def diag_inv(rho_b):
+        return 1.0 / (p_diag + sigma + rho_b[:, None] * w * w)
+
+    def form_S(Dinv):
+        """Exact S = Â D⁻¹ Âᵀ at the CURRENT values (bank refresh, the
+        fallback tail, and the final-polish refinement)."""
+        if schur is not None:
+            return scatter_schur(schur, m_eq,
+                                 schur_contrib(schur, vals_s, Dinv))
+        ADi = A_dense * Dinv[:, None, :]
+        return jnp.einsum("bmn,bkn->bmk", ADi, A_dense,
+                          precision=lax.Precision.HIGHEST)
+
+    def build_bank():
+        """The pre-factorized operator bank: one equilibrated,
+        condition-checked dense inverse per bank rho.  R small dense
+        factorizations ONCE per refresh — the price that buys a
+        refactorization-free inner loop."""
+        slabs = []
+        for r in range(R):
+            rho_r = jnp.full((B,), 1.0, dtype) * bank_arr[r]
+            Sinv_r, _ok = equilibrated_spd_inverse(form_S(diag_inv(rho_r)))
+            slabs.append(Sinv_r)
+        return jnp.stack(slabs, axis=1)  # (B, R, m, m)
+
+    if carry_in is None:
+        Sinv_bank = build_bank()
+    else:
+        Sinv_bank = lax.cond(refresh, build_bank,
+                             lambda: carry_in.Sinv_bank)
+
+    # Warm-start boundary (unscaled → scaled), and the bank index from the
+    # rho hint: idx = round(log_factor(rho_warm / rho0)) + center.
+    x = jnp.zeros((B, n), dtype=dtype) if x0 is None else (x0.astype(dtype) / d)
+    y_box = (jnp.zeros((B, n), dtype=dtype) if y_box0 is None
+             else (c * y_box0.astype(dtype) / e_box))
+    nu = jnp.zeros((B, m_eq), dtype=dtype)
+    z_box = jnp.clip(w * x, ls, us)
+    if rho_warm is None:
+        idx = jnp.full((B,), R // 2, jnp.int32)
+    else:
+        lf = jnp.log(jnp.asarray(rho_factor, dtype))
+        off = jnp.round(jnp.log(jnp.clip(rho_warm.astype(dtype), 1e-12, None)
+                                / rho0) / lf)
+        idx = jnp.clip(off.astype(jnp.int32) + R // 2, 0, R - 1)
+
+    def select(idx):
+        """(B, m, m) operator slab for each home's current bank index —
+        the whole rho adaptation is this gather."""
+        return jnp.take_along_axis(
+            Sinv_bank, idx[:, None, None, None], axis=1)[:, 0]
+
+    def residuals(x, z_box, nu, y_box):
+        """Unscaled residuals + relative scalings (OSQP §3.4, §5.1) —
+        identical math to ops/admm.py, dense matvecs."""
+        Ax = mv(x)
+        wx = w * x
+        r_p_eq = jnp.max(jnp.abs((Ax - bs) / e_eq), axis=1)
+        r_p_box = jnp.max(jnp.abs((wx - z_box) / e_box), axis=1)
+        r_prim = jnp.maximum(r_p_eq, r_p_box)
+        dual = (p_diag * x + qs + mvt(nu) + w * y_box) / (c * d)
+        r_dual = jnp.max(jnp.abs(dual), axis=1)
+        p_sc = jnp.maximum(
+            jnp.maximum(jnp.max(jnp.abs(Ax / e_eq), axis=1),
+                        jnp.max(jnp.abs(bs / e_eq), axis=1)),
+            jnp.maximum(jnp.max(jnp.abs(wx / e_box), axis=1),
+                        jnp.max(jnp.abs(z_box / e_box), axis=1)),
+        )
+        d_sc = jnp.maximum(
+            jnp.max(jnp.abs(mvt(nu) / (c * d)), axis=1),
+            jnp.maximum(jnp.max(jnp.abs(w * y_box / (c * d)), axis=1),
+                        jnp.max(jnp.abs(qs / (c * d)), axis=1)),
+        )
+        ok = ((r_prim <= eps_abs + eps_rel * p_sc)
+              & (r_dual <= eps_abs + eps_rel * d_sc))
+        return r_prim, r_dual, p_sc, d_sc, ok
+
+    def primal_infeasible(dnu, dy_box):
+        """OSQP §3.4 certificate on the window's dual-change direction
+        (same construction as ops/admm.py)."""
+        dnu_u = e_eq * dnu / c
+        dy_box_u = e_box * dy_box / c
+        At_dy = mvt_raw(dnu_u) + dy_box_u
+        norm_dy = jnp.maximum(jnp.max(jnp.abs(dnu_u), axis=1),
+                              jnp.max(jnp.abs(dy_box_u), axis=1))
+        eps_inf = 1e-4 * jnp.maximum(norm_dy, 1e-12)
+        cond1 = jnp.max(jnp.abs(At_dy), axis=1) <= eps_inf
+        dy_pos = jnp.maximum(dy_box_u, 0.0)
+        dy_neg = jnp.minimum(dy_box_u, 0.0)
+        sup = (jnp.sum(b_eq * dnu_u, axis=1)
+               + jnp.sum(jnp.where(dy_pos > 0, u_box * dy_pos, 0.0), axis=1)
+               + jnp.sum(jnp.where(dy_neg < 0, l_box * dy_neg, 0.0), axis=1))
+        return cond1 & (sup <= -eps_inf) & (norm_dy > 1e-10)
+
+    def one_iter(Sinv_sel, Dinv, rho_b, carry):
+        """One dense iteration: 3 einsums + clamp — branch-free."""
+        x, z_box, nu, y_box = carry
+        rhs = sigma * x - qs + w * (rho_b[:, None] * z_box - y_box)
+        t = mv(Dinv * rhs) - bs
+        nu_t = jnp.einsum("bmn,bn->bm", Sinv_sel, t,
+                          precision=lax.Precision.HIGHEST)
+        x_t = Dinv * (rhs - mvt(nu_t))
+        z_t = w * x_t
+        x_new = alpha * x_t + (1.0 - alpha) * x
+        v = alpha * z_t + (1.0 - alpha) * z_box + y_box / rho_b[:, None]
+        z_new = jnp.clip(v, ls, us)
+        y_new = y_box + rho_b[:, None] * (alpha * z_t + (1.0 - alpha) * z_box
+                                          - z_new)
+        return x_new, z_new, nu_t, y_new
+
+    def window(Sinv_sel, Dinv, rho_b, state, k):
+        return lax.fori_loop(
+            0, k, lambda _, cc: one_iter(Sinv_sel, Dinv, rho_b, cc), state)
+
+    def chunk(carry):
+        (state, idx, it, _, pinf, best_done, best_r, last_improve,
+         conv_it) = carry
+        _, _, nu_prev, y_box_prev = state
+        rho_b = bank_arr[idx]
+        Dinv = diag_inv(rho_b)
+        Sinv_sel = select(idx)
+        state = window(Sinv_sel, Dinv, rho_b, state, check_every)
+        x, z_box, nu, y_box = state
+        r_prim, r_dual, p_sc, d_sc, ok = residuals(x, z_box, nu, y_box)
+        pinf = pinf | primal_infeasible(nu - nu_prev, y_box - y_box_prev)
+        done = ok | pinf
+        it = it + check_every
+        conv_it = jnp.where((conv_it < 0) & done, it, conv_it)
+        n_done = jnp.sum(done)
+        r_tot = r_prim + r_dual
+        descending = (r_tot < 0.99 * best_r) & ~done
+        improved = (n_done > best_done) | jnp.any(descending)
+        best_done = jnp.maximum(best_done, n_done)
+        best_r = jnp.minimum(best_r, r_tot)
+        last_improve = jnp.where(improved, it, last_improve)
+        # Rho adaptation = bank-index arithmetic, EVERY window (it costs a
+        # gather, not a refactorization).  Same trigger as the ADMM's
+        # continuous update; the geometric grid quantizes the move.
+        ratio = jnp.sqrt((r_prim / jnp.maximum(p_sc, 1e-10))
+                         / jnp.maximum(r_dual / jnp.maximum(d_sc, 1e-10),
+                                       1e-10))
+        step = jnp.where(ratio > 5.0, 1, jnp.where(ratio < 0.2, -1, 0))
+        idx = jnp.clip(idx + jnp.where(done, 0, step), 0, R - 1)
+        return (state, idx, it, jnp.all(done), pinf, best_done, best_r,
+                last_improve, conv_it)
+
+    def cond(carry):
+        it, all_done, last_improve = carry[2], carry[3], carry[7]
+        keep = (it < iters) & (~all_done)
+        if patience > 0:
+            keep = keep & (it - last_improve < patience * check_every)
+        return keep
+
+    carry0 = ((x, z_box, nu, y_box), idx, jnp.asarray(0), jnp.asarray(False),
+              jnp.zeros((B,), bool), jnp.asarray(-1),
+              jnp.full((B,), jnp.inf, dtype=dtype), jnp.asarray(0),
+              jnp.full((B,), -1, dtype=jnp.int32))
+    out = lax.while_loop(cond, chunk, carry0)
+    state, idx, it, _, pinf, conv_it = (out[0], out[1], out[2], out[3],
+                                        out[4], out[8])
+    x, z_box, nu, y_box = state
+    r_prim, r_dual, _, _, ok = residuals(x, z_box, nu, y_box)
+
+    # --- Fallback exact-refactorization tail: homes the banked loop left
+    # neither converged nor certified get ONE exact factorization at
+    # their CURRENT rho (fresh values, continuous — not bank-quantized
+    # staleness) and a bounded extra run.  This is the only O(Bm³) work
+    # the family does inside a step; ``bank_fallback`` reports who needed
+    # it so artifacts can state whether the pre-factorized path sufficed.
+    need_tail = ~(ok | pinf)
+    fallback = jnp.zeros((B,), bool)
+    if tail_iters > 0:
+        def run_tail(args):
+            x, z_box, nu, y_box, conv_it = args
+            rho_b = bank_arr[idx]
+            Dinv = diag_inv(rho_b)
+            Sinv_ex, _okf = equilibrated_spd_inverse(form_S(Dinv))
+            st = window(Sinv_ex, Dinv, rho_b, (x, z_box, nu, y_box),
+                        tail_iters)
+            x2, z2, nu2, y2 = st
+            # Only the homes that NEEDED the tail adopt its iterate —
+            # converged homes keep their certified solution bit-exact.
+            m1 = need_tail[:, None]
+            x = jnp.where(m1, x2, x)
+            z_box = jnp.where(m1, z2, z_box)
+            nu = jnp.where(m1, nu2, nu)
+            y_box = jnp.where(m1, y2, y_box)
+            conv_it = jnp.where(need_tail & (conv_it < 0), it + tail_iters,
+                                conv_it)
+            return x, z_box, nu, y_box, conv_it
+
+        any_tail = jnp.any(need_tail)
+        x, z_box, nu, y_box, conv_it = lax.cond(
+            any_tail, run_tail, lambda a: a, (x, z_box, nu, y_box, conv_it))
+        it = it + jnp.where(any_tail, tail_iters, 0)
+        fallback = need_tail & any_tail
+        r_prim, r_dual, _, _, ok = residuals(x, z_box, nu, y_box)
+
+    # Final polish: D-weighted projection onto the equality manifold with
+    # refinement against the EXACT current S (absorbs the bank's
+    # between-refresh staleness, same role as the ADMM polish).
+    rho_b = bank_arr[idx]
+    Dinv = diag_inv(rho_b)
+    S_ex = form_S(Dinv)
+    Sinv_sel = select(idx)
+
+    def s_solve(r):
+        pinv = lambda rr: jnp.einsum("bmn,bn->bm", Sinv_sel, rr,
+                                     precision=lax.Precision.HIGHEST)
+        v = pinv(r)
+        for _ in range(2):
+            resid = r - jnp.einsum("bmn,bn->bm", S_ex, v,
+                                   precision=lax.Precision.HIGHEST)
+            v = v + pinv(resid)
+        return v
+
+    x = x - Dinv * mvt(s_solve(mv(x) - bs))
+
+    x_out = jnp.clip(d * x, l_box, u_box)
+    sol = ADMMSolution(
+        x=x_out, y_eq=e_eq * nu / c, y_box=e_box * y_box / c,
+        r_prim=r_prim, r_dual=r_dual, solved=ok & ~pinf, infeasible=pinf,
+        iters=it, rho=bank_arr[idx],
+        conv_iters=jnp.where(conv_it < 0, it, conv_it).astype(jnp.int32),
+        diverged=pinf,
+        bank_fallback=fallback,
+    )
+    return sol, ReLUQPCarry(d=d, e_eq=e_eq, e_box=e_box, c=c,
+                            Sinv_bank=Sinv_bank)
+
+
+_STATIC = ("pat", "bank", "iters", "check_every", "ruiz_iters", "patience",
+           "tail_iters")
+
+
+@partial(jax.jit, static_argnames=_STATIC)
+def reluqp_solve_qp(pat, vals, b_eq, l_box, u_box, q, **kwargs) -> ADMMSolution:
+    """One-shot solve (scalings + bank built in-call).  See
+    :func:`_reluqp_impl` for parameters."""
+    sol, _ = _reluqp_impl(pat, vals, b_eq, l_box, u_box, q, **kwargs)
+    return sol
+
+
+@partial(jax.jit, static_argnames=_STATIC)
+def reluqp_solve_qp_cached(pat, vals, b_eq, l_box, u_box, q, carry_in,
+                           refresh, **kwargs) -> tuple[ADMMSolution,
+                                                       ReLUQPCarry]:
+    """MPC-mode solve with the cross-timestep bank cache: reuses
+    ``carry_in``'s Ruiz scalings and Sinv bank unless the traced
+    ``refresh`` flag fires (the engine's ``admm_refactor_every``
+    cadence).  Returns the solution plus the carry for the next step."""
+    return _reluqp_impl(pat, vals, b_eq, l_box, u_box, q, carry_in=carry_in,
+                        refresh=refresh, **kwargs)

@@ -69,23 +69,48 @@ def enabled_cache_dir() -> str | None:
     return _ENABLED_DIR
 
 
-def enable_compile_cache(config: dict | None = None) -> str | None:
-    """Idempotently enable JAX's persistent compilation cache; returns the
-    cache directory, or None when disabled (``tpu.compile_cache = false``)
-    or unavailable.  Safe to call before or after backend initialization —
-    the cache config is read at compile time."""
-    global _ENABLED_DIR
+def solver_cache_scope(config: dict | None) -> str:
+    """Cache-directory scope token for the configured solver family —
+    part of the cache key (round 10).
+
+    XLA's own entry key hashes the serialized HLO, so ipm/admm/reluqp
+    executables for the SAME bucket pattern can never alias byte-wise —
+    but they all land in one flat directory, where (a) the 2 GiB LRU
+    evicts one family's entries while sweeping another, and (b) the
+    staged-compile hit/miss heuristic (compile_obs._cache_entries counts
+    directory entries) attributes one family's writes to another's
+    compile.  Scoping the directory by solver family — and, for reluqp,
+    by the rho-bank size, which changes every solver executable's shape —
+    keeps both honest.
+
+    Configs naming a reference solver resolve through the same registry
+    as the engine (``config.resolve_solver_family``), so GLPK_MI shares
+    the ipm scope.  Callers with no config (or an unresolvable solver)
+    get the "shared" scope — still fingerprint-segregated, just not
+    family-split."""
+    if not config:
+        return "shared"
+    try:
+        from dragg_tpu.config import resolve_solver_family
+
+        fam = resolve_solver_family(config)
+    except Exception:
+        return "shared"
+    if fam == "reluqp":
+        # Same clamp as engine_params — the scope token must name the bank
+        # size actually compiled, not the raw config value.
+        bank = max(1, int((config.get("tpu") or {}).get("reluqp_bank", 5)))
+        return f"reluqp-bank{bank}"
+    return fam
+
+
+def _resolve_cache_dir(config: dict | None = None) -> tuple[str, str, bool]:
+    """(base_dir, cache_dir, dragg_owned) for a config — the pure path
+    logic of :func:`enable_compile_cache`, split out so the regression
+    test can assert the solver scoping without touching the process-global
+    jax cache config."""
     tpu_cfg = (config or {}).get("tpu", {})
-    if not tpu_cfg.get("compile_cache", True):
-        if _ENABLED_DIR is not None:
-            # The process-global JAX cache config cannot be un-set per
-            # Aggregator: a prior enable stays in effect (ADVICE round 3).
-            _log.warning(
-                "compile_cache=false requested but the persistent cache was "
-                "already enabled at %s earlier in this process; it stays "
-                "enabled (jax.config is process-global)", _ENABLED_DIR)
-        return None
-    cache_dir = (
+    base_dir = (
         str(tpu_cfg.get("compile_cache_dir") or "")
         or os.environ.get("DRAGG_COMPILE_CACHE_DIR", "")
         or os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
@@ -106,9 +131,30 @@ def enable_compile_cache(config: dict | None = None) -> str | None:
     # host without it, warning "could lead to execution errors such as
     # SIGILL").  A per-fingerprint subdir prevents cross-machine loads
     # (best-effort — see _host_fingerprint) while keeping the warm-cache
-    # win on a stable host.
-    base_dir = cache_dir
-    cache_dir = os.path.join(cache_dir, _host_fingerprint())
+    # win on a stable host.  Below the fingerprint, a per-solver-family
+    # scope (see solver_cache_scope).
+    cache_dir = os.path.join(base_dir, _host_fingerprint(),
+                             solver_cache_scope(config))
+    return base_dir, cache_dir, dragg_owned
+
+
+def enable_compile_cache(config: dict | None = None) -> str | None:
+    """Idempotently enable JAX's persistent compilation cache; returns the
+    cache directory, or None when disabled (``tpu.compile_cache = false``)
+    or unavailable.  Safe to call before or after backend initialization —
+    the cache config is read at compile time."""
+    global _ENABLED_DIR
+    tpu_cfg = (config or {}).get("tpu", {})
+    if not tpu_cfg.get("compile_cache", True):
+        if _ENABLED_DIR is not None:
+            # The process-global JAX cache config cannot be un-set per
+            # Aggregator: a prior enable stays in effect (ADVICE round 3).
+            _log.warning(
+                "compile_cache=false requested but the persistent cache was "
+                "already enabled at %s earlier in this process; it stays "
+                "enabled (jax.config is process-global)", _ENABLED_DIR)
+        return None
+    base_dir, cache_dir, dragg_owned = _resolve_cache_dir(config)
     if _ENABLED_DIR is not None:
         if cache_dir != _ENABLED_DIR:
             _log.warning(
@@ -117,20 +163,23 @@ def enable_compile_cache(config: dict | None = None) -> str | None:
                 "process-global — first enable wins)",
                 _ENABLED_DIR, cache_dir)
         return _ENABLED_DIR
-    # Pre-fingerprint entries at the base level are dead weight no code
-    # path reads anymore (JAX's 2 GiB LRU only manages the subdir) —
-    # sweep plain files, leave subdirectories (other hosts' caches).
+    # Pre-fingerprint entries at the base level — and pre-solver-scope
+    # entries at the fingerprint level (rounds ≤9 wrote entries there) —
+    # are dead weight no code path reads anymore (JAX's 2 GiB LRU only
+    # manages the active subdir) — sweep plain files, leave
+    # subdirectories (other hosts' / other solver families' caches).
     # Only in dragg-owned dirs, and only once per process (we are past the
     # _ENABLED_DIR short-circuit here), never in a shared
     # $JAX_COMPILATION_CACHE_DIR (ADVICE round 4).
     if dragg_owned:
-        try:
-            for entry in os.listdir(base_dir):
-                p = os.path.join(base_dir, entry)
-                if os.path.isfile(p):
-                    os.remove(p)
-        except OSError:
-            pass
+        for sweep_dir in (base_dir, os.path.dirname(cache_dir)):
+            try:
+                for entry in os.listdir(sweep_dir):
+                    p = os.path.join(sweep_dir, entry)
+                    if os.path.isfile(p):
+                        os.remove(p)
+            except OSError:
+                pass
     try:
         import jax
 

@@ -28,7 +28,8 @@ CASES = [
 ]
 
 
-def _run_corner(h, dt, s, n, pv, bat, pvb, seed, bucketed="auto"):
+def _run_corner(h, dt, s, n, pv, bat, pvb, seed, bucketed="auto",
+                solver=None):
     cfg = copy.deepcopy(default_config())
     cfg["community"]["total_number_homes"] = n
     cfg["community"]["homes_pv"] = pv
@@ -39,6 +40,8 @@ def _run_corner(h, dt, s, n, pv, bat, pvb, seed, bucketed="auto"):
     cfg["home"]["hems"]["prediction_horizon"] = h
     cfg["home"]["hems"]["sub_subhourly_steps"] = s
     cfg["tpu"]["bucketed"] = bucketed
+    if solver is not None:
+        cfg["home"]["hems"]["solver"] = solver
 
     env = load_environment(cfg, data_dir=None)
     wd = load_waterdraw_profiles(None, seed=seed)
@@ -104,6 +107,24 @@ def test_engine_invariants_across_type_mixes(h, dt, s, n, pv, bat, pvb, seed,
                                   ("pv_battery", pvb),
                                   ("base", n - pv - bat - pvb)) if c > 0}
         assert {b["name"] for b in info} == present
+
+
+# ReLU-QP corners (round 10): the pre-factorized family must hold the
+# same invariants over the shape/mix knobs — including the degenerate
+# bucket shapes, where every bucket gets its own (B, R, m, m) rho bank.
+RELUQP_CASES = [
+    CASES[1],           # subhourly steps + every special type
+    CASES[2],           # base-only community (reduced layout)
+    CASES[5],           # odd horizon, every type
+    (2, 1, 6, 33, 13, 4, 3, 12),  # smallest auto-bucketed community
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("h,dt,s,n,pv,bat,pvb,seed", RELUQP_CASES)
+def test_engine_invariants_reluqp_type_mixes(h, dt, s, n, pv, bat, pvb,
+                                             seed):
+    _run_corner(h, dt, s, n, pv, bat, pvb, seed, solver="reluqp")
 
 
 def test_shipped_example_config_matches_defaults():

@@ -87,3 +87,39 @@ def test_telemetry_scope():
     assert lint._is_telemetry_scope(os.path.join(ROOT, "bench.py"))
     assert lint._is_telemetry_scope(os.path.join(ROOT, "tools", "x.py"))
     assert not lint._is_telemetry_scope(os.path.join(ROOT, "tests", "x.py"))
+
+
+def test_kkt_inverse_discipline(tmp_path):
+    """Round-10 rule: direct np/jnp.linalg.inv outside dragg_tpu/ops/ is
+    rejected — KKT-sized inverses must go through the equilibrated,
+    condition-checked helper (ops.reluqp.equilibrated_spd_inverse); the
+    kkt-inv-ok marker opts out sites with provably non-KKT operands."""
+    import ast
+
+    lint = _load_lint()
+    src = (
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "a = np.linalg.inv(S)\n"                               # bad
+        "b = jnp.linalg.inv(K)\n"                              # bad
+        "c = np.linalg.inv(rot2x2)  # kkt-inv-ok: 2x2 rotation\n"  # marked
+        "d = np.linalg.solve(S, r)\n"                          # fine
+        "e = jnp.linalg.cholesky(S)\n"                         # fine
+    )
+    problems = lint.check_kkt_inverse_discipline(
+        ast.parse(src), src.splitlines(), "dragg_tpu/x.py")
+    assert len(problems) == 2, problems
+    assert any(":3:" in p for p in problems)
+    assert any(":4:" in p for p in problems)
+
+
+def test_kkt_inverse_scope():
+    """The rule covers framework + entry-point code but NOT dragg_tpu/ops/
+    — the solver kernels own their factorization-internal inverses."""
+    lint = _load_lint()
+    assert lint._is_kkt_inv_scope(os.path.join(ROOT, "dragg_tpu", "engine.py"))
+    assert lint._is_kkt_inv_scope(os.path.join(ROOT, "bench.py"))
+    assert lint._is_kkt_inv_scope(os.path.join(ROOT, "tools", "x.py"))
+    assert not lint._is_kkt_inv_scope(
+        os.path.join(ROOT, "dragg_tpu", "ops", "reluqp.py"))
+    assert not lint._is_kkt_inv_scope(os.path.join(ROOT, "tests", "x.py"))

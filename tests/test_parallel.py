@@ -122,7 +122,8 @@ def test_sharded_engine_all_leaves_fixed_iters(tiny_config):
                                   np.asarray(ref_out.admm_iters))
 
     per_home = {"agg_load", "forecast_load", "agg_cost", "admm_iters",
-                "repair_failed", "r_prim_max", "r_dual_max"}
+                "repair_failed", "r_prim_max", "r_dual_max",
+                "bank_fallback_count"}
     for name, ref_leaf, sh_leaf in zip(
         ref_out._fields, ref_out, sh_out
     ):
@@ -178,7 +179,8 @@ def test_sharded_engine_all_leaves_ipm(tiny_config):
     _, sh_out = sh_engine.run_chunk(sh_engine.init_state(), 0, rps)
 
     per_home = {"agg_load", "forecast_load", "agg_cost", "admm_iters",
-                "repair_failed", "r_prim_max", "r_dual_max"}
+                "repair_failed", "r_prim_max", "r_dual_max",
+                "bank_fallback_count"}
     for name, ref_leaf, sh_leaf in zip(ref_out._fields, ref_out, sh_out):
         ref_a, sh_a = np.asarray(ref_leaf), np.asarray(sh_leaf)
         if name in OBS_FIELDS:

@@ -1,5 +1,6 @@
-"""Engine-level band-kernel A/B: time warm engine steps for each
-``tpu.band_kernel`` family on whatever backend is up.
+"""Engine-level A/B: time warm engine steps for each ``tpu.band_kernel``
+family — or, with ``--solvers``, for each SOLVER family — on whatever
+backend is up.
 
 The round-4 microbench (docs/onchip_r4/band_kernel_24h.json) showed the
 pallas refined solve 0.73x vs the XLA scan on real Mosaic while the
@@ -7,10 +8,16 @@ factor is 1.41x the other way — so the engine-level winner is not
 decidable from kernel timings alone.  This tool gives the end-to-end
 verdict that sets the ``auto`` policy.
 
-Prints one JSON line: {kernel: s/step} + the winner.
+``--solvers ipm,admm,reluqp`` switches the swept axis from band kernels
+to solver families (round 10: the reluqp engine-level A/B the runbook
+runs on chip) — same build recipe, same warm-step timing loop, one
+engine per family, ``solver_s_per_step`` in the JSON.
+
+Prints one JSON line: {kernel-or-solver: s/step} + the winner.
 
 Usage: python tools/bench_engine_kernels.py [--homes 1000]
        [--horizon-hours 24] [--steps 6] [--kernels pallas,xla,cr]
+       [--solvers ipm,admm,reluqp] [--bucketed auto|true|false]
 """
 
 import argparse
@@ -30,6 +37,10 @@ def main():
     ap.add_argument("--horizon-hours", type=int, default=24)
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--kernels", default="pallas,xla,cr")
+    ap.add_argument("--solvers", default="",
+                    help="comma list of solver families (ipm,admm,reluqp): "
+                         "sweep SOLVERS at a fixed auto band kernel "
+                         "instead of band kernels at the fixed ipm solver")
     ap.add_argument("--bucketed", choices=["auto", "true", "false"],
                     default="false",
                     help="tpu.bucketed for the timed engine.  Default "
@@ -57,41 +68,58 @@ def main():
         "steps": args.steps, "bucketed": args.bucketed,
     }
 
+    solver_mode = bool(args.solvers.strip())
+    sweep = (args.solvers if solver_mode else args.kernels).split(",")
+
+    def build_variant(label):
+        """One engine per sweep point: solver families at the auto band
+        kernel (--solvers), or band kernels at the fixed ipm solver —
+        always THE benchmark community (bench.build: same population mix
+        and sim window as the headline bench, one definition)."""
+        if solver_mode:
+            eng, _ = bench_mod.build(args.homes, args.horizon_hours, 1000,
+                                     solver=label, bucketed=args.bucketed)
+            return eng if eng.params.solver == label else None
+        eng, _ = bench_mod.build(args.homes, args.horizon_hours, 1000,
+                                 solver="ipm", band_kernel=label,
+                                 bucketed=args.bucketed)
+        return eng if eng.band_kernel == label else None
+
     timings = {}
-    for kern in args.kernels.split(","):
-        kern = kern.strip()
+    for label in sweep:
+        label = label.strip()
         try:
-            # THE benchmark community (bench.build — same population mix
-            # and sim window as the headline bench, one definition).
-            eng, _np = bench_mod.build(args.homes, args.horizon_hours,
-                                       1000, solver="ipm",
-                                       band_kernel=kern,
-                                       bucketed=args.bucketed)
-            eng = eng if eng.band_kernel == kern else None
+            eng = build_variant(label)
             if eng is None:
-                timings[kern] = None
-                res[f"{kern}_err"] = "kernel did not resolve as requested"
+                timings[label] = None
+                res[f"{label}_err"] = "variant did not resolve as requested"
                 continue
             st = eng.init_state()
             rp0 = np.zeros(eng.params.horizon, dtype=np.float32)
             t_c0 = time.perf_counter()
             st, out = eng.step(st, 0, rp0)          # compile + cold step
             jax.block_until_ready(out.agg_load)
-            res[f"{kern}_compile_s"] = round(time.perf_counter() - t_c0, 1)
+            res[f"{label}_compile_s"] = round(time.perf_counter() - t_c0, 1)
             t0 = time.perf_counter()
             done = 0
+            fb_total = 0.0
             for i in range(1, args.steps + 1):
                 st, out = eng.step(st, i, rp0)
                 jax.block_until_ready(out.agg_load)
+                fb_total += float(np.asarray(out.bank_fallback_count))
                 done = i
                 if time.perf_counter() - t0 > 120:
                     break
-            timings[kern] = round((time.perf_counter() - t0) / done, 4)
+            timings[label] = round((time.perf_counter() - t0) / done, 4)
+            if solver_mode and label == "reluqp":
+                # Whether the pre-factorized path sufficed on the timed
+                # steps, or the rho bank's fallback refactorization ran.
+                res["reluqp_bank_fallback_home_steps"] = int(fb_total)
         except Exception as e:
-            timings[kern] = None
-            res[f"{kern}_err"] = repr(e)[:300]
+            timings[label] = None
+            res[f"{label}_err"] = repr(e)[:300]
 
-    res["s_per_step"] = timings
+    res["solver_s_per_step" if solver_mode else "s_per_step"] = timings
     alive = {k: v for k, v in timings.items() if v}
     if alive:
         res["winner"] = min(alive, key=alive.get)

@@ -22,7 +22,15 @@ what the stdlib can check:
   (dragg_tpu/telemetry/registry.py) as a string LITERAL — free strings
   fragment the unified stream the registry exists to keep analyzable.
   Computed names carry a ``# telemetry-name-ok: <why>`` marker (e.g.
-  the taxonomy-kind events, whose kinds are each registered literally).
+  the taxonomy-kind events, whose kinds are each registered literally);
+* KKT-inverse discipline in the same scope (round 10): no direct
+  ``np.linalg.inv``/``jnp.linalg.inv`` outside ``dragg_tpu/ops/`` — the
+  dense rho-bank operators of the reluqp family must be built through
+  the equilibrated, condition-checked Cholesky route
+  (``ops.reluqp.equilibrated_spd_inverse``); an unequilibrated generic
+  LU inverse of a KKT-sized operand silently amplifies float32
+  conditioning error into the hot loop.  Sites whose operand is
+  provably not KKT-sized carry a ``# kkt-inv-ok: <why>`` marker.
 
 The full flake8/autoflake hooks run via .pre-commit-config.yaml and CI
 where those tools are installable; this script is the offline floor and
@@ -158,6 +166,39 @@ def check_telemetry_names(tree, lines: list[str], rel: str) -> list[str]:
     return problems
 
 
+# KKT-inverse discipline (round 10; see the module docstring bullet).
+_INV_MARKER = "# kkt-inv-ok:"
+
+
+def _is_kkt_inv_scope(path: str) -> bool:
+    rel = os.path.relpath(path, ROOT)
+    return (_is_telemetry_scope(path)
+            and not rel.startswith(os.path.join("dragg_tpu", "ops") + os.sep))
+
+
+def check_kkt_inverse_discipline(tree, lines: list[str], rel: str) -> list[str]:
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        # Matches any `<base>.linalg.inv(...)` — np, jnp, scipy aliases.
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "inv"
+                and isinstance(fn.value, ast.Attribute)
+                and fn.value.attr == "linalg"):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if _INV_MARKER not in line:
+            problems.append(
+                f"{rel}:{node.lineno}: direct linalg.inv outside ops/ — "
+                f"KKT-sized inverses must go through the equilibrated, "
+                f"condition-checked helper "
+                f"(dragg_tpu.ops.reluqp.equilibrated_spd_inverse); mark "
+                f"the line '{_INV_MARKER} <why>' if the operand is "
+                f"provably not KKT-sized")
+    return problems
+
+
 def check_device_discipline(tree, lines: list[str], rel: str) -> list[str]:
     problems = []
     for node in ast.walk(tree):
@@ -219,6 +260,8 @@ def check_file(path: str) -> list[str]:
         problems.extend(check_device_discipline(tree, lines, rel))
     if _is_telemetry_scope(path):
         problems.extend(check_telemetry_names(tree, lines, rel))
+    if _is_kkt_inv_scope(path):
+        problems.extend(check_kkt_inverse_discipline(tree, lines, rel))
     return problems
 
 

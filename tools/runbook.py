@@ -105,6 +105,22 @@ def stages(out: str) -> list[dict]:
         dict(name="band_ab_1k", timeout=900,
              argv=[PY, "tools/bench_engine_kernels.py", "--homes", "1000",
                    "--horizon-hours", "24"]),
+        # 4b. Engine-level SOLVER A/B (round 10): reluqp vs ipm vs admm at
+        #     the 512-home bench mix — the on-chip counterpart of the CPU
+        #     A/B in docs/perf_notes.md "Round 10", behind the same probe
+        #     gates as every stage.  The JSON carries solver_s_per_step +
+        #     whether the reluqp rho bank's fallback refactorization ran.
+        dict(name="solver_ab_512_reluqp", timeout=1200,
+             argv=[PY, "tools/bench_engine_kernels.py", "--homes", "512",
+                   "--horizon-hours", "24",
+                   "--solvers", "ipm,admm,reluqp"]),
+        #     Headline-style reluqp bench at 1k: the first artifact whose
+        #     flops_per_step/MFU is the EXACT dense-iteration count
+        #     (bench.py reluqp branch) rather than an analytic floor.
+        dict(name="bench_1k_24h_reluqp", timeout=900,
+             env={"BENCH_TPU_TIMEOUT": "300", "BENCH_CPU_TIMEOUT": "300"},
+             argv=bench + ["--homes", "1000", "--horizon-hours", "24",
+                           "--solver", "reluqp"]),
         # 5. Headline bench, BASELINE row-3 config (10k x 24h), SHIPPED
         #    semantics, DUAL-REPORT: one line on the bundled shipped
         #    default, one on the rounds-2..4 synthetic environment

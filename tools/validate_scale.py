@@ -29,7 +29,8 @@ def main():
     ap.add_argument("--horizon-hours", type=int, default=48)
     ap.add_argument("--days", type=int, default=2)
     ap.add_argument("--chunk", type=int, default=8)
-    ap.add_argument("--solver", choices=["admm", "ipm"], default="admm")
+    ap.add_argument("--solver", choices=["admm", "ipm", "reluqp"],
+                    default="admm")
     ap.add_argument("--mix", default=None,
                     help="comma fractions pv,battery,pv_battery of the "
                          "population (default 0.4,0.1,0.1 — the bench "
