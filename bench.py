@@ -781,6 +781,28 @@ def main() -> None:
         if result is not None:
             if result.get("platform") == "cpu" and args.platform == "auto":
                 result["fallback"] = True
+                # Degradation provenance (ISSUE 7 satellite): when the
+                # ladder fell back mid-flight — a TPU attempt actually
+                # ran (or was probe-skipped) and the same config re-ran
+                # on CPU — name the classified failure and where the TPU
+                # attempt died (its last heartbeat progress payload), so
+                # the artifact says WHY this is a CPU number.
+                # tools/bench_trend.py treats the field as a soft key: a
+                # degraded run annotates its platform series instead of
+                # poisoning it.
+                tpu_fail = next(
+                    (a for a in reversed(attempts)
+                     if a.get("platform") == "tpu" and a.get("failure")),
+                    None)
+                if tpu_fail is not None:
+                    degraded = {"from": "tpu", "to": "cpu",
+                                "failure": tpu_fail["failure"]}
+                    progress = tpu_fail.get("progress") or {}
+                    if progress.get("timestep") is not None:
+                        degraded["transition_step"] = progress["timestep"]
+                    if progress.get("stage") is not None:
+                        degraded["transition_stage"] = progress["stage"]
+                    result["degraded"] = degraded
             result["attempts"] = attempts
             print(json.dumps(result))
         else:

@@ -69,6 +69,28 @@ def build_parser() -> argparse.ArgumentParser:
                           "subprocess — the observatory's compile-path "
                           "self-test")
 
+    srv = sub.add_parser(
+        "serve",
+        help="run the fault-tolerant MPC serving daemon (crash-safe "
+             "request journal, supervised warm-engine worker pool, "
+             "probe-gated admission with TPU→CPU degradation — "
+             "dragg_tpu/serve, docs/serving.md)")
+    srv.add_argument("--config", default=None, help="TOML config path")
+    srv.add_argument("--serve-dir", default=os.path.join("outputs", "serve"),
+                     help="journal + spool + telemetry directory (the "
+                          "daemon's durable state; survives restarts)")
+    srv.add_argument("--host", default=None,
+                     help="bind host (default: serve.host)")
+    srv.add_argument("--port", type=int, default=None,
+                     help="bind port (default: serve.port; 0 = ephemeral)")
+    srv.add_argument("--platform", choices=["auto", "tpu", "cpu"],
+                     default="auto",
+                     help="auto probes and degrades to CPU on a dead "
+                          "tunnel; tpu is strict (429s while the probe "
+                          "says no, unless serve.degrade_to_cpu); cpu "
+                          "skips probing entirely")
+    srv.add_argument("--stub", action="store_true", help=argparse.SUPPRESS)
+
     sub.add_parser("bench", help="run the benchmark harness (prints one JSON line)")
 
     dash = sub.add_parser("dashboard", help="serve the results dashboard over HTTP")
@@ -226,6 +248,21 @@ def main(argv=None) -> int:
             r.sample_home = args.home
         r.main(save=not args.no_save)
         return 0
+    if args.cmd == "serve":
+        # Serving parent stays jax-free for its whole lifetime: all
+        # device work runs in the supervised worker pool's children
+        # (dragg_tpu/serve/pool.py), so a wedged tunnel can never hang
+        # the daemon that must classify and survive it.
+        from dragg_tpu.config import load_config
+        from dragg_tpu.resilience.supervisor import assert_parent_has_no_jax
+        from dragg_tpu.serve import run_serve
+
+        assert_parent_has_no_jax()
+        return run_serve(
+            load_config(args.config), args.serve_dir,
+            platform=args.platform, host=args.host, port=args.port,
+            stub=args.stub,
+            log=lambda m: print(f"[serve] {m}", file=sys.stderr, flush=True))
     if args.cmd == "doctor":
         if args.classify:
             from dragg_tpu.doctor import run_classify

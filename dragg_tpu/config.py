@@ -234,6 +234,62 @@ _DEFAULT: dict[str, Any] = {
                                  # checkpoint (platform transition recorded
                                  # in the provenance JSON)
     },
+    # MPC serving daemon (dragg_tpu/serve — no reference analog; replaces
+    # the pathos+Redis aggregator's dies-with-its-process lifetime,
+    # dragg/aggregator.py:723-724).
+    "serve": {
+        "host": "127.0.0.1",
+        "port": 8070,         # HTTP surface (0 = ephemeral, for tests)
+        "workers": 1,          # supervised worker slots (each holds one
+                               # warm compiled engine child)
+        "queue_max": 256,      # pending+assigned cap; beyond it POST
+                               # /solve answers 429 + Retry-After
+        "batch_max": 0,        # requests per dispatched batch (0 = the
+                               # serving community size — the compiled
+                               # engine's batch shape)
+        "request_deadline_s": 120.0,  # default per-request deadline;
+                                      # expired-unserved requests fail
+                                      # (a request's own deadline_s wins)
+        "request_retries": 2,  # re-dispatches after worker deaths before
+                               # a request fails terminally
+        "batch_deadline_s": 120.0,  # wall-clock limit per dispatched
+                                    # batch; expiry kills the worker
+                                    # (DEADLINE if still beating,
+                                    # COMPILE_HANG if stalled)
+        "worker_stall_s": 900.0,  # heartbeat-stall kill for workers
+                                  # (hung compile / hung solve — the
+                                  # round-4 wedge chain); 0 disables.
+                                  # Default matches resilience.stall_s:
+                                  # staged_compile beats only BETWEEN
+                                  # stages, and a single cold compile
+                                  # stage runs 59-123 s at the 10k
+                                  # target shape — a tighter default
+                                  # would stall-kill honest cold
+                                  # compiles into an unrecoverable
+                                  # relaunch loop (nothing persisted
+                                  # mid-compile, so every relaunch is
+                                  # equally cold)
+        "backoff_s": 2.0,      # base of exponential relaunch backoff
+                               # after consecutive worker failures
+        "probe_timeout_s": 60.0,  # classified liveness probe budget for
+                                  # probe-gated admission / degradation
+        "retry_after_s": 2.0,  # Retry-After hint on queue-full 429s
+        "poll_s": 0.05,        # dispatch/worker spool poll cadence
+        "drain_s": 30.0,       # graceful-drain budget on SIGTERM (the
+                               # journal carries whatever didn't finish)
+        "journal_fsync": True,  # fsync every journal append (the
+                                # durability point; false only for
+                                # throwaway benchmarking)
+        "results_cache": 4096,  # terminal answers held in memory for
+                                # /result + duplicate-POST lookup; the
+                                # journal keeps the unbounded history
+                                # (evicted ids answer their verdict of
+                                # record with an `evicted` marker)
+        "degrade_to_cpu": True,  # dead/wedged tunnel flips to degraded-
+                                 # CPU serving (transition journaled,
+                                 # provenance on every response); false
+                                 # + --platform tpu = strict 429s
+    },
     # Unified run telemetry (dragg_tpu/telemetry — round-7 tentpole).
     "telemetry": {
         "enabled": True,  # run-scoped event bus: <run_dir>/events.jsonl +

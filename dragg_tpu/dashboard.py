@@ -176,28 +176,12 @@ class Dashboard:
     def tail_events(events_path: str, limit: int = 50,
                     tail_bytes: int = 262_144) -> list[dict]:
         """Last ``limit`` parseable event records of an events.jsonl —
-        reads a bounded tail, so tailing a huge in-progress stream stays
-        O(limit) not O(run)."""
-        try:
-            with open(events_path, "rb") as f:
-                f.seek(0, os.SEEK_END)
-                size = f.tell()
-                f.seek(max(0, size - tail_bytes))
-                lines = f.read().decode("utf-8", "replace").splitlines()
-        except OSError:
-            return []
-        out = []
-        for line in reversed(lines):
-            line = line.strip()
-            if not line.startswith("{"):
-                continue
-            try:
-                out.append(json.loads(line))
-            except ValueError:
-                continue  # torn first line of the tail window / mid-write
-            if len(out) >= limit:
-                break
-        return list(reversed(out))
+        delegates to the shared bounded tailer (telemetry.tail_events),
+        which the serving daemon's ``/events.jsonl`` endpoint uses too;
+        serve run directories therefore show up in ``/live`` like any
+        other stream."""
+        return telemetry.tail_events(events_path, limit=limit,
+                                     tail_bytes=tail_bytes)
 
     def metrics_snapshot(self, run: dict) -> dict:
         """The run's metrics: the final ``metrics.json`` when the run

@@ -141,6 +141,37 @@ def _check_staged_compile(timeout_s: float) -> dict:
                 "error": f"staged compile hung >{timeout_s:.0f}s"}
 
 
+def _check_serve_journal() -> dict:
+    """Serving-substrate plumbing (dragg_tpu/serve): a throwaway journal
+    round-trips the accepted→done lifecycle, refuses a double answer,
+    and replays a torn tail without losing the durable record — the
+    crash-safety contract the daemon's zero-lost-requests guarantee
+    stands on.  Pure stdlib; never launches a worker."""
+    import tempfile
+
+    try:
+        from dragg_tpu.serve.journal import Journal, replay
+
+        with tempfile.TemporaryDirectory(prefix="dragg_serve_") as d:
+            path = os.path.join(d, "journal.jsonl")
+            j = Journal(path)
+            j.accepted("probe", {"id": "probe", "home": 0})
+            j.accepted("torn", {"id": "torn", "home": 1})
+            ok = j.done("probe", {"p_grid": 1.0})
+            ok &= not j.done("probe", {"p_grid": 2.0})  # exactly-once
+            j.close()
+            with open(path, "ab") as f:
+                f.write(b'{"state": "done", "id": "torn", "resp')  # torn
+            rep = replay(path)
+            ok &= set(rep.pending) == {"torn"}      # torn line dropped,
+            ok &= set(rep.terminal) == {"probe"}    # durable kept
+            ok &= rep.dropped_lines == 1
+        return {"status": OK if ok else FAIL,
+                **({} if ok else {"error": "journal selftest mismatch"})}
+    except Exception as e:
+        return {"status": FAIL, "error": repr(e)}
+
+
 def _check_outputs(outputs_dir: str) -> dict:
     try:
         os.makedirs(outputs_dir, exist_ok=True)
@@ -198,6 +229,7 @@ def run_doctor(outputs_dir: str = "outputs", backend_timeout: float = 60.0,
         "data_files": _check_data(cfg),
         "outputs_writable": _check_outputs(outputs_dir),
         "telemetry": _check_telemetry(),
+        "serve_journal": _check_serve_journal(),
     }
     if compile_check:
         checks["staged_compile"] = _check_staged_compile(

@@ -138,6 +138,14 @@ def _kill_group(proc: subprocess.Popen, grace_s: float) -> None:
             pass
 
 
+# Public handles for parents that supervise LONG-LIVED children with
+# their own poll loops (the serving daemon's worker pool — serve/pool.py)
+# instead of the blocking run_supervised shape: same group-kill escalation
+# and bounded-tail reads, one implementation.
+kill_group = _kill_group
+read_tail = _read_tail
+
+
 def run_supervised(argv: list[str], deadline_s: float, *,
                    label: str = "", env: dict | None = None,
                    cwd: str | None = None, stall_s: float | None = None,

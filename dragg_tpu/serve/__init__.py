@@ -1,0 +1,14 @@
+"""Fault-tolerant MPC serving daemon (ISSUE 7 / ROADMAP open item 2).
+
+``python -m dragg_tpu serve`` — a long-lived service whose jax-free
+parent owns a crash-safe fsync'd request journal, a supervised worker
+pool holding the compiled engine warm, probe-gated admission with
+checkpointed TPU→CPU degradation, and an HTTP surface
+(/solve /result /healthz /readyz /metrics.json).  See
+:mod:`dragg_tpu.serve.daemon` for the architecture and
+``docs/serving.md`` for operator documentation.
+"""
+
+from dragg_tpu.serve.daemon import ServeDaemon, run_serve, serve_config
+
+__all__ = ["ServeDaemon", "run_serve", "serve_config"]

@@ -1,0 +1,805 @@
+"""Fault-tolerant MPC serving daemon — the jax-free parent process.
+
+``python -m dragg_tpu serve`` keeps a compiled MPC engine warm behind an
+HTTP surface and survives every failure kind in the resilience taxonomy
+without losing a request.  The reference's lifetime model — one
+pathos+Redis aggregator whose queue dies with the process
+(dragg/aggregator.py:723-724) — is exactly what this daemon replaces:
+
+* **crash-safe request journal** (serve/journal.py): a request is
+  acknowledged only after its ``accepted`` record is fsync'd; on restart
+  unfinished requests replay automatically and terminal records answer
+  duplicates without re-solving — zero lost, zero double-answered, by
+  construction;
+* **supervised worker pool** (serve/pool.py + serve/worker.py): workers
+  hold the compiled engine warm (persistent compile cache + staged
+  compile telemetry), are stall-killed on hung compiles (round-4 wedge
+  prevention) and batch deadlines, and every death is classified with
+  the taxonomy and retried with probe-gated backoff;
+* **probe-gated admission + degradation**: a dead/wedged tunnel flips
+  the service to degraded-CPU serving (transition journaled, provenance
+  attached to every response answered while degraded) instead of
+  queueing doomed TPU work; strict ``--platform tpu`` with
+  ``serve.degrade_to_cpu=false`` answers 429 + Retry-After until the
+  probe goes green;
+* **bounded everything**: per-request deadlines, bounded retry
+  (``serve.request_retries``), queue backpressure (429 + Retry-After),
+  graceful SIGTERM drain (in-flight work finishes; the journal carries
+  whatever didn't).
+
+HTTP endpoints (the dashboard's stdlib ``http.server`` idiom — its
+``/live`` + ``/metrics.json`` surface, extended with serving state):
+
+    POST /solve          accept one request (or a JSON list) -> 202/200/429/503
+    GET  /result?id=...  poll one request's outcome
+    GET  /healthz        process liveness (always 200 while serving)
+    GET  /readyz         200 only when a warm worker can take traffic
+    GET  /metrics.json   telemetry snapshot + serving counters
+    GET  /events.jsonl   bounded tail of the run's telemetry stream
+
+Request schema (POST /solve body)::
+
+    {"id": "r1", "t": 0, "home": 3, "rp": 0.0,
+     "state": {"temp_in": 20.5, "temp_wh": 46.0, "e_batt": 2.0},
+     "deadline_s": 60}
+
+``id`` is the idempotency key (generated when absent); ``home`` indexes
+the serving community; ``state`` scalars override that home's carried
+initial conditions.  The response carries the home's first MPC action
+(duty fractions, p_grid, cost, solve verdict) plus provenance
+(platform, retries, degradation record when the service degraded).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from dragg_tpu import telemetry
+from dragg_tpu.resilience import liveness
+from dragg_tpu.serve import journal as journal_mod
+from dragg_tpu.serve import spool
+from dragg_tpu.serve.pool import WorkerSlot
+
+# Failure kinds that can be transient worker trouble rather than a dead
+# device path: after one of these on the TPU mode the daemon re-probes
+# and only degrades when the probe agrees the tunnel is gone.
+_BACKOFF_CAP_S = 60.0
+
+
+def serve_config(config: dict | None) -> dict:
+    """The ``[serve]`` config section with defaults applied."""
+    from dragg_tpu.config import default_config
+
+    merged = dict(default_config()["serve"])
+    merged.update((config or {}).get("serve", {}))
+    return merged
+
+
+class ServeDaemon:
+    """One serving deployment: journal + worker pool + HTTP surface.
+
+    Programmatic use (tests, the soak)::
+
+        d = ServeDaemon(config, serve_dir, platform="cpu")
+        d.start()              # HTTP + dispatch threads; d.port bound
+        ... POST/GET against http://127.0.0.1:{d.port} ...
+        d.stop(drain=True)
+    """
+
+    def __init__(self, config: dict, serve_dir: str, *,
+                 platform: str = "auto", host: str | None = None,
+                 port: int | None = None, stub: bool = False,
+                 log=None, sleep=time.sleep):
+        self.config = json.loads(json.dumps(config))  # JSON-able contract
+        self.scfg = serve_config(self.config)
+        self.serve_dir = serve_dir
+        self.platform_req = platform
+        self.stub = stub
+        self.log = log or (lambda m: None)
+        self.sleep = sleep
+        os.makedirs(serve_dir, exist_ok=True)
+        self.spool_dir = os.path.join(serve_dir, "spool")
+        os.makedirs(self.spool_dir, exist_ok=True)
+        # A leftover STOP from a previous drain must not kill fresh workers.
+        try:
+            os.remove(spool.stop_path(self.spool_dir))
+        except OSError:
+            pass
+        self._owns_bus = False
+        if self.config.get("telemetry", {}).get("enabled", True) \
+                and not telemetry.active():
+            telemetry.init_run(os.environ.get(telemetry.ENV_DIR) or serve_dir)
+            self._owns_bus = True
+
+        # ----- journal replay BEFORE opening the append side
+        jpath = os.path.join(serve_dir, "journal.jsonl")
+        rep = journal_mod.replay(jpath)
+        self.journal = journal_mod.Journal(
+            jpath, fsync=bool(self.scfg["journal_fsync"]),
+            terminal_ids=rep.terminal)
+        self.lock = threading.RLock()
+        self.pending: dict[str, dict] = {}    # id -> entry (queue, FIFO)
+        self.assigned: dict[str, dict] = {}   # id -> entry (in a batch)
+        # In-memory answer cache, BOUNDED (the journal is the unbounded
+        # record): insertion-ordered dict, oldest evicted past the cap —
+        # a daemon that serves for months must not hold every response
+        # ever answered.  Evicted ids answer 404 on /result; duplicate
+        # re-submissions of evicted ids are refused by the journal and
+        # reported as terminal duplicates, never re-answered.
+        self._results_cap = max(64, int(self.scfg["results_cache"]))
+        self.results: dict[str, dict] = dict(
+            list(rep.terminal.items())[-self._results_cap:])
+        self.transition: dict | None = rep.transition
+        now = time.monotonic()
+        for rid, rec in rep.pending.items():
+            req = rec.get("req") or {}
+            self.pending[rid] = self._entry(rid, req, now, replayed=True)
+        if rep.pending or rep.dropped_lines:
+            telemetry.emit("serve.replay", requeued=len(rep.pending),
+                           terminal=len(rep.terminal),
+                           dropped_lines=rep.dropped_lines)
+            self.log(f"journal replay: {len(rep.pending)} requeued, "
+                     f"{len(rep.terminal)} terminal, "
+                     f"{rep.dropped_lines} torn/dropped lines")
+
+        # ----- worker pool
+        self._cfg_path = None
+        if not stub:
+            fd, self._cfg_path = tempfile.mkstemp(prefix="dragg_serve_",
+                                                  suffix=".json",
+                                                  dir=serve_dir)
+            with os.fdopen(fd, "w") as f:
+                json.dump(self.config, f)
+        # Claim the spool: orphan workers of a predecessor daemon exit
+        # when the EPOCH token stops matching theirs (worker fencing).
+        self.epoch = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        spool.write_epoch(self.spool_dir, self.epoch)
+        self.slots = [WorkerSlot(self.spool_dir, i, cfg_path=self._cfg_path,
+                                 stub=stub, poll_s=float(self.scfg["poll_s"]),
+                                 epoch=self.epoch, log=self.log)
+                      for i in range(max(1, int(self.scfg["workers"])))]
+        self.in_flight: dict[int, dict] = {}  # slot -> batch record
+        self._kill_ctx: dict[int, dict] = {}  # slot -> how the daemon killed it
+        self.batch_seq = 0
+        # Resolved serving platform.  None = a probe verdict is owed —
+        # launches park until the dispatch loop's UNLOCKED probe phase
+        # applies one (the probe can block up to probe_timeout_s; it must
+        # never run under the daemon lock or /healthz freezes with it).
+        self.mode: str | None = "cpu" if platform == "cpu" else None
+        self._probe_failure: str | None = None  # precipitating worker failure
+        self.backoff_until = 0.0
+        self.consec_failures = 0
+        self.started_at = time.monotonic()
+        self.draining = False
+        self.stop_event = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._httpd = None
+        self.host = host or str(self.scfg["host"])
+        self.port = port if port is not None else int(self.scfg["port"])
+        n = int(self.config["community"]["total_number_homes"])
+        self.n_homes = n if not stub else max(n, 1)
+        self.batch_max = int(self.scfg["batch_max"]) or self.n_homes
+
+    # ------------------------------------------------------------ admission
+    def _normalize_request(self, req: dict) -> tuple[dict | None, str | None]:
+        """Validate and coerce one request BEFORE the durability point —
+        a malformed field must answer 400, never reach the journal (a
+        poisoned 'accepted' record would crash every later replay: the
+        one bad POST that bricks restarts)."""
+        if not isinstance(req, dict):
+            return None, "request body must be a JSON object"
+        out = dict(req)
+        try:
+            out["home"] = int(req.get("home", 0))
+        except (TypeError, ValueError):
+            return None, f"home must be an integer, got {req.get('home')!r}"
+        if not 0 <= out["home"] < self.n_homes:
+            return None, (f"home {out['home']} outside the serving "
+                          f"community [0, {self.n_homes})")
+        for field, cast, default in (("t", int, 0), ("rp", float, 0.0)):
+            raw = req.get(field)
+            try:
+                out[field] = default if raw is None else cast(raw)
+            except (TypeError, ValueError):
+                return None, f"{field} must be a number, got {raw!r}"
+        if req.get("deadline_s") is not None:
+            try:
+                out["deadline_s"] = float(req["deadline_s"])
+            except (TypeError, ValueError):
+                return None, (f"deadline_s must be a number, got "
+                              f"{req.get('deadline_s')!r}")
+        state = req.get("state")
+        if state is not None:
+            if not isinstance(state, dict):
+                return None, "state must be an object of scalar overrides"
+            try:
+                out["state"] = {k: float(v) for k, v in state.items()
+                                if v is not None}
+            except (TypeError, ValueError):
+                return None, f"state overrides must be numbers: {state!r}"
+        return out, None
+
+    def _entry(self, rid: str, req: dict, now: float,
+               replayed: bool = False) -> dict:
+        try:
+            deadline_s = float(req.get("deadline_s")
+                               or self.scfg["request_deadline_s"])
+        except (TypeError, ValueError):
+            # Replayed record from an older/hand-edited journal: serve it
+            # under the default deadline rather than refuse to start.
+            deadline_s = float(self.scfg["request_deadline_s"])
+        return {"id": rid, "req": req, "accepted_mono": now,
+                "deadline_mono": now + deadline_s, "retries": 0,
+                "replayed": replayed, "last_failure": None}
+
+    def accept(self, req: dict) -> tuple[int, dict]:
+        """Admission control for one request.  Returns (http_status, body);
+        202 = journaled (durable), 200 = idempotent replay of a known id,
+        429 = backpressure (queue full / probe says no), 503 = draining."""
+        with self.lock:
+            if self.draining:
+                return 503, {"error": "draining", "retry_after_s": None}
+            req, bad = self._normalize_request(req)
+            if bad is not None:
+                return 400, {"error": bad}
+            rid = str(req.get("id") or uuid.uuid4().hex)
+            known = self.results.get(rid)
+            if known is not None:
+                return 200, self._result_body(rid, known)
+            if self.journal.is_terminal(rid):
+                # Answered in a previous life / beyond the results-cache
+                # window: the journal holds the answer of record — refuse
+                # upfront rather than re-solve work it would refuse to
+                # record.
+                return 200, self._evicted_body(rid)
+            if rid in self.pending or rid in self.assigned:
+                return 202, {"id": rid, "status": "pending"}
+            if self.mode is None and self.platform_req == "tpu" \
+                    and not bool(self.scfg["degrade_to_cpu"]):
+                # Strict-TPU service with a dead tunnel: admitting would
+                # queue doomed work — push back with the probe cadence.
+                retry = max(1.0, self.backoff_until - time.monotonic())
+                telemetry.inc("serve.requests_rejected", 1)
+                telemetry.emit("serve.reject", id=rid, reason="probe_down",
+                               retry_after_s=round(retry, 1))
+                return 429, {"error": "accelerator unavailable "
+                                      "(probe-gated admission)",
+                             "retry_after_s": round(retry, 1)}
+            depth = len(self.pending) + len(self.assigned)
+            if depth >= int(self.scfg["queue_max"]):
+                retry = float(self.scfg["retry_after_s"])
+                telemetry.inc("serve.requests_rejected", 1)
+                telemetry.emit("serve.reject", id=rid, reason="queue_full",
+                               retry_after_s=retry)
+                return 429, {"error": "queue full",
+                             "retry_after_s": retry}
+            home = req["home"]  # normalized + range-checked above
+            req = dict(req, id=rid)
+            self.journal.accepted(rid, req)       # durability point (fsync)
+            self.pending[rid] = self._entry(rid, req, time.monotonic())
+            telemetry.emit("serve.request", id=rid,
+                           timestep=req.get("t", 0), home=home)
+            telemetry.set_gauge("serve.queue_depth", depth + 1)
+            return 202, {"id": rid, "status": "accepted"}
+
+    def _result_body(self, rid: str, rec: dict) -> dict:
+        if rec.get("state") == journal_mod.DONE:
+            return {"id": rid, "status": "done",
+                    "response": rec.get("response")}
+        return {"id": rid, "status": "failed", "reason": rec.get("reason")}
+
+    def _evicted_body(self, rid: str) -> dict:
+        # The verdict of record survives eviction: a terminally-FAILED
+        # id must never be reported done just because its record left
+        # the bounded cache.
+        state = self.journal.terminal_state(rid) or journal_mod.DONE
+        return {"id": rid, "status": state, "evicted": True,
+                "note": "terminal previously; the record left the "
+                        "results cache (the journal retains it)"}
+
+    def result(self, rid: str) -> tuple[int, dict]:
+        with self.lock:
+            rec = self.results.get(rid)
+            if rec is not None:
+                return 200, self._result_body(rid, rec)
+            if rid in self.pending or rid in self.assigned:
+                return 200, {"id": rid, "status": "pending"}
+            if self.journal.is_terminal(rid):
+                return 200, self._evicted_body(rid)
+            return 404, {"error": f"unknown request id {rid!r}"}
+
+    # ------------------------------------------------- platform / degrade
+    def _apply_probe(self, report) -> None:
+        """Fold one classified probe verdict into the serving mode.
+        The probe itself ran OUTSIDE the lock (dispatch loop); only this
+        fold runs under it."""
+        self.log(f"probe: {'LIVE' if report.alive else report.kind} "
+                 f"{report.detail}")
+        failure = self._probe_failure
+        self._probe_failure = None
+        if report.alive:
+            self.mode = "tpu"
+            return
+        if self.platform_req == "tpu" and not bool(self.scfg["degrade_to_cpu"]):
+            self.mode = None  # stay unready; admission answers 429
+            self.backoff_until = time.monotonic() + self._backoff_s()
+            return
+        self._degrade(failure or report.kind or "TUNNEL_DOWN")
+
+    def _degrade(self, failure: str, batch: int | None = None) -> None:
+        """Flip to degraded-CPU serving; journaled so a restarted daemon
+        keeps reporting the transition's provenance."""
+        if self.mode == "cpu":
+            return
+        self.mode = "cpu"
+        self.transition = {"state": journal_mod.TRANSITION, "from": "tpu",
+                           "to": "cpu", "failure": failure, "batch": batch}
+        self.journal.transition("tpu", "cpu", failure, batch)
+        telemetry.emit("degrade.transition", from_platform="tpu",
+                       to_platform="cpu", failure=failure)
+        self.log(f"DEGRADED to CPU serving (failure={failure})")
+
+    def _provenance(self) -> dict | None:
+        if self.transition is None:
+            return None
+        return {"from": self.transition.get("from"),
+                "to": self.transition.get("to"),
+                "failure": self.transition.get("failure")}
+
+    # ------------------------------------------------------- dispatch loop
+    def _tick(self) -> None:
+        with self.lock:
+            now = time.monotonic()
+            self._expire_pending(now)
+            for slot in self.slots:
+                self._tick_slot(slot, now)
+            for slot in self.slots:
+                if (slot.alive() and slot.ready()
+                        and slot.slot not in self.in_flight):
+                    self._dispatch(slot, now)
+            telemetry.set_gauge("serve.queue_depth",
+                                len(self.pending) + len(self.assigned))
+            probe_due = (self.mode is None and self.platform_req != "cpu"
+                         and not self.draining and now >= self.backoff_until)
+        if probe_due:
+            # The probe can block up to probe_timeout_s (subprocess jax
+            # backend init) — run it with the lock RELEASED so /healthz,
+            # /result, and admission stay responsive while it decides.
+            report = liveness.check_liveness(
+                float(self.scfg["probe_timeout_s"]))
+            with self.lock:
+                if self.mode is None:
+                    self._apply_probe(report)
+
+    def _expire_pending(self, now: float) -> None:
+        for rid in [r for r, e in self.pending.items()
+                    if e["deadline_mono"] < now]:
+            entry = self.pending.pop(rid)
+            self._fail(entry, "request deadline expired before service")
+
+    def _remember_result(self, rid: str, rec: dict) -> None:
+        """Cache one terminal record, evicting oldest past the cap (the
+        journal keeps the unbounded history; this is the /result and
+        duplicate-POST lookup window)."""
+        self.results[rid] = rec
+        while len(self.results) > self._results_cap:
+            self.results.pop(next(iter(self.results)))
+
+    def _fail(self, entry: dict, reason: str) -> None:
+        rid = entry["id"]
+        if self.journal.failed(rid, reason):
+            self._remember_result(rid, {"state": journal_mod.FAILED,
+                                        "id": rid, "reason": reason})
+            telemetry.inc("serve.requests_failed", 1)
+            telemetry.emit("serve.failed", id=rid, reason=reason,
+                           retries=entry["retries"])
+
+    def _tick_slot(self, slot: WorkerSlot, now: float) -> None:
+        if slot.proc is None or not slot.alive():
+            if slot.proc is not None:
+                self._handle_death(slot)
+            self._maybe_launch(slot, now)
+            return
+        # Harvest answers first — also the late answers of a batch whose
+        # deadline is about to land.
+        self._process_outbox(slot)
+        stall_s = float(self.scfg["worker_stall_s"]) or None
+        fl = self.in_flight.get(slot.slot)
+        age = slot.heartbeat_age()
+        if fl is not None and fl["deadline_mono"] < now:
+            stalled = bool(stall_s and age is not None and age > stall_s)
+            self._kill_ctx[slot.slot] = {"timed_out": True,
+                                         "stalled": stalled}
+            slot.kill()
+            self._handle_death(slot)
+            return
+        if stall_s and age is not None and age > stall_s:
+            # Stopped making progress (hung compile / hung solve) — kill
+            # before the abandoned work can wedge the tunnel (round 4).
+            self._kill_ctx[slot.slot] = {"timed_out": False, "stalled": True}
+            slot.kill()
+            self._handle_death(slot)
+            return
+        report = slot.ready()
+        if report is not None and slot.gen > getattr(slot, "_announced", 0):
+            slot._announced = slot.gen
+            compile_rep = report.get("compile") or {}
+            telemetry.emit("serve.worker.ready", slot=slot.slot,
+                           gen=slot.gen, platform=report.get("platform"),
+                           warmup_s=report.get("warmup_s"),
+                           cache=compile_rep.get("cache"))
+            self.consec_failures = 0
+
+    def _maybe_launch(self, slot: WorkerSlot, now: float) -> None:
+        # mode None = a probe verdict is owed; the tick's unlocked probe
+        # phase supplies it — launches park here until then.
+        if self.draining or now < self.backoff_until or self.mode is None:
+            return
+        slot.launch(self.mode)
+
+    def _backoff_s(self) -> float:
+        base = float(self.scfg["backoff_s"])
+        return min(_BACKOFF_CAP_S, base * (2 ** max(0, self.consec_failures - 1)))
+
+    def _handle_death(self, slot: WorkerSlot) -> None:
+        ctx = self._kill_ctx.pop(slot.slot, {})
+        rc = slot.proc.poll() if slot.proc is not None else None
+        if rc == 0 and self.draining and not ctx:
+            # Clean drain exit (the worker saw STOP and finished) — not a
+            # failure; harvest any final answers and retire the slot.
+            self._process_outbox(slot)
+            slot.proc = None
+            return
+        kind = slot.verdict(timed_out=ctx.get("timed_out", False),
+                            stalled=ctx.get("stalled", False))
+        telemetry.emit("failure." + kind,  # telemetry-name-ok: kind from taxonomy.FAILURE_KINDS, each registered literally
+                       source="serve", label=f"w{slot.slot} gen={slot.gen}",
+                       rc=rc)
+        telemetry.emit("serve.worker.exit", slot=slot.slot, gen=slot.gen,
+                       rc=rc, failure=kind, ready=slot.ready() is not None)
+        self.log(f"worker w{slot.slot} gen={slot.gen} died: {kind} (rc={rc})")
+        # Late answers beat requeue: a response fsync'd before the death
+        # is an answer of record, never work to redo.
+        self._process_outbox(slot)
+        slot.clear_inbox()
+        fl = self.in_flight.pop(slot.slot, None)
+        if fl:
+            for rid in fl["ids"]:
+                entry = self.assigned.pop(rid, None)
+                if entry is None:
+                    continue  # answered by the late-outbox harvest
+                entry["retries"] += 1
+                entry["last_failure"] = kind
+                telemetry.inc("serve.request_retries", 1)
+                if entry["retries"] > int(self.scfg["request_retries"]):
+                    self._fail(entry,
+                               f"retries exhausted (last failure: {kind})")
+                else:
+                    self.pending[entry["id"]] = entry
+        slot.proc = None
+        self.consec_failures += 1
+        self.backoff_until = time.monotonic() + self._backoff_s()
+        # Device-path failures on the TPU mode re-probe before relaunch
+        # (a dead tunnel must degrade instead of relaunching into the
+        # wedge) — but the probe blocks, so park the mode and let the
+        # tick's unlocked probe phase deliver the verdict.
+        if self.mode == "tpu":
+            self.mode = None
+            self._probe_failure = kind
+
+    def _process_outbox(self, slot: WorkerSlot) -> None:
+        for seq, path in spool.list_batches(slot.outbox()):
+            payload = spool.read_json(path)
+            if payload is None:
+                continue
+            responses = payload.get("responses") or {}
+            platform = payload.get("platform", "?")
+            if payload.get("elapsed_s") is not None:
+                telemetry.observe("serve.batch_s",
+                                  float(payload["elapsed_s"]))
+            now = time.monotonic()
+            for rid, resp in responses.items():
+                entry = (self.assigned.pop(rid, None)
+                         or self.pending.pop(rid, None))
+                record = {"platform": platform, "batch": seq,
+                          "slot": slot.slot, "gen": payload.get("gen"),
+                          "retries": entry["retries"] if entry else None,
+                          **resp}
+                degraded = self._provenance()
+                if degraded is not None:
+                    record["degraded"] = degraded
+                if self.journal.done(rid, record):
+                    self._remember_result(rid, {"state": journal_mod.DONE,
+                                                "id": rid,
+                                                "response": record})
+                    telemetry.inc("serve.requests_done", 1)
+                    telemetry.emit("serve.done", id=rid, batch=seq,
+                                   platform=platform,
+                                   degraded=degraded is not None)
+                    if entry is not None:
+                        telemetry.observe("serve.request_latency_s",
+                                          now - entry["accepted_mono"])
+                elif rid not in self.results:
+                    # The journal refused: this id was answered in an
+                    # earlier life and evicted from the cache since — a
+                    # duplicate that slipped past admission.  Record a
+                    # terminal marker (never the new answer: the first
+                    # answer of record stands).
+                    self._remember_result(
+                        rid, {"state": journal_mod.FAILED, "id": rid,
+                              "reason": "duplicate of an id already "
+                                        "answered (evicted from the "
+                                        "results cache)"})
+            fl = self.in_flight.get(slot.slot)
+            if fl is not None and fl["batch"] == seq:
+                del self.in_flight[slot.slot]
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    @staticmethod
+    def _req_key(req: dict) -> tuple[int, float, int]:
+        """(t, rp, home) with defensive coercion: admission normalizes
+        these, but replayed records from older or hand-edited journals
+        must degrade to defaults, never poison the dispatch loop."""
+        def _num(v, cast, default):
+            try:
+                return cast(v if v is not None else default)
+            except (TypeError, ValueError):
+                return default
+        return (_num(req.get("t"), int, 0), _num(req.get("rp"), float, 0.0),
+                _num(req.get("home"), int, 0))
+
+    def _dispatch(self, slot: WorkerSlot, now: float) -> None:
+        if not self.pending:
+            return
+        # One batch = one (t, rp) group at the engine's fixed shape, at
+        # most one request per home slot (conflicting overrides for the
+        # same home wait for the next batch).
+        first = next(iter(self.pending.values()))
+        t, rp, _ = self._req_key(first["req"])
+        picked: dict[int, dict] = {}
+        for entry in list(self.pending.values()):
+            req = entry["req"]
+            rt, rrp, home = self._req_key(req)
+            if rt != t or rrp != rp:
+                continue
+            if home in picked:
+                continue
+            picked[home] = entry
+            if len(picked) >= self.batch_max:
+                break
+        if not picked:
+            return
+        self.batch_seq += 1
+        seq = self.batch_seq
+        ids = []
+        for entry in picked.values():
+            rid = entry["id"]
+            ids.append(rid)
+            self.assigned[rid] = self.pending.pop(rid)
+        batch = {"batch": seq, "t": t,
+                 "requests": [e["req"] for e in picked.values()]}
+        spool.atomic_write_json(
+            os.path.join(slot.inbox(), spool.batch_name(seq)), batch)
+        self.journal.assigned(ids, seq, slot.slot, slot.gen,
+                              slot.platform or "?")
+        self.in_flight[slot.slot] = {
+            "batch": seq, "ids": ids, "t": t,
+            "deadline_mono": now + float(self.scfg["batch_deadline_s"])}
+        telemetry.emit("serve.assign", batch=seq, slot=slot.slot,
+                       gen=slot.gen, n=len(ids), timestep=t)
+
+    # ------------------------------------------------------------- surface
+    def stats(self) -> dict:
+        with self.lock:
+            ready = [s.slot for s in self.slots
+                     if s.alive() and s.ready() is not None]
+            return {
+                "mode": self.mode, "draining": self.draining,
+                "uptime_s": round(time.monotonic() - self.started_at, 1),
+                "queue_depth": len(self.pending) + len(self.assigned),
+                "pending": len(self.pending), "assigned": len(self.assigned),
+                "results": len(self.results),
+                "workers_ready": ready,
+                "worker_gens": {s.slot: s.gen for s in self.slots},
+                "degraded": self._provenance(),
+                "batch_seq": self.batch_seq,
+            }
+
+    def ready_verdict(self) -> tuple[bool, str]:
+        with self.lock:
+            if self.draining:
+                return False, "draining"
+            if self.mode is None:
+                return False, "platform unresolved (probe-gated)"
+            if not any(s.alive() and s.ready() is not None
+                       for s in self.slots):
+                return False, "no warm worker"
+            if (len(self.pending) + len(self.assigned)
+                    >= int(self.scfg["queue_max"])):
+                return False, "queue full"
+            return True, "ok"
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        """Bind the HTTP surface and start the dispatch loop (threads)."""
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        http_t = threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.2),
+            name="serve-http", daemon=True)
+        disp_t = threading.Thread(target=self._loop, name="serve-dispatch",
+                                  daemon=True)
+        self._threads = [http_t, disp_t]
+        for t in self._threads:
+            t.start()
+        self.log(f"serving on http://{self.host}:{self.port} "
+                 f"(dir={self.serve_dir})")
+
+    def _loop(self) -> None:
+        tick_s = float(self.scfg["poll_s"])
+        while not self.stop_event.is_set():
+            try:
+                self._tick()
+            except Exception as e:  # the loop must survive anything
+                self.log(f"tick error: {e!r}")
+                telemetry.emit("serve.error", error=repr(e))
+            self.sleep(tick_s)
+
+    def drain(self, timeout_s: float | None = None) -> bool:
+        """Stop accepting, let in-flight + queued work finish.  Returns
+        True when the queue fully drained (False = timeout; the journal
+        carries the leftovers for the next start)."""
+        with self.lock:
+            self.draining = True
+        telemetry.emit("serve.drain", queue=len(self.pending)
+                       + len(self.assigned))
+        deadline = time.monotonic() + float(
+            timeout_s if timeout_s is not None else self.scfg["drain_s"])
+        while time.monotonic() < deadline:
+            with self.lock:
+                if not self.pending and not self.assigned:
+                    break
+            self.sleep(0.05)
+        # STOP after the queue empties (or times out): workers exit
+        # between batches; a mid-batch worker finishes first.
+        with open(spool.stop_path(self.spool_dir), "w") as f:
+            f.write("drain\n")
+        stop_deadline = time.monotonic() + 10.0
+        while time.monotonic() < stop_deadline:
+            if not any(s.alive() for s in self.slots):
+                break
+            self.sleep(0.05)
+        with self.lock:
+            return not self.pending and not self.assigned
+
+    def stop(self, drain: bool = True, timeout_s: float | None = None) -> bool:
+        drained = self.drain(timeout_s) if drain else False
+        with self.lock:
+            self.draining = True
+        self.stop_event.set()
+        for slot in self.slots:
+            slot.kill(grace_s=2.0)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self.journal.close()
+        if self._cfg_path:
+            try:
+                os.remove(self._cfg_path)
+            except OSError:
+                pass
+        telemetry.write_snapshot()
+        if self._owns_bus:
+            # Sequential in-process daemons (the soak's scenarios) each
+            # get their own stream; a bus this daemon merely joined
+            # (supervised CLI, $DRAGG_TELEMETRY_DIR) stays open.
+            telemetry.close_run()
+        return drained
+
+
+# ------------------------------------------------------------------ HTTP
+def _make_handler(daemon: ServeDaemon):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass  # the daemon's own log/telemetry carry the story
+
+        def _send(self, code: int, body: dict,
+                  retry_after: float | None = None) -> None:
+            data = json.dumps(body, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            if retry_after is not None:
+                self.send_header("Retry-After",
+                                 str(max(1, int(round(retry_after)))))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            parsed = urllib.parse.urlparse(self.path)
+            if parsed.path != "/solve":
+                self._send(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, OSError) as e:
+                self._send(400, {"error": f"bad request body: {e!r}"})
+                return
+            if isinstance(payload, list):
+                replies = [daemon.accept(r) for r in payload]
+                worst = max((code for code, _ in replies), default=200)
+                self._send(worst if worst >= 400 else 202,
+                           {"results": [b for _, b in replies]},
+                           retry_after=next(
+                               (b.get("retry_after_s") for c, b in replies
+                                if c == 429), None))
+                return
+            code, body = daemon.accept(payload)
+            self._send(code, body, retry_after=body.get("retry_after_s")
+                       if code in (429, 503) else None)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            parsed = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(parsed.query)
+            if parsed.path == "/result":
+                rid = (q.get("id") or [""])[0]
+                code, body = daemon.result(rid)
+                self._send(code, body)
+            elif parsed.path == "/healthz":
+                self._send(200, {"ok": True, "pid": os.getpid(),
+                                 **daemon.stats()})
+            elif parsed.path == "/readyz":
+                ready, reason = daemon.ready_verdict()
+                self._send(200 if ready else 503,
+                           {"ready": ready, "reason": reason})
+            elif parsed.path == "/metrics.json":
+                self._send(200, {"serve": daemon.stats(),
+                                 **telemetry.snapshot()})
+            elif parsed.path == "/events.jsonl":
+                limit = int((q.get("limit") or ["50"])[0])
+                path = telemetry.events_path()
+                events = (telemetry.tail_events(path, limit=limit)
+                          if path else [])
+                self._send(200, {"events": events})
+            else:
+                self._send(404, {"error": "not found"})
+
+    return Handler
+
+
+def run_serve(config: dict, serve_dir: str, *, platform: str = "auto",
+              host: str | None = None, port: int | None = None,
+              stub: bool = False, log=None) -> int:
+    """Blocking CLI entry (``python -m dragg_tpu serve``): run until
+    SIGTERM/SIGINT, then drain gracefully."""
+    import signal
+
+    daemon = ServeDaemon(config, serve_dir, platform=platform, host=host,
+                         port=port, stub=stub, log=log)
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    daemon.start()
+    while not stop.is_set():
+        stop.wait(0.5)
+    drained = daemon.stop(drain=True)
+    return 0 if drained else 1

@@ -1,0 +1,202 @@
+"""Crash-safe append-only request journal — stdlib only, parent-side.
+
+The serving daemon's durability contract (ISSUE 7): a request the daemon
+acknowledged (HTTP 202) must survive ANY process death — daemon crash,
+worker kill -9, power loss mid-write — and be answered exactly once
+after restart.  The reference kept this state in Redis hashes
+(dragg/aggregator.py:723-724, one pathos+Redis aggregator whose queue
+died with its process); here it is one fsync'd JSONL file, because the
+journal's readers are the same forensic tools that already speak the
+telemetry stream's line-JSON dialect.
+
+Record grammar (one JSON object per line, ``state`` discriminates):
+
+    {"state": "accepted",   "id": ..., "req": {...}}        durability point
+    {"state": "assigned",   "ids": [...], "batch": n,
+                            "slot": s, "gen": g, "platform": p}
+    {"state": "done",       "id": ..., "response": {...}}   terminal
+    {"state": "failed",     "id": ..., "reason": ...}       terminal
+    {"state": "transition", "from": ..., "to": ...,
+                            "failure": ..., "batch": n}     degradation mark
+
+Crash consistency is by construction, not recovery code:
+
+* every append is ``write + flush + fsync`` of ONE complete line before
+  the caller proceeds — an acknowledged request is on disk;
+* a torn final line (power loss mid-append) parses as garbage and is
+  DROPPED by :func:`replay`; since the write that tore never returned to
+  its caller, nothing observable is lost;
+* replay folds states per id: a request whose newest record is
+  ``accepted``/``assigned`` is *pending* (must be re-served); ``done``/
+  ``failed`` are terminal and idempotent — a second ``done`` for an id
+  is refused at append time, which is the "no request answered twice"
+  half of the soak invariant (tools/serve_soak.py).
+
+tests/test_serve.py's torn-write property test truncates a journal at
+every byte boundary and asserts replay stays consistent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import NamedTuple
+
+ACCEPTED = "accepted"
+ASSIGNED = "assigned"
+DONE = "done"
+FAILED = "failed"
+TRANSITION = "transition"
+
+TERMINAL = (DONE, FAILED)
+
+
+class ReplayState(NamedTuple):
+    """The fold of one journal file.
+
+    ``pending``  — id -> accepted record (newest state not terminal;
+                   re-serve these after a restart, in acceptance order);
+    ``terminal`` — id -> the done/failed record (answer duplicates and
+                   ``GET /result`` from here without re-solving);
+    ``transition`` — the newest platform-transition record, if any (a
+                   restarted daemon keeps reporting degradation
+                   provenance for requests accepted before the restart);
+    ``dropped_lines`` — unparseable lines skipped (a torn tail is 0 or 1;
+                   more means outside interference — surfaced, not fatal).
+    """
+
+    pending: dict
+    terminal: dict
+    transition: dict | None
+    dropped_lines: int
+
+
+class Journal:
+    """Append side.  One instance owns the file handle; every append is
+    fsync'd before returning (the whole point — see module docstring)."""
+
+    def __init__(self, path: str, fsync: bool = True,
+                 terminal_ids: set | None = None):
+        self.path = path
+        self._fsync = fsync
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # Terminal-state idempotency must hold across daemon restarts (a
+        # replayed "done" id refuses a second done even though this
+        # process never wrote the first).  Kept as id -> state so the
+        # verdict survives results-cache eviction (a FAILED id must
+        # never be reported done).  Callers that already folded the file
+        # (the daemon replays right before opening the append side) pass
+        # the terminal mapping in instead of paying a second scan;
+        # legacy set-shaped input maps to DONE-unknown.
+        if terminal_ids is None:
+            rep = replay(path)
+            self._terminal: dict = {rid: rec.get("state", DONE)
+                                    for rid, rec in rep.terminal.items()}
+        elif isinstance(terminal_ids, dict):
+            self._terminal = {rid: (rec.get("state", DONE)
+                                    if isinstance(rec, dict) else str(rec))
+                              for rid, rec in terminal_ids.items()}
+        else:
+            self._terminal = {rid: DONE for rid in terminal_ids}
+        self._fh = open(path, "a", encoding="utf-8")
+
+    # ------------------------------------------------------------- plumbing
+    def _append(self, rec: dict) -> None:
+        self._fh.write(json.dumps(rec, separators=(",", ":"),
+                                  default=str) + "\n")
+        self._fh.flush()
+        if self._fsync:
+            os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+    def is_terminal(self, req_id: str) -> bool:
+        """Whether this id already has an answer of record — the FULL
+        journal history, not a bounded cache.  Admission consults this so
+        a duplicate of a long-evicted id is refused upfront instead of
+        burning a solve the journal would refuse to record."""
+        return req_id in self._terminal
+
+    def terminal_state(self, req_id: str) -> str | None:
+        """``done`` / ``failed`` / None — the verdict of record, kept so
+        an id evicted from the daemon's results cache still reports WHAT
+        happened, not just THAT it happened."""
+        return self._terminal.get(req_id)
+
+    # ----------------------------------------------------------- lifecycle
+    def accepted(self, req_id: str, req: dict) -> None:
+        self._append({"state": ACCEPTED, "id": req_id, "req": req})
+
+    def assigned(self, ids: list[str], batch: int, slot: int, gen: int,
+                 platform: str) -> None:
+        self._append({"state": ASSIGNED, "ids": list(ids), "batch": batch,
+                      "slot": slot, "gen": gen, "platform": platform})
+
+    def done(self, req_id: str, response: dict) -> bool:
+        """Record the answer.  Returns False (and writes nothing) when the
+        id is already terminal — the caller must not deliver twice."""
+        if req_id in self._terminal:
+            return False
+        self._terminal[req_id] = DONE
+        self._append({"state": DONE, "id": req_id, "response": response})
+        return True
+
+    def failed(self, req_id: str, reason: str) -> bool:
+        if req_id in self._terminal:
+            return False
+        self._terminal[req_id] = FAILED
+        self._append({"state": FAILED, "id": req_id, "reason": reason})
+        return True
+
+    def transition(self, from_platform: str, to_platform: str,
+                   failure: str | None, batch: int | None) -> None:
+        self._append({"state": TRANSITION, "from": from_platform,
+                      "to": to_platform, "failure": failure, "batch": batch})
+
+
+def replay(path: str) -> ReplayState:
+    """Fold a journal file into :class:`ReplayState` (module docstring).
+    Never raises on file content: torn/garbage lines are counted and
+    skipped, unknown states ignored (forward compatibility)."""
+    pending: dict = {}
+    terminal: dict = {}
+    transition: dict | None = None
+    dropped = 0
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            lines = f.read().split("\n")
+    except OSError:
+        return ReplayState({}, {}, None, 0)
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            dropped += 1
+            continue
+        if not isinstance(rec, dict):
+            dropped += 1
+            continue
+        state = rec.get("state")
+        if state == ACCEPTED and "id" in rec:
+            rid = rec["id"]
+            if rid not in terminal and rid not in pending:
+                pending[rid] = rec
+        elif state in TERMINAL and "id" in rec:
+            rid = rec["id"]
+            pending.pop(rid, None)
+            # First terminal record wins: a duplicate done (which Journal
+            # refuses to write, but a merged/hand-edited file could carry)
+            # must not change the answer of record.
+            terminal.setdefault(rid, rec)
+        elif state == TRANSITION:
+            transition = rec
+        elif state == ASSIGNED:
+            pass  # assignment is not a durability state: accepted covers it
+    return ReplayState(pending, terminal, transition, dropped)

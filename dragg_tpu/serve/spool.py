@@ -1,0 +1,131 @@
+"""Spool-directory wire protocol between the serving daemon and its
+workers — stdlib only, shared by both sides.
+
+The daemon's parent process is jax-free by contract (a wedged tunnel
+hangs ANY backend init — resilience.supervisor), so daemon↔worker
+communication cannot be an in-process queue, and pipes would couple the
+worker's liveness to the parent's read loop.  The spool is the same
+pattern the checkpoint layer already trusts: ATOMIC single-file renames
+on a local filesystem, so every message is observed whole or not at all,
+and a kill -9 at any instruction leaves a recoverable directory, never a
+half-parsed stream.
+
+Layout (one subdirectory per worker slot)::
+
+    <spool>/STOP                      global drain signal (workers exit
+                                      between batches when present)
+    <spool>/w<slot>/inbox/batch-<n>.json    daemon -> worker
+    <spool>/w<slot>/outbox/batch-<n>.json   worker -> daemon
+    <spool>/w<slot>/ready-<gen>.json        worker warm signal + compile
+                                            report (staged_compile's)
+
+Ordering contract for a batch: the worker writes the outbox response
+ATOMICALLY first, then unlinks the inbox file.  A crash between the two
+leaves both present — the daemon prefers the outbox answer and discards
+the inbox leftover, so a request is never re-solved when its answer
+already exists (half of the soak's answered-exactly-once invariant).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+STOP_FILE = "STOP"
+EPOCH_FILE = "EPOCH"  # current daemon's ownership token (orphan fencing)
+
+
+def slot_dir(spool: str, slot: int) -> str:
+    return os.path.join(spool, f"w{slot}")
+
+
+def inbox_dir(spool: str, slot: int) -> str:
+    return os.path.join(slot_dir(spool, slot), "inbox")
+
+
+def outbox_dir(spool: str, slot: int) -> str:
+    return os.path.join(slot_dir(spool, slot), "outbox")
+
+
+def ready_path(spool: str, slot: int, gen: int) -> str:
+    return os.path.join(slot_dir(spool, slot), f"ready-{gen}.json")
+
+
+def stop_path(spool: str) -> str:
+    return os.path.join(spool, STOP_FILE)
+
+
+def epoch_path(spool: str) -> str:
+    return os.path.join(spool, EPOCH_FILE)
+
+
+def write_epoch(spool: str, token: str) -> None:
+    """Claim the spool for one daemon instance.  A daemon that died
+    without cleanup (kill -9 of the parent) leaves its workers orphaned
+    and still scanning this spool; the successor writes a fresh token and
+    workers exit when the file no longer matches the token they were
+    launched with."""
+    os.makedirs(spool, exist_ok=True)
+    tmp = f"{epoch_path(spool)}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(token)
+    os.replace(tmp, epoch_path(spool))
+
+
+def read_epoch(spool: str) -> str | None:
+    try:
+        with open(epoch_path(spool), encoding="utf-8") as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def ensure_slot_dirs(spool: str, slot: int) -> None:
+    os.makedirs(inbox_dir(spool, slot), exist_ok=True)
+    os.makedirs(outbox_dir(spool, slot), exist_ok=True)
+
+
+def atomic_write_json(path: str, payload: dict) -> None:
+    """Write-then-rename so readers only ever see complete documents."""
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_json(path: str) -> dict | None:
+    """One parsed document, or None when absent / mid-rename / torn."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def batch_name(seq: int) -> str:
+    return f"batch-{seq}.json"
+
+
+def batch_seq(name: str) -> int | None:
+    if not (name.startswith("batch-") and name.endswith(".json")):
+        return None
+    try:
+        return int(name[len("batch-"):-len(".json")])
+    except ValueError:
+        return None
+
+
+def list_batches(directory: str) -> list[tuple[int, str]]:
+    """(seq, path) pairs of complete batch files, oldest seq first."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        seq = batch_seq(name)
+        if seq is not None:
+            out.append((seq, os.path.join(directory, name)))
+    return sorted(out)

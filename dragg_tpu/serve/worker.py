@@ -1,0 +1,259 @@
+"""Serving worker child: ``python -m dragg_tpu.serve.worker``.
+
+The ONLY process in a serving deployment that initializes a jax backend
+(daemon-parent contract: resilience.supervisor).  Lifecycle:
+
+1. enable the persistent compile cache, build the serving community's
+   engine from the staged JSON config, and compile its one-step chunk
+   program through :func:`telemetry.compile_obs.staged_compile` — so a
+   hang names its stage on the heartbeat, and the cache hit/miss verdict
+   lands in the ready report (the soak's warm-restart invariant reads
+   exactly this);
+2. write the ready file (``spool.ready_path``) carrying the compile
+   report and the actual backend platform;
+3. loop: claim inbox batches, solve them against the warm compiled
+   runner, write outbox responses atomically (response BEFORE inbox
+   unlink — spool module ordering contract), beating the heartbeat at
+   every progress boundary so the daemon's stall detector only fires on
+   a genuine hang;
+4. exit 0 when the spool's STOP file appears (graceful drain — the
+   in-flight batch finishes first).
+
+``--stub`` runs the same protocol with a deterministic arithmetic
+responder and NO jax import at all — the fast-tier daemon tests drive
+every parent-side code path in milliseconds with it.
+
+Chaos sites (``$DRAGG_FAULT_INJECT`` — resilience.faults): ``serve_boot``
+fires before the engine build, ``serve_batch`` before each batch solve,
+plus the ``compile_<stage>`` sites staged_compile already instruments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+from dragg_tpu.resilience.faults import fault_hook
+from dragg_tpu.resilience.heartbeat import beat
+from dragg_tpu.serve import spool
+
+
+class StubRunner:
+    """Deterministic jax-free responder: the protocol without the MPC.
+    Response fields mirror the engine runner's so parent-side consumers
+    cannot tell them apart structurally."""
+
+    platform = "stub"
+    n_homes = 1 << 20  # accept any home index the daemon admits
+
+    def solve(self, t: int, requests: list[dict]) -> dict:
+        out = {}
+        for req in requests:
+            home = int(req.get("home", 0))
+            st = req.get("state") or {}
+            out[req["id"]] = {
+                "p_grid": round(1.0 + 0.25 * home + 0.01 * t, 6),
+                "temp_in": float(st.get("temp_in", 20.0)),
+                "temp_wh": float(st.get("temp_wh", 46.0)),
+                "e_batt": float(st.get("e_batt", 0.0)),
+                "hvac_cool_on": 0.0, "hvac_heat_on": 0.5, "wh_heat_on": 0.5,
+                "cost": round(0.07 * (1.0 + 0.25 * home), 6),
+                "correct_solve": 1.0,
+            }
+        return out
+
+
+class EngineRunner:
+    """The real thing: a warm compiled one-step engine at the serving
+    community's shape, with per-request scalar-state overrides.
+
+    Requests are "batched into the existing bucket-pattern shapes"
+    literally: the engine solves its whole fixed community batch every
+    step (that IS the compiled shape), requested homes get their carried
+    scalars (temp_in / temp_wh / e_batt) overridden to the caller's
+    values, and only the requested homes' outputs are returned.  Engine
+    state ordering is community order for both the superset and the
+    bucketed path (bucket ranges are contiguous — engine.state_slice
+    precedent)."""
+
+    def __init__(self, config: dict):
+        import numpy as np
+
+        from dragg_tpu.data import load_environment, load_waterdraw_profiles
+        from dragg_tpu.engine import make_engine
+        from dragg_tpu.homes import build_home_batch, create_homes
+        from dragg_tpu.telemetry.compile_obs import staged_compile
+        from dragg_tpu.utils.compile_cache import enable_compile_cache
+
+        self._np = np
+        enable_compile_cache(config)
+        beat({"stage": "serve:build"})
+        seed = int(config["simulation"]["random_seed"])
+        env = load_environment(config)
+        dt = env.dt
+        hems = config["home"]["hems"]
+        waterdraw = load_waterdraw_profiles(None, seed=seed)
+        homes = create_homes(config, 24 * dt, dt, waterdraw)
+        batch = build_home_batch(homes, int(hems["prediction_horizon"]) * dt,
+                                 dt, int(hems["sub_subhourly_steps"]))
+        self.engine = make_engine(batch, env, config,
+                                  env.start_index(env.data_start))
+        self.n_homes = self.engine.true_n_homes
+        rps0 = np.zeros((1, self.engine.params.horizon), np.float32)
+        self._runner, _state, _outs, self.compile_report = staged_compile(
+            self.engine, self.engine.init_state(), 0, rps0, label="serve")
+        self._rps0 = rps0
+        # Host-side template of the initial carried state, plus the
+        # community-order ranges of each state leaf-tuple element (one
+        # range for the superset engine, one per bucket otherwise).
+        self._template = self.engine.init_state()
+        self._ranges = self._state_ranges()
+        import jax
+
+        self.platform = jax.default_backend()  # device-call-ok: serving worker is the supervised jax child
+
+    def _state_ranges(self) -> list[tuple[int, int]]:
+        if getattr(self.engine, "_bucketed", False):
+            return [(c.comm_start, c.n_real) for c in self.engine._buckets]
+        return [(0, self.n_homes)]
+
+    def _with_overrides(self, requests: list[dict]):
+        """The template state with each request's scalar overrides applied
+        at its home's slot (field missing from the request = keep the
+        engine's initial condition for that scalar)."""
+        import jax.numpy as jnp
+
+        np = self._np
+        # Bucketed engines carry a tuple of per-bucket CommunityStates;
+        # the superset engine carries ONE (itself a NamedTuple, so a bare
+        # isinstance-tuple check would shred it into its field arrays).
+        bucketed = getattr(self.engine, "_bucketed", False)
+        states = list(self._template) if bucketed else [self._template]
+        overridden = []
+        for (start, n_real), st in zip(self._ranges, states):
+            edits: dict[str, list] = {}
+            for req in requests:
+                home = int(req["home"])
+                if not start <= home < start + n_real:
+                    continue
+                for field in ("temp_in", "temp_wh", "e_batt"):
+                    val = (req.get("state") or {}).get(field)
+                    if val is not None:
+                        edits.setdefault(field, []).append(
+                            (home - start, float(val)))
+            if edits:
+                repl = {}
+                for field, pairs in edits.items():
+                    arr = np.asarray(getattr(st, field)).copy()
+                    for local, val in pairs:
+                        arr[local] = val
+                    repl[field] = jnp.asarray(arr, dtype=jnp.float32)
+                st = st._replace(**repl)
+            overridden.append(st)
+        return tuple(overridden) if bucketed else overridden[0]
+
+    def solve(self, t: int, requests: list[dict]) -> dict:
+        np = self._np
+        state = self._with_overrides(requests)
+        rp = float(requests[0].get("rp", 0.0)) if requests else 0.0
+        rps = self._rps0 + np.float32(rp)
+        _state_out, outs = self._runner(state, t, rps)
+        fields = {f: np.asarray(getattr(outs, f))[0]
+                  for f in ("p_grid", "temp_in", "temp_wh", "e_batt",
+                            "hvac_cool_on", "hvac_heat_on", "wh_heat_on",
+                            "cost", "correct_solve")}
+        return {req["id"]: {f: round(float(v[int(req["home"])]), 6)
+                            for f, v in fields.items()}
+                for req in requests}
+
+
+def serve_loop(runner, spool_dir: str, slot: int, gen: int,
+               poll_s: float, beat_every_s: float = 1.0,
+               epoch: str = "") -> int:
+    inbox = spool.inbox_dir(spool_dir, slot)
+    outbox = spool.outbox_dir(spool_dir, slot)
+    stop = spool.stop_path(spool_dir)
+    last_beat = 0.0
+    while True:
+        # Orphan fencing: a daemon that died abruptly leaves this worker
+        # running; the successor claims the spool with a fresh EPOCH
+        # token, and a worker whose launch token no longer matches must
+        # stand down instead of racing the new generation for batches.
+        if epoch and spool.read_epoch(spool_dir) != epoch:
+            beat({"stage": "serve:fenced", "gen": gen})
+            return 0
+        batches = spool.list_batches(inbox)
+        if not batches:
+            if os.path.exists(stop):
+                beat({"stage": "serve:drained", "gen": gen})
+                return 0
+            now = time.monotonic()
+            if now - last_beat >= beat_every_s:
+                beat({"stage": "serve:idle", "gen": gen})
+                last_beat = now
+            time.sleep(poll_s)
+            continue
+        for seq, path in batches:
+            payload = spool.read_json(path)
+            if payload is None:  # mid-rename; retry next scan
+                continue
+            beat({"stage": "serve:batch", "batch": seq, "gen": gen})
+            fault_hook("serve_batch")
+            t0 = time.perf_counter()
+            responses = runner.solve(int(payload.get("t", 0)),
+                                     payload.get("requests", []))
+            resp = {"batch": seq, "platform": runner.platform, "gen": gen,
+                    "elapsed_s": round(time.perf_counter() - t0, 4),
+                    "responses": responses}
+            # Response BEFORE inbox unlink (spool ordering contract): a
+            # crash between the two must leave the answer, not the work.
+            spool.atomic_write_json(
+                os.path.join(outbox, spool.batch_name(seq)), resp)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            beat({"stage": "serve:batch_done", "batch": seq, "gen": gen})
+            last_beat = time.monotonic()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spool", required=True)
+    ap.add_argument("--slot", type=int, default=0)
+    ap.add_argument("--gen", type=int, default=1)
+    ap.add_argument("--config", default=None, help="JSON config path")
+    ap.add_argument("--poll-s", type=float, default=0.05)
+    ap.add_argument("--epoch", default="",
+                    help="daemon ownership token; exit when the spool's "
+                         "EPOCH file stops matching (orphan fencing)")
+    ap.add_argument("--stub", action="store_true",
+                    help="deterministic jax-free responder (protocol tests)")
+    args = ap.parse_args()
+
+    beat({"stage": "serve:boot", "slot": args.slot, "gen": args.gen})
+    fault_hook("serve_boot")
+    t0 = time.perf_counter()
+    if args.stub:
+        runner = StubRunner()
+        report = {"stub": True}
+    else:
+        with open(args.config) as f:
+            config = json.load(f)
+        runner = EngineRunner(config)
+        report = runner.compile_report
+    spool.ensure_slot_dirs(args.spool, args.slot)
+    spool.atomic_write_json(
+        spool.ready_path(args.spool, args.slot, args.gen),
+        {"slot": args.slot, "gen": args.gen, "platform": runner.platform,
+         "warmup_s": round(time.perf_counter() - t0, 3),
+         "n_homes": runner.n_homes, "compile": report})
+    beat({"stage": "serve:ready", "slot": args.slot, "gen": args.gen})
+    return serve_loop(runner, args.spool, args.slot, args.gen, args.poll_s,
+                      epoch=args.epoch)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

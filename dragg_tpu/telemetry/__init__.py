@@ -40,6 +40,7 @@ from dragg_tpu.telemetry.bus import (
     set_gauge,
     snapshot,
     span,
+    tail_events,
     write_snapshot,
 )
 from dragg_tpu.telemetry.registry import EVENTS, METRICS
@@ -48,5 +49,5 @@ __all__ = [
     "ENV_DIR", "EVENTS_FILE", "METRICS_FILE", "EVENTS", "METRICS",
     "active", "close_run", "emit", "events_path", "inc", "init_run",
     "observe", "run_dir", "selftest", "set_gauge", "snapshot", "span",
-    "write_snapshot",
+    "tail_events", "write_snapshot",
 ]

@@ -281,6 +281,35 @@ class span:
         return False
 
 
+def tail_events(events_path: str, limit: int = 50,
+                tail_bytes: int = 262_144) -> list[dict]:
+    """Last ``limit`` parseable event records of an events.jsonl — reads
+    a bounded byte tail, so tailing a huge in-progress stream stays
+    O(limit) not O(run).  Torn/mid-write lines are skipped.  Shared by
+    the dashboard's ``/live`` surface and the serving daemon's
+    ``/events.jsonl`` endpoint (one tailer, one dialect)."""
+    try:
+        with open(events_path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - tail_bytes))
+            lines = f.read().decode("utf-8", "replace").splitlines()
+    except OSError:
+        return []
+    out: list[dict] = []
+    for line in reversed(lines):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue  # torn first line of the tail window / mid-write
+        if len(out) >= limit:
+            break
+    return list(reversed(out))
+
+
 # -------------------------------------------------------------- snapshots
 def snapshot() -> dict:
     """The current metrics registry as one JSON-able dict

@@ -64,6 +64,30 @@ EVENTS: dict[str, str] = {
                      "shape keys)",
     "compile.done": "a staged compile finished (label, total_s, cache = "
                     "hit|miss|unknown, stages = {name: s}, buckets)",
+    # Serving daemon (dragg_tpu/serve — ISSUE 7).  The request lifecycle
+    # mirrors the journal states (serve/journal.py), so the event stream
+    # and the fsync'd journal tell one story.
+    "serve.request": "serving daemon accepted + journaled one request "
+                     "(id, timestep, home)",
+    "serve.assign": "one batch dispatched to a worker slot (batch, slot, "
+                    "gen, n, timestep)",
+    "serve.done": "one request answered and journaled terminal (id, "
+                  "batch, platform, degraded)",
+    "serve.failed": "one request failed terminally (id, reason, retries)",
+    "serve.reject": "admission pushed back — 429 backpressure (id, "
+                    "reason = queue_full|probe_down, retry_after_s)",
+    "serve.replay": "journal replay at daemon start (requeued, terminal, "
+                    "dropped_lines)",
+    "serve.worker.launch": "worker slot launched a generation (slot, gen, "
+                           "pid, platform, stub)",
+    "serve.worker.ready": "a worker generation finished warmup (slot, "
+                          "gen, platform, warmup_s, cache = the staged-"
+                          "compile persistent-cache verdict)",
+    "serve.worker.exit": "a worker generation died (slot, gen, rc, "
+                         "failure = taxonomy kind, ready)",
+    "serve.drain": "graceful drain began (queue = outstanding requests)",
+    "serve.error": "serving dispatch loop survived an internal error "
+                   "(error)",
     # The resilience failure taxonomy as event types (one per kind in
     # taxonomy.FAILURE_KINDS; ``source`` says which layer classified it:
     # "probe" or "supervisor", ``detail``/``label`` locate it).
@@ -170,6 +194,24 @@ METRICS: dict[str, tuple[str, str]] = {
     "compile.stage_s": ("histogram",
                         "staged-compile stage wall seconds (stage name on "
                         "the paired compile.stage event)"),
+    # Serving daemon (dragg_tpu/serve — ISSUE 7).
+    "serve.queue_depth": ("gauge",
+                          "pending + assigned requests in the daemon"),
+    "serve.request_latency_s": ("histogram",
+                                "accept→answer wall seconds per request"),
+    "serve.batch_s": ("histogram",
+                      "worker-reported solve seconds per dispatched batch"),
+    "serve.requests_done": ("counter", "requests answered terminally"),
+    "serve.requests_failed": ("counter",
+                              "requests failed terminally (deadline / "
+                              "retries exhausted)"),
+    "serve.requests_rejected": ("counter",
+                                "admissions pushed back with 429"),
+    "serve.request_retries": ("counter",
+                              "request re-dispatches after worker deaths"),
+    "serve.worker_restarts": ("counter",
+                              "worker relaunches beyond each slot's first "
+                              "generation"),
 }
 
 

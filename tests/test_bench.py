@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -54,6 +56,7 @@ def test_bench_smoke_contract():
     assert result["mfu"] is None
 
 
+@pytest.mark.slow  # round-11 tier-1 budget trim: tier-1 keeps test_bench_smoke_contract (the child contract) and the resilience ladder tests; the dual-report ladder compiles two bench children
 def test_bench_probe_gated_ladder_dual_report(tmp_path):
     """The DRIVER path (no --smoke): every TPU attempt is gated on a
     hard-timeout classified tunnel probe (resilience.liveness), the
@@ -115,6 +118,7 @@ def test_validate_scale_smoke():
     assert result["comfort_violation_max"] <= 0.05
 
 
+@pytest.mark.slow  # round-11 tier-1 budget trim: tier-1 keeps the unsharded validate_scale smoke; the 8-device sharded variant doubles the compile
 def test_validate_scale_sharded_smoke():
     """--sharded mode (the row-5 topology the 100k instantiation and the
     on-chip runbook use) runs a capped-step chunk over the mesh and emits

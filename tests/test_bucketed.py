@@ -185,6 +185,7 @@ def test_bucketed_zero_blocks_are_exact(parity_runs):
     assert np.all(np.asarray(out_bkt.p_pv)[:, cols][:, no_pv] == 0.0)
 
 
+@pytest.mark.slow  # round-11 tier-1 budget trim: single-device bucketed parity + test_parallel's sharded-engine parity keep both axes covered; this is their cross product
 def test_bucketed_sharded_matches_superset_8dev_mesh(parity_runs):
     """The parity satellite's 8-device leg: bucketed + per-bucket shard
     padding on the conftest CPU mesh vs the single-device superset run.

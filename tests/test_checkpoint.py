@@ -220,6 +220,7 @@ def test_checkpoint_survives_preexisting_final_dir(tmp_path):
         assert len(d["p_grid_opt"]) == res.num_timesteps
 
 
+@pytest.mark.slow  # round-11 tier-1 budget trim: tier-1 keeps test_baseline_resume_bit_exact (same resume machinery); the rl_agg variant re-runs it with RL training on top
 def test_rl_agg_resume_bit_exact(tmp_path):
     from dragg_tpu.aggregator import Aggregator
 

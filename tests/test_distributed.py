@@ -26,6 +26,8 @@ import textwrap
 
 import numpy as np
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -176,6 +178,7 @@ _DRIVER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow  # round-11 tier-1 budget trim: the run-entry two-process test keeps the multi-process init covered; the rl_agg variant re-runs it with RL on top
 def test_distributed_rl_agg_two_process(tmp_path):
     """The RL-aggregator run mode (fused agent + community scan) over two
     processes: the chunk jit takes the engine constants as arguments
@@ -207,6 +210,7 @@ def test_distributed_rl_agg_two_process(tmp_path):
             "non-zero rank wrote agent telemetry"
 
 
+@pytest.mark.slow  # round-11 tier-1 budget trim: tier-1 keeps the two lighter 2-process entry tests (run entry, rl_agg); the bit-exact resume A/B runs four supervised child processes
 def test_distributed_checkpoint_resume_bit_exact(tmp_path):
     """Non-shared-FS pod resume: two processes checkpoint to SEPARATE
     outputs directories (each holding only its own state shard), the run is

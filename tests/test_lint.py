@@ -53,6 +53,35 @@ def test_device_discipline_scoping():
     assert lint._is_entry_point(os.path.join(ROOT, "bench.py"))
     assert lint._is_entry_point(os.path.join(ROOT, "tools", "x.py"))
     assert not lint._is_entry_point(os.path.join(ROOT, "dragg_tpu", "engine.py"))
+    # ISSUE 7: the serving subsystem is an entry-point scope too — its
+    # parent is the one process that must never touch a device bare.
+    assert lint._is_entry_point(
+        os.path.join(ROOT, "dragg_tpu", "serve", "daemon.py"))
+    assert lint._is_serve_scope(
+        os.path.join(ROOT, "dragg_tpu", "serve", "worker.py"))
+    assert not lint._is_serve_scope(
+        os.path.join(ROOT, "dragg_tpu", "engine.py"))
+
+
+def test_accept_loop_discipline():
+    """ISSUE 7 rule: serving-daemon accept loops must stay interruptible
+    — serve_forever() needs poll_interval=, raw socket accept() needs the
+    accept-timeout-ok marker."""
+    import ast
+
+    lint = _load_lint()
+    src = (
+        "httpd.serve_forever()\n"                                   # bad
+        "httpd.serve_forever(poll_interval=0.2)\n"                  # ok
+        "conn, addr = sock.accept()\n"                              # bad
+        "conn, addr = sock.accept()  "
+        "# accept-timeout-ok: settimeout(1.0) above\n"              # marked
+    )
+    problems = lint.check_accept_loop_discipline(
+        ast.parse(src), src.splitlines(), "dragg_tpu/serve/x.py")
+    assert len(problems) == 2, problems
+    assert any("serve_forever" in p and ":1:" in p for p in problems)
+    assert any("accept()" in p and ":3:" in p for p in problems)
 
 
 def test_telemetry_name_discipline(tmp_path):

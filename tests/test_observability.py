@@ -5,6 +5,8 @@ import os
 
 import numpy as np
 
+import pytest
+
 from dragg_tpu.aggregator import Aggregator
 from dragg_tpu.config import default_config
 
@@ -91,6 +93,7 @@ def test_reset_seed_changes_population(tmp_path):
     assert names1 != names2
 
 
+@pytest.mark.slow  # round-11 tier-1 budget trim: profiler-trace plumbing, not correctness; the phase timers stay covered by the bench smoke
 def test_profiler_trace_and_phase_times(tmp_path):
     """tpu.profile_dir wraps the second device chunk in a jax.profiler trace
     and Summary carries the wall-clock phase attribution (SURVEY §5.1)."""

@@ -342,6 +342,26 @@ def test_bench_trend_comparability_rules(tmp_path):
     assert any("bucketed" in n for n in row["notes"])
 
 
+def test_bench_trend_degraded_soft_key(tmp_path):
+    """A `degraded` artifact (supervised run fell back TPU→CPU
+    mid-flight — ISSUE 7 satellite) still pairs within its platform
+    series, annotated with the failure kind instead of gated on."""
+    arts = [
+        _bench_line(2.0, 0.50, 1),
+        _bench_line(1.9, 0.52, 2, fallback=True,
+                    degraded={"from": "tpu", "to": "cpu",
+                              "failure": "COMPILE_HANG",
+                              "transition_step": 48}),
+    ]
+    rc, trend = _trend(tmp_path, arts, extra=("--gate",))
+    assert rc == 0, trend                       # annotates, never poisons
+    assert len(trend["rows"]) == 1
+    notes = trend["rows"][0]["notes"]
+    assert any("degraded artifact" in n and "COMPILE_HANG" in n
+               and "step 48" in n for n in notes), notes
+    assert trend["rows"][0]["rate_verdict"] == "stable"
+
+
 def test_bench_trend_committed_series():
     """The committed BENCH_r01–r05 artifacts reproduce the known
     trajectory: the r02→r03 1000-home window improved, the r04→r05

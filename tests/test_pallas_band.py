@@ -176,6 +176,7 @@ def test_admm_band_pallas_matches_xla():
                                   np.asarray(sol_p.solved))
 
 
+@pytest.mark.slow  # round-11 tier-1 budget trim: single-device pallas parity tests keep the kernels covered; this is the mesh cross product
 def test_sharded_pallas_band_kernels(tiny_config):
     """band_kernel='pallas' on an 8-device mesh: the kernels run under
     shard_map over the homes axis and agree with the single-device XLA

@@ -1,0 +1,494 @@
+"""Serving daemon (dragg_tpu/serve) — fast-tier tests.
+
+Everything here runs with STUB workers (serve/worker.py --stub: the full
+spool protocol with a deterministic jax-free responder), so the daemon's
+parent-side machinery — journal durability, admission control,
+backpressure, retry/requeue after worker death, degradation provenance,
+drain, restart replay — is exercised in seconds.  The real-engine chaos
+paths (compile-cache survival across CHILD_CRASH) live in
+tests/test_serve_chaos.py (slow tier) and tools/serve_soak.py (the
+acceptance harness).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dragg_tpu.config import default_config
+from dragg_tpu.resilience import faults
+from dragg_tpu.serve.daemon import ServeDaemon, serve_config
+from dragg_tpu.serve.journal import Journal, replay
+
+
+# --------------------------------------------------------------- journal
+def test_journal_roundtrip(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = Journal(path)
+    j.accepted("a", {"id": "a", "t": 0, "home": 1})
+    j.accepted("b", {"id": "b", "t": 1, "home": 2})
+    j.assigned(["a", "b"], batch=1, slot=0, gen=1, platform="cpu")
+    assert j.done("a", {"p_grid": 1.0})
+    j.close()
+
+    rep = replay(path)
+    assert set(rep.pending) == {"b"}
+    assert rep.pending["b"]["req"]["home"] == 2
+    assert set(rep.terminal) == {"a"}
+    assert rep.terminal["a"]["response"]["p_grid"] == 1.0
+    assert rep.dropped_lines == 0
+
+
+def test_journal_refuses_double_answer(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = Journal(path)
+    j.accepted("a", {"id": "a"})
+    assert j.done("a", {"v": 1})
+    assert not j.done("a", {"v": 2})
+    assert not j.failed("a", "late failure")
+    j.close()
+    rep = replay(path)
+    assert rep.terminal["a"]["response"] == {"v": 1}
+
+    # The refusal survives a restart: a NEW journal on the same file must
+    # refuse too (terminal ids replayed into the dedup set).
+    j2 = Journal(path)
+    assert not j2.done("a", {"v": 3})
+    j2.close()
+    assert replay(path).terminal["a"]["response"] == {"v": 1}
+
+
+def test_journal_transition_record(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = Journal(path)
+    j.accepted("a", {"id": "a"})
+    j.transition("tpu", "cpu", "WEDGED", batch=3)
+    j.close()
+    rep = replay(path)
+    assert rep.transition["failure"] == "WEDGED"
+    assert rep.transition["from"] == "tpu"
+
+
+def test_journal_torn_write_property(tmp_path):
+    """The crash-consistency property test (ISSUE 7 satellite): truncate
+    the journal at EVERY byte boundary — replay must never raise, never
+    lose a request whose accepted record survived whole, and never
+    produce a duplicate id across pending/terminal."""
+    path = str(tmp_path / "journal.jsonl")
+    j = Journal(path)
+    j.accepted("a", {"id": "a", "home": 1})
+    j.accepted("b", {"id": "b", "home": 2})
+    j.assigned(["a", "b"], batch=1, slot=0, gen=1, platform="cpu")
+    j.done("a", {"p_grid": 1.5})
+    j.transition("tpu", "cpu", "TUNNEL_DOWN", batch=1)
+    j.accepted("c", {"id": "c", "home": 3})
+    j.failed("b", "retries exhausted")
+    j.close()
+    with open(path, "rb") as f:
+        blob = f.read()
+    # Byte offsets at which each record's trailing newline lands — a
+    # record is durable iff its newline is inside the truncated prefix.
+    line_ends = [i + 1 for i, ch in enumerate(blob) if ch == ord("\n")]
+    torn = str(tmp_path / "torn.jsonl")
+    for cut in range(len(blob) + 1):
+        with open(torn, "wb") as f:
+            f.write(blob[:cut])
+        rep = replay(torn)  # must not raise at any cut
+        whole_records = sum(1 for e in line_ends if e <= cut)
+        overlap = set(rep.pending) & set(rep.terminal)
+        assert not overlap, f"cut={cut}: duplicate ids {overlap}"
+        assert rep.dropped_lines <= 1, f"cut={cut}: >1 torn line"
+        # Durability: every fully-written accepted id is still known.
+        for n_whole, rid in ((1, "a"), (2, "b"), (6, "c")):
+            if whole_records >= n_whole:
+                assert rid in rep.pending or rid in rep.terminal, \
+                    f"cut={cut}: {rid} lost"
+        # Terminal-state monotonicity: once done/failed is durable the id
+        # must never replay as pending.
+        if whole_records >= 4:
+            assert "a" in rep.terminal
+        if whole_records >= 7:
+            assert "b" in rep.terminal
+        if whole_records >= 5:
+            assert (rep.transition or {}).get("failure") == "TUNNEL_DOWN"
+
+
+def test_journal_ignores_garbage_lines(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    with open(path, "w") as f:
+        f.write('{"state":"accepted","id":"a","req":{}}\n')
+        f.write("not json at all\n")
+        f.write('{"state":"done","id":"a","response":{}}\n')
+        f.write('{"half": "torn')
+    rep = replay(path)
+    assert set(rep.terminal) == {"a"}
+    assert not rep.pending
+    assert rep.dropped_lines == 2
+
+
+# ------------------------------------------------------------ HTTP helpers
+def _post(base: str, body) -> tuple[int, dict]:
+    req = urllib.request.Request(base + "/solve",
+                                 data=json.dumps(body).encode())
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base: str, path: str) -> tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _wait_terminal(base: str, ids, timeout_s: float = 30.0) -> dict:
+    outcomes = {}
+    deadline = time.monotonic() + timeout_s
+    remaining = set(ids)
+    while remaining and time.monotonic() < deadline:
+        for rid in list(remaining):
+            _code, body = _get(base, f"/result?id={rid}")
+            if body.get("status") in ("done", "failed"):
+                outcomes[rid] = body
+                remaining.discard(rid)
+        time.sleep(0.05)
+    assert not remaining, f"requests never terminated: {remaining}"
+    return outcomes
+
+
+def _serve_cfg(**overrides) -> dict:
+    cfg = default_config()
+    cfg["serve"].update({"port": 0, "poll_s": 0.02, "backoff_s": 0.1,
+                         "request_retries": 3, "batch_deadline_s": 30.0,
+                         "worker_stall_s": 30.0, "drain_s": 10.0,
+                         **overrides})
+    return cfg
+
+
+@pytest.fixture
+def stub_daemon_factory(tmp_path, monkeypatch):
+    """Build stub-worker daemons in tmp dirs; stops them at teardown and
+    keeps fault injection scoped to the test."""
+    daemons = []
+    monkeypatch.setenv("DRAGG_FAULT_STATE", str(tmp_path / "fault_state"))
+    os.makedirs(tmp_path / "fault_state", exist_ok=True)
+
+    def build(name="d", platform="cpu", faults_spec="", **cfg_overrides):
+        if faults_spec:
+            monkeypatch.setenv(faults.ENV, faults_spec)
+        else:
+            monkeypatch.delenv(faults.ENV, raising=False)
+        faults.reset_plan()
+        d = ServeDaemon(_serve_cfg(**cfg_overrides),
+                        str(tmp_path / name), platform=platform, stub=True)
+        d.start()
+        daemons.append(d)
+        return d, f"http://127.0.0.1:{d.port}"
+
+    yield build
+    for d in daemons:
+        try:
+            d.stop(drain=False)
+        except Exception:
+            pass
+    faults.reset_plan()
+
+
+# ------------------------------------------------------------ daemon paths
+def test_serve_config_defaults_and_overrides():
+    cfg = default_config()
+    scfg = serve_config(cfg)
+    assert scfg["workers"] == 1 and scfg["journal_fsync"] is True
+    cfg["serve"] = {"queue_max": 7}
+    assert serve_config(cfg)["queue_max"] == 7
+    assert serve_config(cfg)["workers"] == 1  # defaults still applied
+
+
+def test_end_to_end_accept_solve_result(stub_daemon_factory):
+    _d, base = stub_daemon_factory("e2e")
+    code, body = _post(base, {"id": "a", "t": 0, "home": 2})
+    assert code == 202 and body["status"] == "accepted"
+    code, body = _post(base, [{"id": "b", "t": 0, "home": 3},
+                              {"id": "c", "t": 2, "home": 2}])
+    assert code == 202
+    outcomes = _wait_terminal(base, ["a", "b", "c"])
+    assert all(o["status"] == "done" for o in outcomes.values())
+    # Stub responses are deterministic in (t, home).
+    assert outcomes["a"]["response"]["p_grid"] == 1.5
+    assert outcomes["c"]["response"]["p_grid"] == 1.52
+    # Idempotent duplicate: answered from the journal, not re-solved.
+    code, body = _post(base, {"id": "a"})
+    assert code == 200 and body["status"] == "done"
+    assert body["response"]["p_grid"] == 1.5
+    # Unknown id → 404; health/ready surface agree the service is up.
+    assert _get(base, "/result?id=nope")[0] == 404
+    assert _get(base, "/healthz")[0] == 200
+    assert _get(base, "/readyz")[0] == 200
+    code, metrics = _get(base, "/metrics.json")
+    assert code == 200 and metrics["serve"]["results"] == 3
+    assert metrics["counters"]["serve.requests_done"] == 3.0
+
+
+def test_backpressure_queue_full_answers_429(stub_daemon_factory):
+    # queue_max 2 and a worker that can't start (bad config would be
+    # slower — just flood before the stub warms up).
+    _d, base = stub_daemon_factory("bp", queue_max=2, retry_after_s=3.0)
+    codes = [_post(base, {"id": f"q{i}", "t": 9, "home": i})[0]
+             for i in range(6)]
+    assert 429 in codes, codes
+    # The 429 carried Retry-After.
+    req = urllib.request.Request(base + "/solve",
+                                 data=json.dumps({"id": "qq"}).encode())
+    try:
+        urllib.request.urlopen(req, timeout=10)
+    except urllib.error.HTTPError as e:
+        if e.code == 429:
+            assert int(e.headers["Retry-After"]) >= 1
+
+
+def test_invalid_home_rejected(stub_daemon_factory):
+    _d, base = stub_daemon_factory("bad")
+    code, body = _post(base, {"id": "x", "home": 10_000})
+    assert code == 400 and "outside the serving community" in body["error"]
+
+
+def test_malformed_fields_rejected_before_the_journal(stub_daemon_factory):
+    """Validation must happen BEFORE the durability point: a malformed
+    field answers 400 and leaves NO journal record (a poisoned accepted
+    record would crash every later replay — one bad POST must never
+    brick restarts) and never poisons the dispatch loop."""
+    d, base = stub_daemon_factory("malformed")
+    bad = [{"id": "b1", "home": 0, "deadline_s": "oops"},
+           {"id": "b2", "home": 0, "t": "x"},
+           {"id": "b3", "home": 0, "rp": []},
+           {"id": "b4", "home": "not-an-int"},
+           {"id": "b5", "home": 0, "state": "warm"},
+           {"id": "b6", "home": 0, "state": {"temp_in": "cold"}}]
+    for req in bad:
+        code, body = _post(base, req)
+        assert code == 400, (req, code, body)
+    # Nothing journaled; healthy requests still flow; a restart on the
+    # same dir starts clean.
+    jpath = os.path.join(d.serve_dir, "journal.jsonl")
+    assert not os.path.exists(jpath) or not open(jpath).read().strip()
+    assert _post(base, {"id": "ok", "t": 0, "home": 0})[0] == 202
+    assert _wait_terminal(base, ["ok"])["ok"]["status"] == "done"
+    d.stop(drain=False)
+    d2 = ServeDaemon(_serve_cfg(), d.serve_dir, platform="cpu", stub=True)
+    assert set(d2.results) == {"ok"}
+    d2.stop(drain=False)
+
+
+def test_worker_crash_requeues_and_serves(stub_daemon_factory):
+    """A worker that dies mid-stream (exit 17 at its 2nd batch) costs a
+    retry, not a request: the daemon requeues the in-flight batch to the
+    relaunched generation and every id still terminates done exactly
+    once."""
+    d, base = stub_daemon_factory(
+        "crash", faults_spec="exit@serve_batch:2:once")
+    ids = [f"c{i}" for i in range(6)]
+    for i, rid in enumerate(ids):
+        # Distinct t per pair forces several batches → batch 2 exists.
+        assert _post(base, {"id": rid, "t": i // 2, "home": i})[0] == 202
+    outcomes = _wait_terminal(base, ids)
+    assert all(o["status"] == "done" for o in outcomes.values())
+    assert d.slots[0].gen >= 2, "worker was never relaunched"
+    recs = [json.loads(line) for line in
+            open(os.path.join(d.serve_dir, "journal.jsonl"))]
+    done_ids = [r["id"] for r in recs if r["state"] == "done"]
+    assert sorted(done_ids) == sorted(ids)  # exactly once each
+    retried = [r for r in recs if r["state"] == "done"
+               and r["response"].get("retries", 0) > 0]
+    assert retried, "no request recorded a retry after the crash"
+
+
+def test_degraded_service_carries_provenance(stub_daemon_factory):
+    """probe says the tunnel is down → the service degrades to CPU at
+    startup and EVERY response carries the platform-transition record."""
+    d, base = stub_daemon_factory("deg", platform="auto",
+                                  faults_spec="probe_down:1")
+    ids = ["g0", "g1"]
+    for i, rid in enumerate(ids):
+        assert _post(base, {"id": rid, "t": 0, "home": i})[0] == 202
+    outcomes = _wait_terminal(base, ids)
+    for rid, o in outcomes.items():
+        deg = o["response"].get("degraded")
+        assert deg, f"{rid} answered without degradation provenance"
+        assert deg["failure"] == "TUNNEL_DOWN"
+        assert (deg["from"], deg["to"]) == ("tpu", "cpu")
+    assert d.transition is not None
+    # The transition is journaled → a restarted daemon keeps reporting it.
+    rep = replay(os.path.join(d.serve_dir, "journal.jsonl"))
+    assert rep.transition["failure"] == "TUNNEL_DOWN"
+
+
+def test_strict_tpu_answers_429_when_probe_says_no(stub_daemon_factory):
+    _d, base = stub_daemon_factory(
+        "strict", platform="tpu", faults_spec="probe_down,probe_down:50",
+        degrade_to_cpu=False)
+    time.sleep(0.3)  # let the dispatch loop resolve (and fail) the probe
+    code, body = _post(base, {"id": "s0", "home": 0})
+    assert code == 429 and body["retry_after_s"] >= 1
+    assert _get(base, "/readyz")[0] == 503
+
+
+def test_restart_replays_unfinished_requests(tmp_path):
+    """Daemon killed with journaled-but-unserved requests: the next
+    daemon on the same directory must serve them with no resubmission
+    (zero lost requests by construction)."""
+    sdir = str(tmp_path / "replay")
+    cfg = _serve_cfg()
+    d1 = ServeDaemon(cfg, sdir, platform="cpu", stub=True)
+    # No start(): requests are journaled but the dispatch loop never runs
+    # — the sharpest version of "accepted then died".
+    for i in range(3):
+        code, _body = d1.accept({"id": f"p{i}", "t": 0, "home": i})
+        assert code == 202
+    d1.stop(drain=False)
+
+    d2 = ServeDaemon(cfg, sdir, platform="cpu", stub=True)
+    d2.start()
+    try:
+        base = f"http://127.0.0.1:{d2.port}"
+        outcomes = _wait_terminal(base, [f"p{i}" for i in range(3)])
+        assert all(o["status"] == "done" for o in outcomes.values())
+    finally:
+        d2.stop(drain=False)
+
+
+def test_restart_ignores_stale_spool_and_fences_orphans(tmp_path):
+    """A successor daemon on the same serve dir must not trust the
+    predecessor's spool leftovers: stale ready/outbox files are dropped
+    at slot construction (a cold worker must not be reported warm, a
+    stale batch-1 answer must not collide with the new numbering), and
+    the EPOCH token flips so orphan workers stand down."""
+    from dragg_tpu.serve import spool as spool_mod
+
+    sdir = str(tmp_path / "restart")
+    cfg = _serve_cfg()
+    d1 = ServeDaemon(cfg, sdir, platform="cpu", stub=True)
+    d1.start()
+    base = f"http://127.0.0.1:{d1.port}"
+    assert _post(base, {"id": "s1", "t": 0, "home": 0})[0] == 202
+    _wait_terminal(base, ["s1"])
+    epoch1 = spool_mod.read_epoch(d1.spool_dir)
+    # Abrupt death: no drain, spool left with ready-1.json + a planted
+    # stale outbox answer for the successor's first batch number.
+    d1.stop(drain=False)
+    spool_mod.atomic_write_json(
+        os.path.join(spool_mod.outbox_dir(d1.spool_dir, 0),
+                     spool_mod.batch_name(1)),
+        {"batch": 1, "platform": "stub", "gen": 1,
+         "responses": {"ghost": {"p_grid": 0.0}}})
+
+    d2 = ServeDaemon(cfg, sdir, platform="cpu", stub=True)
+    try:
+        # Stale artifacts are gone before any worker runs, and the spool
+        # has a fresh ownership token.
+        assert d2.slots[0].ready() is None
+        assert spool_mod.list_batches(d2.slots[0].outbox()) == []
+        assert spool_mod.read_epoch(d2.spool_dir) != epoch1
+        d2.start()
+        base = f"http://127.0.0.1:{d2.port}"
+        assert _post(base, {"id": "s2", "t": 0, "home": 1})[0] == 202
+        outcomes = _wait_terminal(base, ["s2"])
+        assert outcomes["s2"]["status"] == "done"
+        assert "ghost" not in d2.results
+    finally:
+        d2.stop(drain=False)
+
+
+def test_evicted_duplicate_refused_without_resolve(stub_daemon_factory):
+    """An id answered long ago and evicted from the bounded results
+    cache must be refused at ADMISSION from the journal's terminal set —
+    an evicted marker, no re-solve, no second journal lifecycle."""
+    d, base = stub_daemon_factory("evict")
+    assert _post(base, {"id": "old", "t": 0, "home": 0})[0] == 202
+    _wait_terminal(base, ["old"])
+    with d.lock:
+        d.results.pop("old")  # simulate cache eviction past results_cache
+    code, body = _post(base, {"id": "old"})
+    assert code == 200 and body["status"] == "done" and body["evicted"]
+    code, body = _get(base, "/result?id=old")
+    assert code == 200 and body.get("evicted")
+    recs = [json.loads(line) for line in
+            open(os.path.join(d.serve_dir, "journal.jsonl"))]
+    assert [r["id"] for r in recs if r["state"] == "accepted"] == ["old"]
+    assert [r["id"] for r in recs if r["state"] == "done"] == ["old"]
+
+
+def test_drain_finishes_inflight_work(stub_daemon_factory):
+    d, base = stub_daemon_factory("drain")
+    ids = [f"dr{i}" for i in range(4)]
+    for i, rid in enumerate(ids):
+        assert _post(base, {"id": rid, "t": 0, "home": i})[0] == 202
+    assert d.stop(drain=True) is True
+    rep = replay(os.path.join(d.serve_dir, "journal.jsonl"))
+    assert set(rep.terminal) == set(ids) and not rep.pending
+    # Draining admission answers 503.
+    code, _ = d.accept({"id": "late"})
+    assert code == 503
+
+
+def test_request_deadline_expires_unserved_work(stub_daemon_factory):
+    """A request whose own deadline passes while queued fails terminally
+    with a deadline reason (never silently dropped)."""
+    d, base = stub_daemon_factory(
+        "ddl", faults_spec="hang@serve_batch:1:once",
+        worker_stall_s=0.0, batch_deadline_s=2.0)
+    code, _ = _post(base, {"id": "slow", "t": 0, "home": 0,
+                           "deadline_s": 900})
+    assert code == 202
+    # This one expires while the hung batch blocks the worker.
+    code, _ = _post(base, {"id": "fast", "t": 1, "home": 1,
+                           "deadline_s": 0.3})
+    assert code == 202
+    outcomes = _wait_terminal(base, ["slow", "fast"], timeout_s=40)
+    assert outcomes["fast"]["status"] == "failed"
+    assert "deadline" in outcomes["fast"]["reason"]
+    assert outcomes["slow"]["status"] == "done"  # retried after the kill
+    assert d.slots[0].gen >= 2
+
+
+def test_worker_pool_two_slots_share_the_queue(stub_daemon_factory):
+    d, base = stub_daemon_factory("pool2", workers=2)
+    ids = [f"w{i}" for i in range(8)]
+    for i, rid in enumerate(ids):
+        # Four distinct timesteps → at least four batches to spread.
+        assert _post(base, {"id": rid, "t": i % 4, "home": i})[0] == 202
+    outcomes = _wait_terminal(base, ids)
+    assert all(o["status"] == "done" for o in outcomes.values())
+    slots_used = {o["response"]["slot"] for o in outcomes.values()}
+    assert len(d.slots) == 2
+    assert slots_used <= {0, 1}
+
+
+def test_concurrent_submitters_all_terminate(stub_daemon_factory):
+    """Thread-per-client admission against one daemon: every id lands
+    exactly one terminal outcome (the lock discipline under the HTTP
+    thread pool)."""
+    _d, base = stub_daemon_factory("conc", queue_max=512)
+    ids = [f"t{i}" for i in range(24)]
+
+    def submit(chunk):
+        for rid in chunk:
+            _post(base, {"id": rid, "t": int(rid[1:]) % 3,
+                         "home": int(rid[1:]) % 6})
+    threads = [threading.Thread(target=submit, args=(ids[i::4],))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    outcomes = _wait_terminal(base, ids)
+    assert all(o["status"] == "done" for o in outcomes.values())

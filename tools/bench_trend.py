@@ -118,7 +118,7 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         return dict(source=source, ordinal=ordinal,
                     metric="metrics_snapshot", platform="?", solver="?",
                     semantics="?", data="?", bucketed=False,
-                    fallback=False,
+                    fallback=False, degraded=None,
                     value=float(gauges.get("bench.rate_ts_per_s", 0.0)),
                     solve_rate=gauges.get("engine.solve_rate"),
                     compile_s=None, phases=phases)
@@ -133,6 +133,7 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         data=rec.get("data", "synthetic"),
         bucketed=bool(rec.get("bucketed", False)),
         fallback=bool(rec.get("fallback", False)),
+        degraded=rec.get("degraded"),
         value=float(rec.get("value") or 0.0),
         solve_rate=rec.get("solve_rate"),
         compile_s=rec.get("compile_s"),
@@ -191,6 +192,23 @@ def build_trend(entries: list[dict], threshold: float) -> dict:
                     f"fallback artifact ({','.join(dg)}): the TPU→CPU "
                     f"ladder degraded — this side measured the fallback "
                     f"platform, not the requested one")
+            # `degraded` is a SOFT key like `bucketed`: a supervised run
+            # that fell back TPU→CPU mid-flight annotates its series
+            # (failure kind + where the TPU attempt died) instead of
+            # breaking comparability — the hard key already carries the
+            # executed platform.
+            for lbl, e in (("from", prev), ("to", cur)):
+                d = e.get("degraded")
+                if d:
+                    where = (f" at step {d['transition_step']}"
+                             if d.get("transition_step") is not None else
+                             f" in {d['transition_stage']}"
+                             if d.get("transition_stage") else "")
+                    notes.append(
+                        f"degraded artifact ({lbl}): mid-flight "
+                        f"{d.get('from', 'tpu')}→{d.get('to', 'cpu')} on "
+                        f"{d.get('failure')}{where} — annotating, not "
+                        f"gating")
             if prev["bucketed"] != cur["bucketed"]:
                 notes.append(
                     f"tpu.bucketed resolution changed "

@@ -8,7 +8,8 @@ what the stdlib can check:
 * every Python file parses (`check-ast` parity);
 * no unused imports (autoflake parity; `# noqa` opt-out honored);
 * no tabs in indentation, no trailing whitespace, newline at EOF;
-* device-call discipline in `tools/` and `bench.py` (round 6): no bare
+* device-call discipline in `tools/`, `bench.py`, and `dragg_tpu/serve/`
+  (round 6; serve added by ISSUE 7): no bare
   ``jax.devices()``/``jax.default_backend()``/``jax.local_devices()`` —
   a wedged tunnel hangs backend init, so device calls in entry points
   must run inside a supervised/probed child (dragg_tpu/resilience);
@@ -16,6 +17,12 @@ what the stdlib can check:
   ``# device-call-ok: <why>`` marker — and no un-deadlined
   ``subprocess.run/check_output/check_call/call`` (a child that can
   hang forever defeats the supervision; pass ``timeout=``);
+* accept-loop discipline in `dragg_tpu/serve/` (ISSUE 7): the serving
+  daemon must stay interruptible — ``serve_forever()`` needs an explicit
+  ``poll_interval=`` (the default blocks shutdown on a quiet socket
+  longer than the drain budget expects) and raw ``socket.accept()``
+  loops are disallowed unless the line carries
+  ``# accept-timeout-ok: <why>`` (a timeout is set on the socket);
 * telemetry-name discipline in `dragg_tpu/`, `tools/`, and `bench.py`
   (round 7): every ``telemetry.emit/span/observe/inc/set_gauge`` call
   must name an entry of the central registry
@@ -90,7 +97,45 @@ _DEVICE_MARKER = "# device-call-ok:"
 
 def _is_entry_point(path: str) -> bool:
     rel = os.path.relpath(path, ROOT)
-    return rel == "bench.py" or rel.startswith("tools" + os.sep)
+    return (rel == "bench.py" or rel.startswith("tools" + os.sep)
+            or _is_serve_scope(path))
+
+
+# Accept-loop discipline (ISSUE 7; see the module docstring bullet).
+_ACCEPT_MARKER = "# accept-timeout-ok:"
+
+
+def _is_serve_scope(path: str) -> bool:
+    rel = os.path.relpath(path, ROOT)
+    return rel.startswith(os.path.join("dragg_tpu", "serve") + os.sep)
+
+
+def check_accept_loop_discipline(tree, lines: list[str], rel: str) -> list[str]:
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if fn.attr == "serve_forever":
+            if not any(kw.arg == "poll_interval" for kw in node.keywords) \
+                    and _ACCEPT_MARKER not in line:
+                problems.append(
+                    f"{rel}:{node.lineno}: serve_forever() without "
+                    f"poll_interval= in the serving daemon — a quiet "
+                    f"socket must not outlive the drain budget; pass "
+                    f"poll_interval= or mark the line "
+                    f"'{_ACCEPT_MARKER} <why>'")
+        elif fn.attr == "accept" and not node.args and not node.keywords:
+            if _ACCEPT_MARKER not in line:
+                problems.append(
+                    f"{rel}:{node.lineno}: raw socket accept() in the "
+                    f"serving daemon — an un-timeouted accept loop cannot "
+                    f"drain; set a socket timeout and mark the line "
+                    f"'{_ACCEPT_MARKER} <why>'")
+    return problems
 
 
 # Telemetry-name discipline (round 7): emits in framework + entry-point
@@ -258,6 +303,8 @@ def check_file(path: str) -> list[str]:
         problems.append(f"{rel}:{lineno}: unused import '{name}'")
     if _is_entry_point(path):
         problems.extend(check_device_discipline(tree, lines, rel))
+    if _is_serve_scope(path):
+        problems.extend(check_accept_loop_discipline(tree, lines, rel))
     if _is_telemetry_scope(path):
         problems.extend(check_telemetry_names(tree, lines, rel))
     if _is_kkt_inv_scope(path):

@@ -50,6 +50,14 @@ step "test/smoke-bench" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   bash -c 'python bench.py --smoke | tee /tmp/bench_smoke.json &&
            python -c "import json; r=json.load(open(\"/tmp/bench_smoke.json\")); assert r[\"value\"]>0"'
 
+# --- job: serve-soak smoke (ISSUE 7): the serving daemon's chaos soak on
+#     the CPU mesh — all six taxonomy fault kinds plus kill -9 mid-batch;
+#     asserts zero lost / zero double-answered requests, degradation
+#     provenance, and warm-restart compile-cache reuse
+step "test/serve-soak-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  bash -c 'python tools/serve_soak.py --smoke | tee /tmp/serve_soak_smoke.json &&
+           python -c "import json; r=json.load(open(\"/tmp/serve_soak_smoke.json\")); assert r[\"ok\"], r[\"violations\"]"'
+
 # --- job: bench-trend gate (round 9): the committed BENCH_r*.json series
 #     must show no like-for-like regression (comparability rules per
 #     CLAUDE.md; tools/bench_trend.py docstring)

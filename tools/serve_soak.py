@@ -1,0 +1,472 @@
+#!/usr/bin/env python
+"""Deterministic chaos soak for the MPC serving daemon (ISSUE 7
+acceptance harness).
+
+Replays one deterministic request trace against a fresh in-process
+:class:`dragg_tpu.serve.ServeDaemon` per scenario, driving
+``$DRAGG_FAULT_INJECT`` through every failure kind of the resilience
+taxonomy plus an external kill -9 mid-batch and a full daemon restart,
+then asserts the serving invariants from the journal and the telemetry
+stream:
+
+* **no request lost** — every trace id reaches exactly one terminal
+  journal state (all ``done`` here: retries are sized to outlast every
+  injected fault);
+* **no request answered twice** — at most one ``done`` record per id in
+  the raw journal (the fsync'd journal is the delivery of record);
+* **degradation provenance** — every response journaled after a
+  platform transition carries the ``degraded`` record with the
+  precipitating failure kind;
+* **warm restart beats cold start** — after a CHILD_CRASH the
+  replacement worker's staged compile must NOT be a cache miss
+  (compile_obs hit/miss telemetry) and its warmup must undercut the
+  soak's one genuinely cold warmup.
+
+Scenario → taxonomy coverage: child_crash/kill9/midflight_degrade →
+CHILD_CRASH, vmem_oom → VMEM_OOM, compile_hang → COMPILE_HANG,
+deadline → DEADLINE, tunnel_down → TUNNEL_DOWN, wedge → WEDGED.
+
+Usage::
+
+    python tools/serve_soak.py --smoke            # CPU-mesh CI stage
+    python tools/serve_soak.py --homes 32 --trace-len 64
+    python tools/serve_soak.py --scenario kill9   # one scenario
+
+Prints a human transcript on stderr and exactly one JSON line on stdout
+(repo bench convention); exit 0 only when every invariant held.  The
+measured headline numbers (cold-request→first-action latency, sustained
+requests/s, restart-recovery seconds) go to ``docs/perf_notes.md`` per
+the repo convention.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import signal
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dragg_tpu import telemetry  # noqa: E402
+from dragg_tpu.config import default_config  # noqa: E402
+from dragg_tpu.resilience import faults  # noqa: E402
+from dragg_tpu.resilience.supervisor import assert_parent_has_no_jax  # noqa: E402
+from dragg_tpu.serve import ServeDaemon  # noqa: E402
+from dragg_tpu.serve import journal as journal_mod  # noqa: E402
+
+
+def _log(msg: str) -> None:
+    print(f"[serve_soak] {msg}", file=sys.stderr, flush=True)
+
+
+def _http(method: str, url: str, body=None, timeout: float = 10.0):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def make_trace(n_requests: int, n_homes: int, path: str) -> list[dict]:
+    """The deterministic replayed trace: ids r00.., timesteps cycling a
+    small window, homes cycling the community, a few state overrides."""
+    trace = []
+    for i in range(n_requests):
+        req = {"id": f"r{i:03d}", "t": i % 3, "home": i % n_homes}
+        if i % 4 == 0:
+            req["state"] = {"temp_in": 18.0 + (i % 5)}
+        trace.append(req)
+    with open(path, "w") as f:
+        for req in trace:
+            f.write(json.dumps(req) + "\n")
+    return trace
+
+
+def load_trace(path: str) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ------------------------------------------------------------- journal QA
+def journal_records(path: str) -> list[dict]:
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                pass
+    return recs
+
+
+def check_invariants(trace: list[dict], journal_path: str,
+                     expect_degraded: str | None,
+                     degraded_after_transition_only: bool) -> list[str]:
+    """The soak invariants, read from the journal of record.  Returns a
+    list of violation strings (empty = clean)."""
+    violations = []
+    recs = journal_records(journal_path)
+    trace_ids = [r["id"] for r in trace]
+    done_counts = {rid: 0 for rid in trace_ids}
+    failed = []
+    transition_seen = False
+    for rec in recs:
+        if rec.get("state") == journal_mod.DONE:
+            rid = rec.get("id")
+            if rid in done_counts:
+                done_counts[rid] += 1
+                deg = (rec.get("response") or {}).get("degraded")
+                if transition_seen or not degraded_after_transition_only:
+                    if expect_degraded and not deg:
+                        violations.append(
+                            f"{rid}: answered after degradation without "
+                            f"provenance")
+                    elif expect_degraded and deg.get("failure") != expect_degraded:
+                        violations.append(
+                            f"{rid}: degraded provenance names "
+                            f"{deg.get('failure')!r}, expected "
+                            f"{expect_degraded!r}")
+                elif deg:
+                    violations.append(
+                        f"{rid}: carries degradation provenance before any "
+                        f"transition")
+        elif rec.get("state") == journal_mod.FAILED \
+                and rec.get("id") in done_counts:
+            failed.append(rec)
+        elif rec.get("state") == journal_mod.TRANSITION:
+            transition_seen = True
+    for rid, n in done_counts.items():
+        if n == 0:
+            violations.append(f"{rid}: LOST — no terminal done record")
+        elif n > 1:
+            violations.append(f"{rid}: answered {n} times")
+    for rec in failed:
+        violations.append(f"{rec['id']}: failed terminally "
+                          f"({rec.get('reason')})")
+    if expect_degraded and not transition_seen:
+        violations.append(f"expected a {expect_degraded} degradation "
+                          f"transition; journal has none")
+    return violations
+
+
+def events_summary(serve_dir: str) -> dict:
+    """Fold the scenario's telemetry stream: failure kinds observed,
+    compile verdicts + worker lifecycle (the warm-restart evidence)."""
+    path = os.path.join(serve_dir, telemetry.EVENTS_FILE)
+    failures = []
+    compiles = []
+    ready = []
+    exits = []
+    for rec in telemetry.tail_events(path, limit=100000,
+                                     tail_bytes=1 << 26):
+        ev = rec.get("event", "")
+        if ev.startswith("failure.") and rec.get("source") == "serve":
+            failures.append(ev[len("failure."):])
+        elif ev == "compile.done":
+            compiles.append({"cache": rec.get("cache"),
+                             "total_s": rec.get("total_s"),
+                             "pid": rec.get("pid"),
+                             "t": rec.get("t")})
+        elif ev == "serve.worker.ready":
+            ready.append({"gen": rec.get("gen"), "mono": rec.get("mono"),
+                          "warmup_s": rec.get("warmup_s"),
+                          "cache": rec.get("cache")})
+        elif ev == "serve.worker.exit":
+            exits.append({"gen": rec.get("gen"), "mono": rec.get("mono"),
+                          "failure": rec.get("failure")})
+    return {"failures": failures, "compiles": compiles, "ready": ready,
+            "exits": exits}
+
+
+# --------------------------------------------------------------- scenario
+def run_scenario(name: str, *, root: str, base_cfg: dict, trace: list[dict],
+                 platform: str = "cpu", fault_spec: str = "",
+                 serve_overrides: dict | None = None,
+                 expect_failure: str | None = None,
+                 expect_degraded: str | None = None,
+                 degraded_after_transition_only: bool = False,
+                 kill9_on_inflight: bool = False,
+                 restart_daemon: bool = False,
+                 timeout_s: float = 420.0) -> dict:
+    sdir = os.path.join(root, name)
+    os.makedirs(sdir, exist_ok=True)
+    state_dir = os.path.join(sdir, "fault_state")
+    os.makedirs(state_dir, exist_ok=True)
+    os.environ[faults.ENV] = fault_spec
+    os.environ["DRAGG_FAULT_STATE"] = state_dir
+    faults.reset_plan()
+    cfg = copy.deepcopy(base_cfg)
+    cfg["serve"].update(serve_overrides or {})
+    _log(f"--- scenario {name}: platform={platform} "
+         f"faults={fault_spec or '(none)'}")
+    t0 = time.monotonic()
+    daemon = ServeDaemon(cfg, sdir, platform=platform, port=0, log=_log)
+    daemon.start()
+    base = f"http://127.0.0.1:{daemon.port}"
+    report: dict = {"name": name, "violations": []}
+    try:
+        t_submit = time.monotonic()
+        for req in trace:
+            code, body = _http("POST", base + "/solve", req)
+            if code not in (200, 202):
+                report["violations"].append(
+                    f"{req['id']}: POST /solve answered {code}: {body}")
+        if kill9_on_inflight:
+            # The injected hang freezes the worker mid-batch; the
+            # external SIGKILL is the literal kill -9 of the acceptance
+            # criterion (abrupt device-loss analog, no Python involved).
+            deadline = time.monotonic() + timeout_s
+            pid = None
+            while time.monotonic() < deadline:
+                with daemon.lock:
+                    if daemon.in_flight:
+                        slot = daemon.slots[
+                            next(iter(daemon.in_flight))]
+                        pid = slot.proc.pid if slot.proc else None
+                        break
+                time.sleep(0.05)
+            if pid is None:
+                report["violations"].append("kill9: no batch ever went "
+                                            "in-flight")
+            else:
+                time.sleep(0.5)  # let the worker reach the fault site
+                _log(f"kill -9 worker pid={pid} mid-batch")
+                os.kill(pid, signal.SIGKILL)
+        if restart_daemon:
+            # Abrupt stop with work outstanding: no drain, workers killed,
+            # journal left as-is.  The NEW daemon must replay and finish.
+            time.sleep(0.2)
+            daemon.stop(drain=False)
+            _log("daemon stopped abruptly with outstanding work; "
+                 "restarting on the same journal")
+            daemon = ServeDaemon(cfg, sdir, platform=platform, port=0,
+                                 log=_log)
+            daemon.start()
+            base = f"http://127.0.0.1:{daemon.port}"
+        # Wait for every trace id to reach a terminal state.
+        deadline = time.monotonic() + timeout_s
+        last_done_mono = None
+        outstanding = {r["id"] for r in trace}
+        while outstanding and time.monotonic() < deadline:
+            for rid in list(outstanding):
+                code, body = _http("GET", f"{base}/result?id={rid}")
+                if code == 200 and body.get("status") in ("done", "failed"):
+                    outstanding.discard(rid)
+                    last_done_mono = time.monotonic()
+            time.sleep(0.1)
+        if outstanding:
+            report["violations"].append(
+                f"timed out with {len(outstanding)} requests unterminated: "
+                f"{sorted(outstanding)[:5]}")
+        if last_done_mono is not None:
+            span = max(1e-6, last_done_mono - t_submit)
+            report["sustained_rps"] = round(len(trace) / span, 3)
+        code, body = _http("GET", base + "/healthz")
+        report["health"] = body if code == 200 else {"error": code}
+    finally:
+        daemon.stop(drain=True)
+        os.environ.pop(faults.ENV, None)
+        faults.reset_plan()
+    report["elapsed_s"] = round(time.monotonic() - t0, 1)
+    report["violations"] += check_invariants(
+        trace, os.path.join(sdir, "journal.jsonl"), expect_degraded,
+        degraded_after_transition_only)
+    ev = events_summary(sdir)
+    report["events"] = {k: ev[k] for k in ("failures", "ready")}
+    report["compiles"] = ev["compiles"]
+    if expect_failure and expect_failure not in ev["failures"]:
+        report["violations"].append(
+            f"expected a classified {expect_failure} worker failure; "
+            f"saw {ev['failures']}")
+    # Warm-restart evidence for the crash scenarios: a replacement worker
+    # must come up after the first death (a worker killed during warmup —
+    # the compile-hang scenario — never reported ready BEFORE dying, so
+    # key on exit/ready ordering, not on counting ready reports) and must
+    # not recompile (cache != miss).
+    if expect_failure:
+        if not ev["exits"]:
+            report["violations"].append(
+                "crash scenario recorded no worker exit")
+        else:
+            first_exit = min(e["mono"] for e in ev["exits"])
+            ready_after = [r for r in ev["ready"]
+                           if r["mono"] > first_exit]
+            if not ready_after:
+                report["violations"].append(
+                    "crash scenario never produced a replacement-worker "
+                    "ready report")
+            else:
+                last = ready_after[-1]
+                report["restart_cache"] = last.get("cache")
+                report["restart_warmup_s"] = last.get("warmup_s")
+                report["recovery_s"] = round(
+                    ready_after[0]["mono"] - first_exit, 2)
+                # "miss" is a violation only when a compile COMPLETED
+                # before the death (then the cache must hold the entry);
+                # a worker hung mid-compile persisted nothing, so its
+                # replacement legitimately compiles cold.  compile.done
+                # is emitted in the WORKER process (its mono is not
+                # comparable to the daemon's), so order by count: ≥2
+                # compiles means the dead generation finished one.
+                compiled_before = len(ev["compiles"]) >= 2
+                if last.get("cache") == "miss" and compiled_before:
+                    report["violations"].append(
+                        "replacement worker RECOMPILED (persistent-cache "
+                        "miss after restart)")
+    if ev["ready"]:
+        report["cold_ready_s"] = ev["ready"][0].get("warmup_s")
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized run: tiny community, short trace, all "
+                         "scenarios (the acceptance gate)")
+    ap.add_argument("--homes", type=int, default=None)
+    ap.add_argument("--horizon-hours", type=int, default=None)
+    ap.add_argument("--trace-len", type=int, default=None)
+    ap.add_argument("--trace", default=None,
+                    help="replay an existing JSONL request trace")
+    ap.add_argument("--scenario", default=None,
+                    help="run just one named scenario")
+    ap.add_argument("--root", default=None,
+                    help="soak working directory (default: a fresh "
+                         "/tmp/dragg_serve_soak_<pid>)")
+    ap.add_argument("--stub", action="store_true",
+                    help="stub workers (protocol-only; no jax, no "
+                         "compile-cache assertions)")
+    args = ap.parse_args(argv)
+
+    assert_parent_has_no_jax()
+    homes = args.homes if args.homes is not None else (6 if args.smoke else 32)
+    horizon = args.horizon_hours or (2 if args.smoke else 4)
+    trace_len = args.trace_len or (12 if args.smoke else 48)
+    root = args.root or f"/tmp/dragg_serve_soak_{os.getpid()}"
+    os.makedirs(root, exist_ok=True)
+    cache_dir = os.path.join(root, "compile_cache")
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = homes
+    cfg["community"]["homes_pv"] = max(1, homes // 6)
+    cfg["community"]["homes_battery"] = max(1, homes // 6)
+    cfg["community"]["homes_pv_battery"] = max(1, homes // 6)
+    cfg["home"]["hems"]["prediction_horizon"] = horizon
+    cfg["tpu"]["compile_cache_dir"] = cache_dir
+    cfg["serve"].update({
+        "request_retries": 3, "backoff_s": 0.2, "poll_s": 0.02,
+        "batch_deadline_s": 120.0, "worker_stall_s": 60.0,
+        "request_deadline_s": 600.0, "drain_s": 20.0,
+    })
+
+    if args.trace:
+        trace = load_trace(args.trace)
+    else:
+        trace = make_trace(trace_len, homes, os.path.join(root, "trace.jsonl"))
+    _log(f"root={root} homes={homes} horizon={horizon}h "
+         f"trace={len(trace)} requests")
+
+    CC = "CHILD_CRASH"
+    scenarios = [
+        dict(name="baseline"),
+        dict(name="child_crash", fault_spec="exit@serve_batch:2:once",
+             expect_failure=CC),
+        dict(name="kill9", fault_spec="hang@serve_batch:2:once",
+             kill9_on_inflight=True, expect_failure=CC),
+        dict(name="vmem_oom", fault_spec="vmem_oom@serve_batch:1:once",
+             expect_failure="VMEM_OOM"),
+        dict(name="compile_hang", fault_spec="hang@compile_compile:1:once",
+             serve_overrides={"worker_stall_s": 20.0},
+             expect_failure="COMPILE_HANG"),
+        dict(name="deadline", fault_spec="hang@serve_batch:1:once",
+             serve_overrides={"worker_stall_s": 0.0,
+                              "batch_deadline_s": 5.0},
+             expect_failure="DEADLINE"),
+        dict(name="tunnel_down", platform="auto", fault_spec="probe_down:1",
+             expect_degraded="TUNNEL_DOWN"),
+        dict(name="wedge", platform="auto", fault_spec="probe_wedge:1",
+             expect_degraded="WEDGED"),
+        dict(name="midflight_degrade", platform="auto",
+             fault_spec="probe_live:1,probe_down:1,exit@serve_batch:2:once",
+             expect_failure=CC, expect_degraded=CC,
+             degraded_after_transition_only=True),
+        dict(name="daemon_restart", restart_daemon=True),
+    ]
+    if args.stub:
+        # Stub workers have no staged-compile path — its chaos site never
+        # fires; drop the scenario rather than time out waiting for it.
+        scenarios = [s for s in scenarios
+                     if "compile_" not in s.get("fault_spec", "")]
+    if args.scenario:
+        scenarios = [s for s in scenarios if s["name"] == args.scenario]
+        if not scenarios:
+            _log(f"unknown scenario {args.scenario!r}")
+            return 2
+
+    if args.stub:
+        # Protocol-only mode: swap real workers for the stub responder.
+        ServeDaemon_init = ServeDaemon.__init__
+
+        def _stub_init(self, *a, **kw):
+            kw["stub"] = True
+            ServeDaemon_init(self, *a, **kw)
+        ServeDaemon.__init__ = _stub_init  # type: ignore[method-assign]
+
+    reports = {}
+    violations = []
+    cold_ready_s = None
+    for spec in scenarios:
+        spec = dict(spec)
+        name = spec.pop("name")
+        rep = run_scenario(name, root=root, base_cfg=cfg, trace=trace, **spec)
+        reports[name] = rep
+        violations += [f"{name}: {v}" for v in rep["violations"]]
+        if name == "baseline":
+            cold_ready_s = rep.get("cold_ready_s")
+        _log(f"--- scenario {name}: "
+             f"{'OK' if not rep['violations'] else 'VIOLATIONS'} "
+             f"({rep['elapsed_s']}s, rps={rep.get('sustained_rps')})")
+
+    # Cross-scenario invariant: restart recovery beats the cold start.
+    crash = reports.get("child_crash", {})
+    if cold_ready_s and crash.get("restart_warmup_s") is not None \
+            and not args.stub:
+        if crash["restart_warmup_s"] >= cold_ready_s:
+            violations.append(
+                f"warm restart ({crash['restart_warmup_s']}s) did not beat "
+                f"the cold start ({cold_ready_s}s) — compile cache not "
+                f"helping")
+
+    result = {
+        "tool": "serve_soak", "ok": not violations, "smoke": bool(args.smoke),
+        "homes": homes, "horizon_hours": horizon, "trace_len": len(trace),
+        "stub": bool(args.stub),
+        "metrics": {
+            "cold_ready_s": cold_ready_s,
+            "first_action_latency_proxy_s": cold_ready_s,
+            "sustained_rps_baseline":
+                reports.get("baseline", {}).get("sustained_rps"),
+            "restart_recovery_s": crash.get("recovery_s"),
+            "restart_warmup_s": crash.get("restart_warmup_s"),
+            "restart_cache": crash.get("restart_cache"),
+        },
+        "violations": violations,
+        "scenarios": reports,
+    }
+    print(json.dumps(result, default=str))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
