@@ -93,23 +93,28 @@ def _log(msg: str) -> None:
 def build(n_homes: int, horizon_hours: int, admm_iters: int,
           solver: str = "admm", band_kernel: str | None = None,
           data_dir: str | None = None, semantics: str = "default",
-          bucketed: str = "auto", per_home_obs: str = "true"):
+          bucketed: str = "auto", per_home_obs: str = "true",
+          communities: int = 1):
     """Build THE benchmark community engine (population mix, sim window,
     solver config).  This is the one definition of the measured community —
     tools/bench_engine_kernels.py reuses it so kernel A/B verdicts are
     measured on the same population as the headline bench.  ``data_dir``
     points at real nsrdb.csv/waterdraw_profiles.csv assets (default:
     synthetic — real January weather measures ~1.1 % more fallback steps
-    and ~26 % more wall, docs/perf_notes.md round 4)."""
+    and ~26 % more wall, docs/perf_notes.md round 4).  ``communities > 1``
+    folds C independent communities of ``n_homes`` EACH into one fleet
+    batch (round 12 — same compiled pattern set, C·B_type homes per type
+    bucket)."""
     import numpy as np
 
     from dragg_tpu.config import default_config
     from dragg_tpu.data import load_environment, load_waterdraw_profiles
     from dragg_tpu.engine import make_engine
-    from dragg_tpu.homes import build_home_batch, create_homes
+    from dragg_tpu.homes import build_fleet_batch, create_fleet_homes
 
     cfg = default_config()
     cfg["community"]["total_number_homes"] = n_homes
+    cfg["fleet"]["communities"] = communities
     # Mixed population, reference default ratio-ish: 40% PV, 10% battery,
     # 10% pv_battery.
     cfg["community"]["homes_pv"] = int(0.4 * n_homes)
@@ -142,13 +147,15 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
     env = load_environment(cfg, data_dir=data_dir)
     dt = int(cfg["agg"]["subhourly_steps"])
     waterdraw = load_waterdraw_profiles(waterdraw_path(cfg, data_dir), seed=12)
-    homes = create_homes(cfg, 24 * 7 * dt, dt, waterdraw)
+    homes = create_fleet_homes(cfg, 24 * 7 * dt, dt, waterdraw)
     hems = cfg["home"]["hems"]
-    batch = build_home_batch(
-        homes, max(1, int(hems["prediction_horizon"]) * dt), dt,
+    batch, fleet = build_fleet_batch(
+        homes, cfg, max(1, int(hems["prediction_horizon"]) * dt), dt,
         int(hems["sub_subhourly_steps"]),
     )
-    _log(f"home batch built ({batch.n_homes} homes)")
+    _log(f"home batch built ({batch.n_homes} homes"
+         + (f" = {communities} communities × {n_homes})" if fleet is not None
+            else ")"))
     # Run the pallas compile self-test BEFORE the engine constructor so a
     # hang between here and "engine ready" is attributable: self-test
     # (first TPU compile in this process) vs device commit of the batch
@@ -158,7 +165,7 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
     _log("pallas self-test (first TPU kernel compile)...")
     _log(f"pallas self-test: {pallas_band.available()}")
     _log("constructing engine (device commit + jit wrap)...")
-    engine = make_engine(batch, env, cfg, 0)
+    engine = make_engine(batch, env, cfg, 0, fleet=fleet)
     _log(f"engine ready: band_kernel={engine.band_kernel} "
          f"bw={engine.band_bw} bucketed={engine.bucketed}")
     if engine.bucketed:
@@ -215,7 +222,8 @@ def run_measured(args) -> dict:
                        solver="admm" if args.solver == "auto" else args.solver,
                        data_dir=args.data_dir, semantics=args.semantics,
                        bucketed=args.bucketed,
-                       per_home_obs=args.per_home_obs)
+                       per_home_obs=args.per_home_obs,
+                       communities=args.communities)
     solver_used = engine.params.solver
     if args.solver == "auto":
         # Race the two solver families over SEVERAL sequential steps and
@@ -231,7 +239,8 @@ def run_measured(args) -> dict:
                                   data_dir=args.data_dir,
                                   semantics=args.semantics,
                                   bucketed=args.bucketed,
-                                  per_home_obs=args.per_home_obs)
+                                  per_home_obs=args.per_home_obs,
+                                  communities=args.communities)
 
             def steps_time(eng, k=6, budget_s=60.0):
                 """Mean warm-step time over up to k steps, stopping early
@@ -586,6 +595,17 @@ def run_measured(args) -> dict:
         "platform": platform,
         "device_kind": str(device_kind),
         "n_homes": args.homes,
+        # Fleet fields (round 12): C independent communities of n_homes
+        # each folded into one batch.  tools/bench_trend.py treats
+        # ``communities`` as a HARD series key — fleet rows form their own
+        # trend series and never gate against single-community history.
+        "communities": args.communities,
+        "homes_total": args.homes * args.communities,
+        # Compiled pattern count — flat in C by construction (the fleet
+        # folds into the home axis; each type bucket holds C·B_type homes
+        # under ONE pattern).  A value that grows with C is a fleet-axis
+        # regression.
+        "bucket_patterns": len(binfo),
         "solver": solver_used,
         # Which optimization semantics this rate was measured under:
         # "integer" = the shipped default (integer_first_action repair —
@@ -657,6 +677,7 @@ def child_argv(args, platform: str, attempt: int,
         "--semantics", args.semantics,
         "--bucketed", args.bucketed,
         "--per-home-obs", args.per_home_obs,
+        "--communities", str(args.communities),
     ]
     if data_dir is not None:
         # "" is meaningful — it forces the synthetic generators (the
@@ -670,7 +691,15 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     # Defaults = the BASELINE target config (BASELINE.md row "10k-home
     # batched MPC, 24 h horizon").
-    ap.add_argument("--homes", type=int, default=10_000)
+    ap.add_argument("--homes", type=int, default=10_000,
+                    help="homes PER COMMUNITY (fleet total = homes × "
+                         "--communities)")
+    ap.add_argument("--communities", type=int, default=1,
+                    help="fleet size C (round 12): fold C independent "
+                         "communities of --homes each into one batched "
+                         "fleet engine; JSON gains communities/"
+                         "homes_total fields and bench_trend keys the "
+                         "series on C")
     ap.add_argument("--horizon-hours", type=int, default=24)
     ap.add_argument("--steps", type=int, default=16, help="timesteps per timed chunk")
     ap.add_argument("--chunks", type=int, default=3, help="number of timed chunks")

@@ -26,7 +26,7 @@ from dragg_tpu import telemetry
 from dragg_tpu.config import configured_solver, load_config
 from dragg_tpu.data import EnvironmentData, load_environment, load_waterdraw_profiles, parse_dt
 from dragg_tpu.engine import Engine, StepOutputs, make_engine
-from dragg_tpu.homes import build_home_batch, check_home_configs, create_homes
+from dragg_tpu.homes import check_home_configs
 from dragg_tpu.logger import Logger
 
 # Per-home series appended each timestep, in the reference's result-hash
@@ -56,6 +56,17 @@ _CONV_ITERS_METRICS = {
     "base": "solver.conv_iters_base",
     "superset": "solver.conv_iters_superset",
 }
+
+
+def _is_ready(a) -> bool:
+    """Whether a dispatched jax array's computation has completed — the
+    pipeline's overlap-credit probe.  Conservative on any backend that
+    cannot answer (old jax, non-addressable pod arrays): report ready, so
+    ``overlap_hidden_s`` stays a LOWER bound and never over-credits."""
+    try:
+        return bool(a.is_ready())
+    except Exception:
+        return True
 
 
 class Aggregator:
@@ -94,6 +105,15 @@ class Aggregator:
         self.check_type = self.config["simulation"]["check_type"]
         self.case = "baseline"
 
+        # Fleet resolution ([fleet] — round 12, architecture.md §14):
+        # C > 1 folds C independent communities (own seeds / weather
+        # offsets) into one batched engine; community.total_number_homes
+        # stays PER COMMUNITY.
+        from dragg_tpu.homes import fleet_config
+
+        (self.n_communities, self._fleet_seed_stride,
+         self._fleet_weather_off_h) = fleet_config(self.config)
+
         # Simulation window (dragg/aggregator.py:111-127).
         self.start_dt = parse_dt(self.config["simulation"]["start_datetime"])
         self.end_dt = parse_dt(self.config["simulation"]["end_datetime"])
@@ -102,10 +122,15 @@ class Aggregator:
         self.dt_interval = 60 // self.dt
         self.num_timesteps = int(np.ceil(self.hours * self.dt))
 
-        # Environment series (weather + TOU price).
+        # Environment series (weather + TOU price).  A fleet with weather
+        # offsets shifts community c's windows c*offset hours forward, so
+        # coverage must extend past the horizon by the largest offset.
         self.env: EnvironmentData = load_environment(self.config, data_dir=self.data_dir)
         horizon_hours = int(self.config["home"]["hems"]["prediction_horizon"])
-        self.env.check_coverage(self.start_dt, self.end_dt, horizon_hours)
+        self.env.check_coverage(
+            self.start_dt, self.end_dt,
+            horizon_hours
+            + (self.n_communities - 1) * self._fleet_weather_off_h)
         self.start_index = self.env.start_index(self.start_dt)
 
         self.all_homes: list[dict] | None = None
@@ -154,11 +179,34 @@ class Aggregator:
         enable_compile_cache(self.config)
 
     # ----------------------------------------------------------- population
+    @property
+    def total_homes(self) -> int:
+        """Homes across the whole fleet (= per-community count × C)."""
+        return (int(self.config["community"]["total_number_homes"])
+                * self.n_communities)
+
+    def _homes_cache_file(self) -> str:
+        """Population cache path.  C=1 keeps the reference's exact
+        ``all_homes-<N>-config.json`` name; a fleet's name carries the
+        community axis too — a 2×500 fleet and a 1×1000 community have
+        the same total and (at equal mix ratios) the same per-type
+        counts, so a total-only key would silently cross-reuse their
+        cached populations (review round 12)."""
+        n = self.total_homes
+        tag = (f"{n}" if self.n_communities == 1
+               else f"{n}-{self.n_communities}comm")
+        return os.path.join(self.outputs_dir, f"all_homes-{tag}-config.json")
+
     def get_homes(self) -> None:
         """Create or reload the home population (dragg/aggregator.py:263-271):
-        reuse ``all_homes-<N>-config.json`` unless overwrite_existing."""
-        n = self.config["community"]["total_number_homes"]
-        homes_file = os.path.join(self.outputs_dir, f"all_homes-{n}-config.json")
+        reuse ``all_homes-<N>-config.json`` unless overwrite_existing.
+        With ``fleet.communities > 1`` the population is C communities
+        drawn with their own seeds, stored community-major in one flat
+        list (homes.create_fleet_homes) under a fleet-tagged cache name
+        (:meth:`_homes_cache_file`)."""
+        from dragg_tpu.homes import create_fleet_homes
+
+        homes_file = self._homes_cache_file()
         if not self.config["community"].get("overwrite_existing", True) and os.path.isfile(homes_file):
             with open(homes_file) as f:
                 self.all_homes = json.load(f)
@@ -166,8 +214,18 @@ class Aggregator:
             waterdraw = load_waterdraw_profiles(
                 self._waterdraw_path(), seed=int(self.config["simulation"]["random_seed"])
             )
-            self.all_homes = create_homes(self.config, self.num_timesteps, self.dt, waterdraw)
-        check_home_configs(self.all_homes, self.config)
+            self.all_homes = create_fleet_homes(
+                self.config, self.num_timesteps, self.dt, waterdraw)
+        if self.n_communities == 1:
+            check_home_configs(self.all_homes, self.config)
+        else:
+            # Per-community blocks each satisfy the (per-community) config
+            # counts; the fleet structure itself is validated again when
+            # the spec is derived (homes.fleet_spec_for).
+            B = len(self.all_homes) // self.n_communities
+            for c in range(self.n_communities):
+                check_home_configs(self.all_homes[c * B:(c + 1) * B],
+                                   self.config)
         self.write_home_configs()
 
     def _waterdraw_path(self) -> str | None:
@@ -177,17 +235,22 @@ class Aggregator:
 
     def write_home_configs(self) -> None:
         """Persist the population (dragg/aggregator.py:846-854)."""
-        n = self.config["community"]["total_number_homes"]
-        path = os.path.join(self.outputs_dir, f"all_homes-{n}-config.json")
-        with open(path, "w") as f:
+        with open(self._homes_cache_file(), "w") as f:
             json.dump(self.all_homes, f, indent=4)
 
     def _build_engine(self) -> None:
+        from dragg_tpu.homes import build_fleet_batch
+
         hems = self.config["home"]["hems"]
         horizon = max(1, int(hems["prediction_horizon"]) * self.dt)
-        batch = build_home_batch(
-            self.all_homes, horizon, self.dt, int(hems["sub_subhourly_steps"])
-        )
+        # Fleet batches are TYPE-MAJOR (all communities' homes of one type
+        # contiguous) so the bucketed engine compiles ONE pattern per type
+        # regardless of C; fleet.global_idx / engine.real_home_cols map
+        # the merged outputs back to this aggregator's community-major
+        # all_homes order.  C=1 reduces to build_home_batch exactly.
+        batch, fleet = build_fleet_batch(
+            self.all_homes, self.config, horizon, self.dt,
+            int(hems["sub_subhourly_steps"]))
         self.batch = batch
         # Multi-device processes (a TPU pod slice launched via
         # deploy/launch_tpu_pod.sh, or any host with >1 visible device)
@@ -200,22 +263,33 @@ class Aggregator:
             raise ValueError(
                 f"tpu.sharded must be 'auto', true, or false, got {sharded!r}")
         if sharded == "auto":
-            import jax
+            # Device enumeration initializes the backend — route through
+            # the sanctioned helper (this process is committed to the
+            # device anyway: the engine build below puts arrays on it),
+            # never a bare jax.devices() (lint-enforced; CLAUDE.md).
+            from dragg_tpu.resilience.devices import device_count
 
-            use_sharded = len(jax.devices()) > 1
+            use_sharded = device_count() > 1
         else:
             use_sharded = bool(sharded)
         if use_sharded:
             from dragg_tpu.parallel import make_sharded_engine
 
             self.engine = make_sharded_engine(
-                batch, self.env, self.config, self.start_index)
+                batch, self.env, self.config, self.start_index, fleet=fleet)
             self.log.logger.info(
                 f"sharded engine: {self.engine.mesh.devices.size} devices, "
                 f"{self.engine.n_homes} home slots "
                 f"({self.engine.true_n_homes} real)")
         else:
-            self.engine = make_engine(batch, self.env, self.config, self.start_index)
+            self.engine = make_engine(batch, self.env, self.config,
+                                      self.start_index, fleet=fleet)
+        if fleet is not None:
+            self.log.logger.info(
+                f"fleet engine: {fleet.n_communities} communities × "
+                f"{fleet.homes_per_community} homes "
+                f"(seeds {fleet.seeds[0]}..{fleet.seeds[-1]}, weather "
+                f"offset {self._fleet_weather_off_h} h/community)")
         if self.engine.bucketed:
             self.log.logger.info(
                 "type-bucketed engine: " + ", ".join(
@@ -251,8 +325,14 @@ class Aggregator:
         # baseline shape error surfacing in a clean rl_agg Summary).
         self.extra_summary = {}
         # Wall-clock phase attribution (device scan vs host collect),
-        # surfaced as Summary.phase_times.
-        self._phase_times = {"device_chunks": 0.0, "collect": 0.0}
+        # surfaced as Summary.phase_times.  Pipeline accounting (round
+        # 12): ``overlap_hidden_s`` is the portion of host collect/
+        # checkpoint wall that provably ran WHILE the next chunk executed
+        # on device (a lower bound — host windows during which the device
+        # finished are not credited), and ``state_snapshot`` the donated-
+        # carry host-copy cost the pipeline pays per chunk.
+        self._phase_times = {"device_chunks": 0.0, "collect": 0.0,
+                             "overlap_hidden_s": 0.0, "state_snapshot": 0.0}
         if getattr(self, "collector", None) is not None:
             self.collector.close()
         n = len(self.all_homes)
@@ -741,7 +821,13 @@ class Aggregator:
         return {
             "num_timesteps": self.num_timesteps,
             "n_homes": len(self.all_homes) if self.all_homes else
-                       self.config["community"]["total_number_homes"],
+                       self.total_homes,
+            # The fleet's community axis (round 12): the carry leaves are
+            # sized by the WHOLE fleet and the per-home bookkeeping by its
+            # community-major order, so a checkpoint written at a
+            # different C (or a pre-fleet one) must start fresh, not
+            # misattribute homes across communities.
+            "communities": self.n_communities,
             # Solver family (config.resolve_solver_family): warm_rho is a
             # continuous per-home rho under admm but a bank-snapped value
             # under reluqp, and the two families' warm carries are not
@@ -946,13 +1032,29 @@ class Aggregator:
     # ------------------------------------------------------------------ runs
     def run_baseline(self) -> None:
         """The baseline community simulation (dragg/aggregator.py:757-778):
-        chunked device scans with checkpoint writes between chunks."""
+        chunked device scans with checkpoint writes between chunks.
+
+        Double-buffered host pipeline (round 12, ``fleet.pipeline`` —
+        architecture.md §14): once chunk N's device scan completes, chunk
+        N+1 is DISPATCHED (jax async dispatch) *before* chunk N's outputs
+        are materialized, so all of chunk N's host work — numpy collect,
+        observatory fold, checkpoint, telemetry — runs while the device
+        executes N+1 instead of sitting on its critical path.  On
+        accelerator backends the re-dispatch DONATES the carry, host-
+        snapshotted first (checkpoint.host_snapshot — the snapshot
+        doubles as the checkpoint payload and the forensics chunk-start
+        state); CPU and multi-host runs keep non-donated carries (see the
+        ``donate`` resolution below).  ``fleet.pipeline = false``
+        restores the synchronous order (host work before the next
+        dispatch) for overlap A/Bs."""
         horizon_h = self.config["home"]["hems"]["prediction_horizon"]
         self.log.logger.info(f"Performing baseline run for horizon: {horizon_h}")
         self.start_time = time.time()
         state, t = self.try_resume(self.engine.init_state())
         H = self.engine.params.horizon
-        chunks = 0
+        import jax
+
+        from dragg_tpu.checkpoint import host_snapshot
         # Supervised-run instrumentation (dragg_tpu/resilience): progress
         # beats let the supervisor's stall detector distinguish a hung
         # device chunk from a slow one, and the fault site lets chaos
@@ -960,48 +1062,169 @@ class Aggregator:
         from dragg_tpu.resilience.faults import fault_hook
         from dragg_tpu.resilience.heartbeat import beat
 
-        beat({"timestep": t})
-        while t < self.num_timesteps:
-            fault_hook("sim_chunk")
-            n_steps = min(self.checkpoint_interval, self.num_timesteps - t)
-            rps = np.zeros((n_steps, H), dtype=np.float32)
-            # Chunk-start carry, kept one chunk for the opt-in forensic
-            # state snapshots (_write_forensics).  Only when forensics is
-            # on — pinning a second full scan carry (plans + warm starts,
-            # ~35 MB at 10k×48h) every chunk is pure waste otherwise.
-            self._chunk_state0 = state if self._forensics_on else None
-            # Stage-named beat BEFORE the chunk: the first chunk is where
-            # the scan program compiles, so a supervised run that stalls
-            # there is attributed to the compile, not a slow simulation
-            # (the supervisor surfaces the last payload on failure.*).
-            beat({"stage": ("first_chunk(compile+execute)" if chunks == 0
-                            else "chunk_execute"), "timestep": t})
-            t0 = time.perf_counter()
-            with self._maybe_profile(chunks):
-                state, outs = self.engine.run_chunk(state, t, rps)
-                import jax
+        pipelined = bool(self.config.get("fleet", {}).get("pipeline", True))
+        # Donation is an accelerator-HBM optimization ONLY: XLA:CPU runs
+        # donated computations SYNCHRONOUSLY inside the dispatch call
+        # (measured round 12: warm donated dispatch 2.1 s = the whole
+        # chunk, vs 0.05 s async without donation — docs/perf_notes.md),
+        # which would serialize the very overlap this pipeline exists
+        # for; host RAM is not the constrained resource there.  Multi-
+        # host runs also skip it (per-process checkpoint shards read the
+        # device state's addressable blocks).
+        from dragg_tpu.resilience.devices import default_platform
 
-                jax.block_until_ready(outs.agg_load)
-            device_s = time.perf_counter() - t0
+        donate = (pipelined and jax.process_count() == 1
+                  and default_platform() != "cpu")
+
+        def process(pend, after_state, overlapping):
+            """Host work for one finished chunk: collect + telemetry +
+            (mid-run) checkpoint of ``after_state``.  Under the pipeline
+            this runs while the NEXT chunk executes on device; the
+            overlap credit is a lower bound — granted only when that
+            chunk is still provably running as the host window closes.
+            The same probe stamps ``overlapping``'s earliest OBSERVED
+            completion so its device span isn't inflated by THIS host
+            window (see the loop-top device_s accounting)."""
+            p_t0, p_ns, p_outs, device_s = (pend["t0"], pend["n_steps"],
+                                            pend["outs"], pend["device_s"])
+            p_start = pend["start_state"]
             self._phase_times["device_chunks"] += device_s
-            t0 = time.perf_counter()
-            self._collect_chunk(outs, device_s=device_s)
-            collect_s = time.perf_counter() - t0
+            self._chunk_state0 = p_start
+            host_t0 = time.perf_counter()
+            self._collect_chunk(p_outs, device_s=device_s)
+            # "collect" keeps its pre-round-12 meaning (_collect_chunk
+            # only) and is booked BEFORE write_outputs so a mid-run
+            # results.json Summary already includes this chunk's value;
+            # the overlap credit below covers the WHOLE host window
+            # (collect + results + checkpoint).
+            collect_s = time.perf_counter() - host_t0
             self._phase_times["collect"] += collect_s
             if self._telemetry_on:
                 telemetry.observe("engine.collect_s", collect_s)
-            t += n_steps
-            chunks += 1
-            beat({"timestep": t})
-            if t < self.num_timesteps:
+            # Mid-window completion probe: the checkpoint/results writes
+            # below can dwarf the collect, so observing completion here
+            # keeps the next chunk's device_s bound tight when the device
+            # finished early (device_s is dispatch → earliest OBSERVED
+            # completion — an upper bound at probe granularity).
+            if overlapping is not None and overlapping["ready_at"] is None \
+                    and _is_ready(overlapping["outs"].agg_load):
+                overlapping["ready_at"] = time.perf_counter()
+            end_t = p_t0 + p_ns
+            beat({"timestep": end_t})
+            if end_t < self.num_timesteps:
                 self.log.logger.info("Creating a checkpoint file.")
                 self.write_outputs()
-                self.save_checkpoint(state)
-                if self.stop_after_chunks is not None and chunks >= self.stop_after_chunks:
-                    self.log.logger.info(f"Stopping early after {chunks} chunks.")
-                    self._state = state
-                    return
+                self.save_checkpoint(after_state)
+            host_s = time.perf_counter() - host_t0
+            if overlapping is not None:
+                if overlapping["ready_at"] is not None:
+                    pass  # completed mid-window; earliest stamp kept
+                elif _is_ready(overlapping["outs"].agg_load):
+                    # Completed during this host window — stamp the bound
+                    # for its device_s; no overlap credit (lower bound).
+                    overlapping["ready_at"] = time.perf_counter()
+                else:
+                    self._phase_times["overlap_hidden_s"] += host_s
+                    if self._telemetry_on:
+                        telemetry.observe("engine.overlap_hidden_s",
+                                          host_s)
+
+        chunks = 0
+        # The chunk in flight (dict): t0/n_steps/outs/dispatched (the
+        # dispatch stamp)/start_state (forensics)/device_s/ready_at (the
+        # earliest time the chunk was OBSERVED complete — the overlap
+        # probe stamps it so device_s is not inflated by host work that
+        # ran after the device already finished).
+        pending = None
+        beat({"timestep": t})
+        while True:
+            dispatch = t < self.num_timesteps and (
+                self.stop_after_chunks is None
+                or chunks < self.stop_after_chunks)
+            if not dispatch and pending is None:
+                break
+            if pending is not None:
+                # Wait for the in-flight chunk BEFORE dispatching the
+                # next: keeps the per-chunk device span honest (dispatch→
+                # ready with an idle queue) and is required by donation
+                # (the snapshot below must copy computed buffers).
+                # device_s = dispatch → earliest OBSERVED completion: the
+                # block-return time, unless the previous chunk's overlap
+                # probe already saw this chunk finished DURING that host
+                # window — then its (earlier) probe stamp is the bound,
+                # so host work never pads the device span (review round
+                # 12: on host-bound runs the raw dispatch→block wall
+                # conflated the two and device_chunks + collect could
+                # exceed total wall).
+                jax.block_until_ready(pending["outs"].agg_load)
+                done_t = pending["ready_at"] or time.perf_counter()
+                pending["device_s"] = done_t - pending["dispatched"]
+            # ``state`` is the carry AFTER the pending chunk — the
+            # checkpoint payload once that chunk's host work runs.
+            after_state = state
+            if not pipelined and pending is not None:
+                # Synchronous order (the pre-round-12 loop, kept for
+                # overlap A/Bs): host work BEFORE the next dispatch.
+                process(pending, after_state, overlapping=None)
+                pending = None
+            nxt = None
+            if dispatch:
+                n_steps = min(self.checkpoint_interval,
+                              self.num_timesteps - t)
+                rps = np.zeros((n_steps, H), dtype=np.float32)
+                fault_hook("sim_chunk")
+                if donate:
+                    # Owning host copy of the carry — it must outlive the
+                    # donated re-dispatch below (checkpoint payload +
+                    # next chunk's forensics start state).
+                    t_sn = time.perf_counter()
+                    after_state = host_snapshot(state)
+                    self._phase_times["state_snapshot"] += \
+                        time.perf_counter() - t_sn
+                # Stage-named beat BEFORE the chunk: the first chunk is
+                # where the scan program compiles, so a supervised run
+                # that stalls there is attributed to the compile, not a
+                # slow simulation (the supervisor surfaces the last
+                # payload on failure.*).
+                beat({"stage": ("first_chunk(compile+execute)" if chunks == 0
+                                else "chunk_execute"), "timestep": t})
+                d0 = time.perf_counter()
+                with self._maybe_profile(chunks):
+                    state, outs = self.engine.run_chunk(state, t, rps,
+                                                        donate=donate)
+                    if self._profiling_chunk(chunks):
+                        # Keep the traced chunk's execution inside the
+                        # trace context (serializes this one chunk).
+                        jax.block_until_ready(outs.agg_load)
+                nxt = {"t0": t, "n_steps": n_steps, "outs": outs,
+                       "dispatched": d0,
+                       "start_state":
+                           after_state if self._forensics_on else None,
+                       "device_s": 0.0, "ready_at": None}
+                t += n_steps
+                chunks += 1
+            if pending is not None:
+                # Pipelined: the finished chunk's host work overlaps the
+                # device execution of the chunk dispatched above.
+                process(pending, after_state, overlapping=nxt)
+            pending = nxt
         self._state = state
+        if self.stop_after_chunks is not None and t < self.num_timesteps:
+            self.log.logger.info(f"Stopping early after {chunks} chunks.")
+
+    def _profile_dir(self) -> str:
+        """The ONE resolution of the trace destination (env overrides
+        config) — both the trace decision and the writer read it here so
+        they can never disagree."""
+        return os.environ.get(
+            "JAX_PROFILE_DIR", self.config.get("tpu", {}).get("profile_dir", "")
+        )
+
+    def _profiling_chunk(self, chunk_idx: int) -> bool:
+        """Whether ``_maybe_profile`` traces this chunk — the pipeline
+        serializes exactly that chunk so its execution stays inside the
+        trace context."""
+        return bool(self._profile_dir()) and chunk_idx == 1
 
     def _maybe_profile(self, chunk_idx: int):
         """Profiler trace around one device chunk (SURVEY §5.1: the
@@ -1011,11 +1234,9 @@ class Aggregator:
         compile — is traced for TensorBoard/xprof."""
         import contextlib
 
-        profile_dir = os.environ.get(
-            "JAX_PROFILE_DIR", self.config.get("tpu", {}).get("profile_dir", "")
-        )
-        if not profile_dir or chunk_idx != 1:
+        if not self._profiling_chunk(chunk_idx):
             return contextlib.nullcontext()
+        profile_dir = self._profile_dir()
         import jax
 
         self.log.logger.info(f"Writing profiler trace to {profile_dir}")
@@ -1090,6 +1311,16 @@ class Aggregator:
             "phase_times": {k: round(v, 3) for k, v in
                             getattr(self, "_phase_times", {}).items()},
         }
+        if self.n_communities > 1:
+            summary["fleet"] = {
+                "communities": self.n_communities,
+                "homes_per_community":
+                    int(cfg["community"]["total_number_homes"]),
+                "homes_total": self.total_homes,
+                "seed_stride": self._fleet_seed_stride,
+                "weather_offset_hours": self._fleet_weather_off_h,
+            }
+            summary["num_homes"] = self.total_homes
         # The reference wraps the price series in a 1-tuple — a trailing-comma
         # bug (dragg/aggregator.py:814-816) we do NOT reproduce.
         summary["TOU"] = self.env.tou[sim_slice].tolist()
@@ -1224,6 +1455,17 @@ class Aggregator:
 
     def _run_cases(self) -> None:
         """The enabled simulation cases, in reference order."""
+        if self.n_communities > 1 and (
+                self.config["simulation"].get("run_rl_agg", False)
+                or self.config["simulation"].get("run_rl_simplified", False)):
+            # The RL cases drive ONE community's reward price; the
+            # vectorized fleet policy is ROADMAP item 5 (it builds on this
+            # community axis) — refuse loudly rather than train a single
+            # agent against a silently-merged fleet aggregate.
+            raise ValueError(
+                "fleet.communities > 1 currently supports the baseline MPC "
+                "case only (run_rbo_mpc); the fleet RL aggregator is "
+                "ROADMAP item 5")
         if self.config["simulation"].get("run_rbo_mpc", True):
             self.case = "baseline"
             self.get_homes()

@@ -23,15 +23,32 @@ import jax
 import numpy as np
 
 
-def to_host(a) -> np.ndarray:
+def to_host(a, copy: bool = False) -> np.ndarray:
     """Fetch an array to host numpy, handling leaves sharded across
     processes: a multi-host global array is all-gathered (a collective —
-    EVERY process must call this) before the local read."""
+    EVERY process must call this) before the local read.
+
+    ``copy=True`` forces an owning deep copy.  ``np.asarray`` of a jax
+    CPU array can be a zero-copy VIEW of the device buffer — fine for
+    write-once reads, but a checkpoint snapshot taken under the
+    aggregator's double-buffered pipeline must outlive the donated carry
+    it was taken from (the next chunk's execution reuses those buffers;
+    see :func:`host_snapshot`)."""
     if isinstance(a, jax.Array) and not a.is_fully_addressable:
         from jax.experimental import multihost_utils
 
         return np.asarray(multihost_utils.process_allgather(a, tiled=True))
-    return np.asarray(a)
+    out = np.asarray(a)
+    return np.array(out, copy=True) if copy else out
+
+
+def host_snapshot(tree):
+    """Deep host copy of a state pytree, safe to keep across a DONATED
+    re-dispatch of the same carry (aggregator.run_baseline's pipeline:
+    the snapshot is taken, then the carry's buffers are donated to chunk
+    N+1, then the snapshot is checkpointed while N+1 runs).  Blocks until
+    the leaves are computed — i.e. until the producing chunk finished."""
+    return jax.tree_util.tree_map(lambda a: to_host(a, copy=True), tree)
 
 
 def save_pytree(path: str, tree) -> None:

@@ -290,6 +290,27 @@ _DEFAULT: dict[str, Any] = {
                                  # provenance on every response); false
                                  # + --platform tpu = strict 429s
     },
+    # Multi-community fleet engine (round 12 — ROADMAP item 3,
+    # architecture.md §14; no reference analog: the reference runs one
+    # community per process).
+    "fleet": {
+        "communities": 1,   # C independent communities folded into the
+                            # home axis as one batched fleet (each drawn
+                            # with its own seed; type buckets hold
+                            # C·B_type homes under the SAME compiled
+                            # patterns — compile cost flat in C)
+        "seed_stride": 1,   # community c's population seed =
+                            # random_seed + c * seed_stride
+        "weather_offset_hours": 0,  # community c's environment windows are
+                                    # shifted by c * this many hours
+                                    # (decorrelates fleet weather; 0 keeps
+                                    # the shared-window fast path)
+        "pipeline": True,   # double-buffered host pipeline: dispatch chunk
+                            # N+1 before materializing chunk N's outputs so
+                            # collect/observatory/checkpoint/telemetry run
+                            # while the device solves; false restores the
+                            # synchronous loop (for overlap A/Bs)
+    },
     # Unified run telemetry (dragg_tpu/telemetry — round-7 tentpole).
     "telemetry": {
         "enabled": True,  # run-scoped event bus: <run_dir>/events.jsonl +

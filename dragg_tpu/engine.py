@@ -127,10 +127,12 @@ class _TypeBucket:
     jitted entry points trace (:meth:`Engine._bound`), exactly like the
     engine-level constants."""
 
-    ARRAY_ATTRS = ("draws", "tank", "check_mask", "home_idx")
+    ARRAY_ATTRS = ("draws", "tank", "check_mask", "home_idx", "noise_idx",
+                   "home_key", "env_off")
 
     def __init__(self, *, name, spec, lay, comm_start, n_real, start_slot,
                  n, static, batch, draws, tank, check_mask, home_idx,
+                 noise_idx, home_key, env_off,
                  band_plan, solve_backend, ordinal=0):
         self.ordinal = ordinal      # position in engine._buckets (= the
                                     # bucket_info() row the observatory's
@@ -147,7 +149,16 @@ class _TypeBucket:
         self.draws = draws
         self.tank = tank
         self.check_mask = check_mask
-        self.home_idx = home_idx      # global community index per slot
+        self.home_idx = home_idx      # global fleet index per slot
+                                      # (community-major — the all_homes /
+                                      # real_home_cols order)
+        self.noise_idx = noise_idx    # WITHIN-community index per slot —
+                                      # the forecast-noise stream id, so
+                                      # fleet batching reproduces each
+                                      # community's standalone noise
+        self.home_key = home_key      # (n, 2) uint32 per-home base PRNG
+                                      # key (the home's community seed)
+        self.env_off = env_off        # (n,) int32 env-series offset
         self.band_plan = band_plan
         self.solve_backend = solve_backend
 
@@ -175,6 +186,9 @@ class _SupersetView:
     tank = property(lambda s: s._eng._tank)
     check_mask = property(lambda s: s._eng._check_mask)
     home_idx = property(lambda s: s._eng._home_idx)
+    noise_idx = property(lambda s: s._eng._noise_idx)
+    home_key = property(lambda s: s._eng._home_key)
+    env_off = property(lambda s: s._eng._env_off)
     n = property(lambda s: s._eng.n_homes)
     n_real = property(lambda s: s._eng.true_n_homes)
     band_plan = property(lambda s: s._eng._band_plan)
@@ -200,7 +214,11 @@ class CommunityState(NamedTuple):
     warm_x: jnp.ndarray      # (n, nvar) ADMM warm-start primal
     warm_y_box: jnp.ndarray  # (n, nvar) ADMM warm-start box duals
     warm_rho: jnp.ndarray    # (n,) ADMM warm-start rho
-    key: jnp.ndarray         # PRNG key for the seasonal forecast noise
+    key: jnp.ndarray         # PRNG key (legacy carry — since round 12 the
+                             # forecast noise is keyed from the per-home
+                             # ctx.home_key/noise_idx constants so fleet
+                             # batching can't perturb it; the leaf stays so
+                             # checkpoints keep their structure)
 
 
 class StepOutputs(NamedTuple):
@@ -303,8 +321,9 @@ class StepAux(NamedTuple):
 
     draw0: jnp.ndarray        # (n,) liters drawn this step
     temp_wh_init: jnp.ndarray # (n,) draw-mixed initial WH temp
-    oat1: jnp.ndarray         # () OAT at t+1 (fallback simulation forcing)
-    ghi_w: jnp.ndarray        # (H+1,)
+    oat1: jnp.ndarray         # () OAT at t+1 (fallback simulation forcing);
+                              # (n,) under fleet weather offsets
+    ghi_w: jnp.ndarray        # (H+1,); (n, H+1) under fleet weather offsets
     price_total: jnp.ndarray  # (n, H)
     cool_cap: jnp.ndarray     # (n,)
     heat_cap: jnp.ndarray     # (n,)
@@ -380,7 +399,7 @@ class Engine:
     """
 
     def __init__(self, params: EngineParams, batch, env_oat, env_ghi, env_tou,
-                 check_mask=None):
+                 check_mask=None, fleet=None):
         self.params = params
         self.batch = batch
         lay = QPLayout(params.horizon)
@@ -390,6 +409,45 @@ class Engine:
         # before super().__init__; unsharded engines carry no padding.
         if not hasattr(self, "true_n_homes"):
             self.true_n_homes = batch.n_homes
+        # Fleet identity per batch row (ROADMAP item 3): community-major
+        # fleet index, within-community noise index, per-home base PRNG
+        # key (the community's seed), and env-series offset.  A
+        # single-community engine is the C=1 special case — identical
+        # values to the pre-fleet engine, so its noise streams (and the
+        # compiled numbers) are unchanged.  A padded batch (ShardedEngine
+        # pads before super().__init__) edge-extends the fleet rows like
+        # every other per-home array.
+        self._fleet = fleet
+        n_now = batch.n_homes
+        if fleet is None:
+            g_idx = np.arange(n_now)
+            n_idx = np.arange(n_now)
+            e_off = np.zeros(n_now, np.int32)
+            keys = np.broadcast_to(
+                np.asarray(jax.random.PRNGKey(params.seed), np.uint32),
+                (n_now, 2)).copy()
+        else:
+            pad = n_now - len(fleet.global_idx)
+
+            def _padded(a):
+                return np.pad(np.asarray(a), (0, pad), mode="edge")
+
+            g_idx = _padded(fleet.global_idx)
+            n_idx = _padded(fleet.local_idx)
+            e_off = _padded(fleet.env_offset).astype(np.int32)
+            seed_keys = np.stack(
+                [np.asarray(jax.random.PRNGKey(int(s)), np.uint32)
+                 for s in fleet.seeds])
+            keys = seed_keys[_padded(fleet.community)]
+        self._fleet_rows = {
+            "home_idx": g_idx.astype(np.int64),
+            "noise_idx": n_idx.astype(np.int32),
+            "home_key": keys, "env_off": e_off,
+        }
+        # Static trace-time switch: all-zero offsets keep the scalar
+        # shared-window slice (byte-identical program to the pre-fleet
+        # engine); any non-zero offset compiles the per-home gather path.
+        self._per_home_env = bool(np.any(e_off))
         # Type-bucketed shape specialization (tpu.bucketed) resolves FIRST:
         # a bucketed engine's per-home constants live in the bucket
         # contexts, and building the superset copies too would double the
@@ -422,7 +480,10 @@ class Engine:
                                       dtype=jnp.float32)
             self._tank = jnp.asarray(np.asarray(batch.tank_size),
                                      dtype=jnp.float32)
-            self._home_idx = jnp.asarray(np.arange(batch.n_homes))
+            self._home_idx = jnp.asarray(self._fleet_rows["home_idx"])
+            self._noise_idx = jnp.asarray(self._fleet_rows["noise_idx"])
+            self._home_key = jnp.asarray(self._fleet_rows["home_key"])
+            self._env_off = jnp.asarray(self._fleet_rows["env_off"])
             self._check_mask = jnp.asarray(np.asarray(check_mask),
                                            dtype=jnp.float32)
             # Resolve the "auto" solve backend HERE, where the mesh is
@@ -508,6 +569,13 @@ class Engine:
         p = self.params
         shards = getattr(self, "_mesh_shards", 1)
         cmask = np.asarray(check_mask, dtype=np.float64)
+        rows = self._fleet_rows
+
+        def _row_pad(key, a, b, n_slots):
+            v = np.asarray(rows[key])[a:b]
+            widths = [(0, n_slots - (b - a))] + [(0, 0)] * (v.ndim - 1)
+            return jnp.asarray(np.pad(v, widths, mode="edge"))
+
         slot = 0
         for ordinal, (tname, a, b) in enumerate(self._bucket_ranges):
             spec = TYPE_SPECS[tname]
@@ -532,16 +600,17 @@ class Engine:
                 check_mask=jnp.asarray(
                     np.pad(cmask[a:b], (0, n_slots - (b - a))) * pmask,
                     dtype=jnp.float32),
-                home_idx=jnp.asarray(
-                    np.pad(np.arange(a, b), (0, n_slots - (b - a)),
-                           mode="edge")),
+                home_idx=_row_pad("home_idx", a, b, n_slots),
+                noise_idx=_row_pad("noise_idx", a, b, n_slots),
+                home_key=_row_pad("home_key", a, b, n_slots),
+                env_off=_row_pad("env_off", a, b, n_slots),
                 band_plan=plan, solve_backend=backend, ordinal=ordinal,
             ))
             slot += n_slots
 
     # ------------------------------------------------- traced constant tree
     _CONST_ATTRS = ("_oat", "_ghi", "_tou", "_draws", "_tank", "_check_mask",
-                    "_home_idx")
+                    "_home_idx", "_noise_idx", "_home_key", "_env_off")
     _STATIC_ARRAYS = ("vals", "a_in", "a_wh", "kin", "kwh", "awr")
 
     def _consts(self):
@@ -689,13 +758,49 @@ class Engine:
     @property
     def real_home_cols(self) -> np.ndarray:
         """Column indices of the TRUE homes in the merged per-home output
-        axis, in community order.  Superset engines pad (if at all) only at
-        the end, so this is a plain prefix; bucketed engines shard-pad each
-        bucket independently, interleaving pad slots at bucket boundaries."""
-        if not self._bucketed:
+        axis, in COMMUNITY-MAJOR fleet order (``all_homes`` order; for a
+        single community that is just community order).  Superset engines
+        pad (if at all) only at the end; bucketed engines shard-pad each
+        bucket independently, interleaving pad slots at bucket boundaries;
+        fleet engines additionally interleave communities within each type
+        bucket (the batch is type-major), so the mapping is the inverse of
+        the rows' ``global_idx``.  ``real_home_pairs`` carries the same
+        mapping as explicit (community, col) pairs."""
+        if self._fleet is None and not self._bucketed:
             return np.arange(self.true_n_homes)
-        return np.concatenate([c.start_slot + np.arange(c.n_real)
-                               for c in self._buckets])
+        cols = np.empty(self.true_n_homes, dtype=np.int64)
+        g = self._fleet_rows["home_idx"]
+        if self._bucketed:
+            for c in self._buckets:
+                cols[g[c.comm_start:c.comm_start + c.n_real]] = \
+                    c.start_slot + np.arange(c.n_real)
+        else:
+            cols[g[:self.true_n_homes]] = np.arange(self.true_n_homes)
+        return cols
+
+    @property
+    def real_home_pairs(self) -> np.ndarray:
+        """(true_n_homes, 2) int array of ``(community, output column)``
+        per home, in community-major fleet order — row ``j`` is home
+        ``j % B`` of community ``j // B`` and names the merged-output
+        column carrying it.  Single-community engines report community 0
+        everywhere (B = the community size)."""
+        cols = self.real_home_cols
+        if self._fleet is None:
+            comm = np.zeros(len(cols), dtype=np.int64)
+        else:
+            comm = np.arange(len(cols)) // self._fleet.homes_per_community
+        return np.stack([comm, cols], axis=1)
+
+    @property
+    def fleet(self):
+        """The :class:`~dragg_tpu.homes.FleetSpec` this engine was built
+        with (``None`` for a single community)."""
+        return self._fleet
+
+    @property
+    def n_communities(self) -> int:
+        return 1 if self._fleet is None else self._fleet.n_communities
 
     @property
     def obs_enabled(self) -> bool:
@@ -709,17 +814,29 @@ class Engine:
         only the (n,) leaves (temp_in/temp_wh/e_batt/counter), never the
         (n, H) plans or warm starts, so an opt-in dump at 10k homes moves
         kilobytes, not the full carry."""
+        if not 0 <= home_idx < self.true_n_homes:
+            return {}
+        # ``home_idx`` is the community-major fleet index (all_homes
+        # order); map it to its TYPE-MAJOR batch row first (identity for
+        # single communities).
+        row = home_idx
+        if self._fleet is not None:
+            inv = getattr(self, "_fleet_inv", None)
+            if inv is None:
+                inv = np.empty(self.true_n_homes, dtype=np.int64)
+                inv[self._fleet_rows["home_idx"][:self.true_n_homes]] = \
+                    np.arange(self.true_n_homes)
+                self._fleet_inv = inv
+            row = int(inv[home_idx])
         if self._bucketed:
             for ctx, st in zip(self._buckets, state):
-                if ctx.comm_start <= home_idx < ctx.comm_start + ctx.n_real:
-                    local = home_idx - ctx.comm_start
+                if ctx.comm_start <= row < ctx.comm_start + ctx.n_real:
+                    local = row - ctx.comm_start
                     break
             else:
                 return {}
         else:
-            if not 0 <= home_idx < self.true_n_homes:
-                return {}
-            st, local = state, home_idx
+            st, local = state, row
         return {f: float(np.asarray(getattr(st, f))[local])
                 for f in ("temp_in", "temp_wh", "e_batt", "counter")}
 
@@ -828,19 +945,41 @@ class Engine:
         ) / ctx.tank
 
         # --- Environment windows (true values; dragg/mpc_calc.py:211-230).
+        # Fleet weather offsets (fleet.weather_offset_hours) shift each
+        # home's window by its community's offset: a per-home gather from
+        # the shared series.  The trace-time switch keeps the scalar
+        # dynamic_slice path — byte-identical to the pre-fleet program —
+        # whenever every offset is zero (single communities, and fleets
+        # running synchronized weather).
         start = p.start_index + t
-        oat_w = lax.dynamic_slice(self._oat, (start,), (H + 1,))
-        ghi_w = lax.dynamic_slice(self._ghi, (start,), (H + 1,))
-        tou_w = lax.dynamic_slice(self._tou, (start,), (H,))
-        price_total = rp[None, :].astype(f32) + tou_w[None, :]   # (1, H) → broadcast
+        if self._per_home_env:
+            row0 = start + ctx.env_off[:, None]                  # (n, 1)
+            oat_w = self._oat[row0 + jnp.arange(H + 1)[None, :]]  # (n, H+1)
+            ghi_w = self._ghi[row0 + jnp.arange(H + 1)[None, :]]
+            tou_w = self._tou[row0 + jnp.arange(H)[None, :]]      # (n, H)
+            price_total = rp[None, :].astype(f32) + tou_w
+            oat0, oat1 = oat_w[:, 0], oat_w[:, 1]
+            oat_fore = oat_w[:, 1:]
+        else:
+            oat_w = lax.dynamic_slice(self._oat, (start,), (H + 1,))
+            ghi_w = lax.dynamic_slice(self._ghi, (start,), (H + 1,))
+            tou_w = lax.dynamic_slice(self._tou, (start,), (H,))
+            price_total = rp[None, :].astype(f32) + tou_w[None, :]
+            oat0, oat1 = oat_w[0], oat_w[1]
+            oat_fore = oat_w[None, 1:]
         price_total = jnp.broadcast_to(price_total, (n, H))
 
         # --- Seasonal gate on the noisy forecast (dragg/mpc_calc.py:217-223,302-309).
-        # Per-home keys (not one (n, H) draw): each home's noise stream is a
-        # function of (seed, t, GLOBAL home index — ctx.home_idx) alone, so
-        # it is invariant to the batch size AND the bucket partition —
-        # shard-padding or bucketing a community must not perturb the real
-        # homes' forecasts (sharded/bucketed-vs-single equivalence).
+        # Per-home keys (not one (n, H) draw): each home's noise stream is
+        # a function of (its COMMUNITY's seed — ctx.home_key, t, its
+        # WITHIN-community index — ctx.noise_idx) alone, so it is
+        # invariant to the batch size, the bucket partition, AND the fleet
+        # composition — shard-padding, bucketing, or fleet-batching a
+        # community must not perturb the real homes' forecasts
+        # (sharded/bucketed/fleet-vs-single equivalence).  For a
+        # single-community engine home_key is the tiled PRNGKey(seed) and
+        # noise_idx the global index, reproducing the pre-fleet stream
+        # bit-for-bit.
         #
         # Documented deviation: the reference's 1.1^k noise growth is
         # unbounded — at the H=48 BASELINE horizon step 47 carries ±88 degC
@@ -849,14 +988,15 @@ class Engine:
         # vs HiGHS).  The reference never ran horizons >16 h.  We cap the
         # std at ``forecast_noise_cap`` (default 3 degC ~ 1.1^12, identical
         # to the reference for the first 12 horizon steps).
-        key = jax.random.fold_in(state.key, t)
-        home_keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, ctx.home_idx)
+        keys_t = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+            ctx.home_key, t)
+        home_keys = jax.vmap(jax.random.fold_in)(keys_t, ctx.noise_idx)
         noise_std = jnp.minimum(
             jnp.power(jnp.asarray(1.1, f32), jnp.arange(H, dtype=f32)),
             jnp.asarray(p.forecast_noise_cap, f32),
         )
         noise = jax.vmap(lambda k: jax.random.normal(k, (H,), dtype=f32))(home_keys) * noise_std
-        oat_ev_max = jnp.maximum(oat_w[0], jnp.max(oat_w[None, 1:] + noise, axis=1))
+        oat_ev_max = jnp.maximum(oat0, jnp.max(oat_fore + noise, axis=1))
         winter = (oat_ev_max <= WINTER_MAX_OAT).astype(f32)
         heat_cap = winter * s
         cool_cap = (1.0 - winter) * s
@@ -872,7 +1012,7 @@ class Engine:
             discount=p.discount,
         )
         aux = StepAux(
-            draw0=draw_size[:, 0], temp_wh_init=temp_wh_init, oat1=oat_w[1],
+            draw0=draw_size[:, 0], temp_wh_init=temp_wh_init, oat1=oat1,
             ghi_w=ghi_w, price_total=price_total,
             cool_cap=cool_cap, heat_cap=heat_cap,
         )
@@ -1476,12 +1616,32 @@ class Engine:
         )
         return state, out
 
-    def run_chunk(self, state: CommunityState, t0: int, rps) -> tuple[CommunityState, StepOutputs]:
+    def run_chunk(self, state: CommunityState, t0: int, rps,
+                  donate: bool = False) -> tuple[CommunityState, StepOutputs]:
         """Run a chunk of timesteps with a device-side scan.  ``rps`` is
         (n_steps, H) reward prices (zeros for the baseline case).  Returns
-        (final_state, outputs stacked along time)."""
-        return self._chunk_fn(self._consts(), state, jnp.asarray(t0),
-                              jnp.asarray(rps, dtype=jnp.float32))
+        (final_state, outputs stacked along time).
+
+        ``donate=True`` donates the incoming carry's buffers to the
+        output state (XLA aliases them, halving the carry HBM at the
+        100k-home target) — the caller MUST NOT touch ``state`` after the
+        call.  The aggregator's double-buffered pipeline host-snapshots
+        the carry before the next dispatch for exactly this reason
+        (aggregator.run_baseline); plain callers (tests, tools that reuse
+        a state) keep the default non-donating entry.  Caveat measured
+        round 12: XLA:CPU executes donated computations SYNCHRONOUSLY
+        inside the dispatch call (async dispatch is lost), so the
+        aggregator only donates on accelerator backends — donate here on
+        CPU only when you don't care about dispatch asynchrony."""
+        if donate:
+            if getattr(self, "_chunk_fn_donate", None) is None:
+                self._chunk_fn_donate = jax.jit(self._chunk_entry,
+                                                donate_argnums=(1,))
+            fn = self._chunk_fn_donate
+        else:
+            fn = self._chunk_fn
+        return fn(self._consts(), state, jnp.asarray(t0),
+                  jnp.asarray(rps, dtype=jnp.float32))
 
     # ----------------------------------------------------------- profiling
     def phase_fns(self):
@@ -1642,9 +1802,12 @@ def check_mask_for(batch, config) -> np.ndarray:
     return (np.asarray(batch.type_code) == TYPE_CODES[check_type]).astype(np.float64)
 
 
-def make_engine(batch, env, config, start_index: int) -> Engine:
+def make_engine(batch, env, config, start_index: int, fleet=None) -> Engine:
     """Construct an :class:`Engine` from a HomeBatch + EnvironmentData +
-    validated config dict."""
+    validated config dict.  ``fleet`` (a :class:`~dragg_tpu.homes.FleetSpec`
+    from :func:`~dragg_tpu.homes.build_fleet_batch`) folds C independent
+    communities into the home axis."""
     params = engine_params(config, start_index)
     mask = check_mask_for(batch, config)
-    return Engine(params, batch, env.oat, env.ghi, env.tou, check_mask=mask)
+    return Engine(params, batch, env.oat, env.ghi, env.tou, check_mask=mask,
+                  fleet=fleet)

@@ -197,6 +197,151 @@ def check_home_configs(all_homes: list[dict], config: dict) -> None:
             raise ValueError(f"Incorrect number of {t} homes: {counts[t]} != {c}")
 
 
+class FleetSpec(NamedTuple):
+    """Static description of a multi-community fleet folded into one home
+    batch (ROADMAP item 3 / architecture.md §14).
+
+    The fleet batch is TYPE-MAJOR: all communities' homes of one type are
+    contiguous, so the type-bucketed engine solves ``C·B_type`` homes per
+    bucket under the SAME compiled pattern set as a single community
+    (compile cost flat in C by construction).  The arrays below are per
+    fleet-batch row (type-major order) and map each row back to its
+    community identity:
+
+    * ``community[i]``  — which community row ``i`` belongs to;
+    * ``global_idx[i]`` — the row's COMMUNITY-MAJOR fleet index
+      (``c * B + local``) — the index into the aggregator's flat
+      ``all_homes`` list, and the order ``Engine.real_home_cols`` maps
+      merged outputs back to;
+    * ``local_idx[i]``  — the row's index within its own community's
+      standalone batch.  The forecast-noise stream is keyed on
+      ``(community seed, local_idx)`` so every home draws EXACTLY the
+      noise it would draw in a standalone run of its community — fleet
+      batching must not perturb per-community trajectories (parity:
+      tests/test_fleet.py);
+    * ``env_offset[i]`` — per-home offset (in sim steps) into the
+      environment series, so communities can see time-shifted weather
+      (``fleet.weather_offset_hours``); all-zero keeps the engine on the
+      scalar shared-window path.
+    """
+
+    n_communities: int
+    homes_per_community: int
+    seeds: tuple               # per-community population seed
+    community: np.ndarray      # (N,) int32
+    global_idx: np.ndarray     # (N,) int32 community-major fleet index
+    local_idx: np.ndarray      # (N,) int32 within-community index
+    env_offset: np.ndarray     # (N,) int32 env-series offset (sim steps)
+
+
+def fleet_config(config: dict) -> tuple[int, int, int]:
+    """The resolved ``[fleet]`` knobs: (communities, seed_stride,
+    weather_offset_hours).  ``communities = 1`` (the default) is the
+    single-community engine unchanged."""
+    f = config.get("fleet", {})
+    c = int(f.get("communities", 1))
+    if c < 1:
+        raise ValueError(f"fleet.communities must be >= 1, got {c}")
+    off = int(f.get("weather_offset_hours", 0))
+    if off < 0:
+        # A negative offset would UNDERSHOOT the startup coverage check
+        # (horizon + (C-1)*off shrinks) while the traced gather clamps
+        # its negative indices to 0 — silently wrong weather instead of
+        # a loud error.
+        raise ValueError(
+            f"fleet.weather_offset_hours must be >= 0, got {off}")
+    return (c, int(f.get("seed_stride", 1)), off)
+
+
+def create_fleet_homes(config: dict, num_timesteps: int, dt: int,
+                       waterdraw_df: pd.DataFrame) -> list[dict[str, Any]]:
+    """Synthesize C independent communities (``fleet.communities``), each
+    drawn with its OWN seed (``random_seed + c * seed_stride``) so the
+    fleet is C distinct populations, not C copies.  Returns the flat
+    COMMUNITY-MAJOR list (community 0's homes, then community 1's, …);
+    names are prefixed ``c<k>-`` so a 100k-home fleet cannot collide in
+    the results.json / home_logs namespaces."""
+    n_comm, stride, _off = fleet_config(config)
+    if n_comm == 1:
+        return create_homes(config, num_timesteps, dt, waterdraw_df)
+    import copy as _copy
+
+    base_seed = int(config["simulation"]["random_seed"])
+    all_homes: list[dict[str, Any]] = []
+    for c in range(n_comm):
+        cfg_c = _copy.deepcopy(config)
+        cfg_c["simulation"]["random_seed"] = base_seed + c * stride
+        homes_c = create_homes(cfg_c, num_timesteps, dt, waterdraw_df)
+        for h in homes_c:
+            h["name"] = f"c{c}-{h['name']}"
+        all_homes.extend(homes_c)
+    return all_homes
+
+
+def fleet_spec_for(all_homes: list[dict], config: dict) -> FleetSpec | None:
+    """Derive the :class:`FleetSpec` from a community-major ``all_homes``
+    list + config (``None`` when ``fleet.communities == 1``).  Works on
+    freshly synthesized AND cache-reloaded home lists — everything is
+    recomputed from the list structure, so a reloaded
+    ``all_homes-<N>-config.json`` reconstructs the identical fleet.
+
+    Raises when the list is not C equal blocks each grouped by type —
+    the slicing the type-bucketed fleet engine depends on."""
+    n_comm, stride, off_hours = fleet_config(config)
+    if n_comm == 1:
+        return None
+    n_total = len(all_homes)
+    if n_total % n_comm:
+        raise ValueError(
+            f"fleet of {n_comm} communities needs len(all_homes) divisible "
+            f"by it, got {n_total}")
+    B = n_total // n_comm
+    dt = int(config["agg"]["subhourly_steps"])
+    base_seed = int(config["simulation"]["random_seed"])
+    codes = np.asarray([TYPE_CODES[h["type"]] for h in all_homes])
+    # Per-community type runs must be identical across blocks (same config
+    # synthesizes the same counts) and grouped (create_homes order).
+    ranges0 = type_bucket_ranges(codes[:B])
+    if ranges0 is None:
+        raise ValueError("fleet communities must be grouped by home type "
+                         "(the create_homes materialization order)")
+    for c in range(1, n_comm):
+        if type_bucket_ranges(codes[c * B:(c + 1) * B]) != ranges0:
+            raise ValueError(
+                f"fleet community {c} has a different type partition than "
+                f"community 0 — all communities must share one config")
+    # Type-major fleet order: for each type run, every community's slice.
+    order = np.concatenate([
+        np.arange(c * B + a, c * B + b)
+        for (_t, a, b) in ranges0 for c in range(n_comm)])
+    community = order // B
+    local_idx = order % B
+    return FleetSpec(
+        n_communities=n_comm,
+        homes_per_community=B,
+        seeds=tuple(base_seed + c * stride for c in range(n_comm)),
+        community=community.astype(np.int32),
+        global_idx=order.astype(np.int32),
+        local_idx=local_idx.astype(np.int32),
+        env_offset=(community * off_hours * dt).astype(np.int32),
+    )
+
+
+def build_fleet_batch(all_homes: list[dict], config: dict, horizon: int,
+                      dt: int, sub_steps: int):
+    """(HomeBatch, FleetSpec | None) for a community-major ``all_homes``
+    list: the batch rows are the TYPE-MAJOR fleet order (``spec.global_idx``
+    maps them back), so ``type_bucket_ranges`` sees C·B_type contiguous
+    homes per type and the bucketed engine compiles ONE pattern per type
+    regardless of C.  With ``fleet.communities == 1`` this is exactly
+    :func:`build_home_batch`."""
+    spec = fleet_spec_for(all_homes, config)
+    if spec is None:
+        return build_home_batch(all_homes, horizon, dt, sub_steps), None
+    ordered = [all_homes[i] for i in spec.global_idx]
+    return build_home_batch(ordered, horizon, dt, sub_steps), spec
+
+
 class HomeBatch(NamedTuple):
     """Struct-of-arrays community, padded to the superset (pv_battery) shape.
 

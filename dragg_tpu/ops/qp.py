@@ -396,8 +396,11 @@ def assemble_qp_step(
     lay: QPLayout,
     batch,
     *,
-    oat_window,        # (H+1,) environment slice — oat_window[k] = OAT at t+k
-    ghi_window,        # (H+1,) GHI slice — ghi_window[k] = GHI at t+k
+    oat_window,        # (H+1,) environment slice — oat_window[k] = OAT at
+                       # t+k; (n_homes, H+1) under fleet weather offsets
+                       # (per-home windows, engine._prepare)
+    ghi_window,        # (H+1,) GHI slice — ghi_window[k] = GHI at t+k;
+                       # (n_homes, H+1) under fleet weather offsets
     price_total,       # (n_homes, H) discounting NOT applied; rp + tou
     draw_frac,         # (n_homes, H+1) draw fractions for this step (index 0 = current)
     temp_in_init,      # (n_homes,)
@@ -421,15 +424,18 @@ def assemble_qp_step(
     vals = static.vals.at[:, static.whmix_pos].set(whmix_vals).astype(dtype)
 
     oat = jnp.asarray(oat_window)
+    # Per-home windows (fleet weather offsets) arrive 2-D; the shared
+    # scalar window broadcasts through the same (., H) row writes.
+    oat = oat if oat.ndim == 2 else oat[None, :]
     b = jnp.zeros((n_homes, lay.m_eq), dtype=dtype)
     b = b.at[:, lay.r_tin0].set(temp_in_init)
     b = b.at[:, lay.r_tind : lay.r_tind + H].set(
-        (static.a_in[:, None] / jnp.asarray(batch.hvac_r)[:, None]) * oat[None, 1 : H + 1]
+        (static.a_in[:, None] / jnp.asarray(batch.hvac_r)[:, None]) * oat[:, 1 : H + 1]
     )
     b = b.at[:, lay.r_twh0].set(temp_wh_init)
     b = b.at[:, lay.r_twhd : lay.r_twhd + H].set(draw_frac[:, 1:] * TAP_TEMP * static.kwh[:, None])
     b = b.at[:, lay.r_tin1].set(
-        temp_in_init * static.kin + static.a_in / jnp.asarray(batch.hvac_r) * oat[1]
+        temp_in_init * static.kin + static.a_in / jnp.asarray(batch.hvac_r) * oat[:, 1]
     )
     b = b.at[:, lay.r_twh1].set(temp_wh_init * static.kwh)
     if lay.has_batt:
@@ -490,11 +496,12 @@ def assemble_qp_step(
         # dropped from q (it shifts the objective, not the argmin) and the
         # u_curt coefficient is +w*price*s*pvc (dragg/mpc_calc.py:380-385,410-432).
         ghi = jnp.asarray(ghi_window).astype(dtype)
+        ghi = ghi if ghi.ndim == 2 else ghi[None, :]
         pvc = (
             jnp.asarray(batch.pv_area)[:, None]
             * jnp.asarray(batch.pv_eff)[:, None]
             * jnp.asarray(batch.has_pv)[:, None]
-            * ghi[None, :H]
+            * ghi[:, :H]
             / 1000.0
         ).astype(dtype)
         q = q.at[:, lay.i_curt : lay.i_curt + H].set(wp * s * pvc)
@@ -561,7 +568,8 @@ def recover_solution(x, lay: QPLayout, batch, ghi_window, price_total, s: float)
     p_ch = x[:, lay.i_pch : lay.i_pch + H] if lay.has_batt else zH
     p_disch = x[:, lay.i_pd : lay.i_pd + H] if lay.has_batt else zH
     u_curt = x[:, lay.i_curt : lay.i_curt + H] if lay.has_curt else zH
-    ghi = jnp.asarray(ghi_window)[None, :H]
+    ghi = jnp.asarray(ghi_window)
+    ghi = (ghi if ghi.ndim == 2 else ghi[None, :])[:, :H]
     pvc = (
         jnp.asarray(batch.pv_area)[:, None]
         * jnp.asarray(batch.pv_eff)[:, None]

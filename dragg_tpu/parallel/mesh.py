@@ -74,7 +74,7 @@ class ShardedEngine(Engine):
 
     def __init__(self, params: EngineParams, batch, env_oat, env_ghi, env_tou,
                  check_mask=None, mesh: Mesh | None = None,
-                 axis_name: str = HOMES_AXIS):
+                 axis_name: str = HOMES_AXIS, fleet=None):
         if mesh is None:
             mesh = make_mesh(axis_name=axis_name)
         self.mesh = mesh
@@ -100,7 +100,7 @@ class ShardedEngine(Engine):
             check_mask = np.pad(np.asarray(check_mask, dtype=np.float64),
                                 (0, batch.n_homes - self.true_n_homes)) * pad_mask
         super().__init__(params, batch, env_oat, env_ghi, env_tou,
-                         check_mask=check_mask)
+                         check_mask=check_mask, fleet=fleet)
 
         shard = NamedSharding(mesh, P(axis_name))
         rep = NamedSharding(mesh, P())
@@ -117,6 +117,8 @@ class ShardedEngine(Engine):
             # the homes sharding.  The engine-level superset copies stay
             # unsharded — the bucketed trace never reads them, and jit
             # drops unused inputs at compile.
+            from dragg_tpu.engine import _TypeBucket
+
             for c in self._buckets:
                 st = c.static
                 c.static = type(st)(
@@ -127,16 +129,21 @@ class ShardedEngine(Engine):
                     kwh=put_s(st.kwh), awr=put_s(st.awr),
                 )
                 c.batch = type(c.batch)(*[put_s(f) for f in c.batch])
-                c.draws = put_s(c.draws)
-                c.tank = put_s(c.tank)
-                c.check_mask = put_s(c.check_mask)
-                c.home_idx = put_s(c.home_idx)
+                # Every per-home bucket constant (draws/tank/check_mask +
+                # the fleet identity arrays) gets the homes sharding —
+                # iterated from ARRAY_ATTRS so a new per-home constant
+                # cannot silently stay replicated.
+                for attr in _TypeBucket.ARRAY_ATTRS:
+                    setattr(c, attr, put_s(getattr(c, attr)))
             return
         # Sharded per-home device constants (superset batch).
         self._draws = put_s(self._draws)
         self._tank = put_s(self._tank)
         self._check_mask = put_s(self._check_mask)
         self._home_idx = put_s(self._home_idx)
+        self._noise_idx = put_s(self._noise_idx)
+        self._home_key = put_s(self._home_key)
+        self._env_off = put_s(self._env_off)
         # QP static: shared sparsity indices stay host-side numpy constants;
         # per-home coefficient arrays are sharded.
         st = self.static
@@ -156,7 +163,8 @@ class ShardedEngine(Engine):
 
 
 def make_sharded_engine(batch, env, config, start_index: int,
-                        mesh: Mesh | None = None) -> ShardedEngine:
+                        mesh: Mesh | None = None,
+                        fleet=None) -> ShardedEngine:
     """Sharded counterpart of :func:`dragg_tpu.engine.make_engine`."""
     from dragg_tpu.engine import check_mask_for, engine_params
 
@@ -164,4 +172,5 @@ def make_sharded_engine(batch, env, config, start_index: int,
     return ShardedEngine(
         engine_params(config, start_index), batch, env.oat, env.ghi, env.tou,
         check_mask=check_mask_for(batch, config), mesh=mesh, axis_name=axis,
+        fleet=fleet,
     )

@@ -1,0 +1,46 @@
+"""Sanctioned in-process device enumeration (round 12, ISSUE 8
+satellite).
+
+``jax.devices()`` initializes the backend, and on this repo's hardware a
+wedged axon tunnel makes that initialization HANG — which is why
+``tools/lint.py`` rejects bare device calls in entry-point scope
+(CLAUDE.md gotchas).  Code that genuinely needs the device count from
+inside a process that is *already committed* to touching the backend
+(the aggregator's sharding auto-resolution, the engine build — both of
+which commit device arrays moments later, and both of which run inside
+supervised children on every shipped path: ``run --supervised``, bench,
+validate_scale, the serve worker pool) routes through
+:func:`device_count` instead, so the discipline has exactly one
+documented escape hatch and the lint scope can keep widening.
+
+Import rule: this module imports jax lazily inside the function — the
+jax-free resilience parents can import the package without pulling in a
+backend.
+"""
+
+from __future__ import annotations
+
+
+def default_platform() -> str:
+    """The initialized backend's platform name ("cpu" / "tpu" / …), via
+    the same sanctioned in-process site as :func:`device_count` — same
+    contract: callers are already device-committed."""
+    import jax
+
+    return jax.default_backend()  # device-call-ok: the sanctioned helper — see module docstring
+
+
+def device_count() -> int:
+    """Number of visible devices, via the one sanctioned in-process
+    backend-init site.
+
+    Callers must already be on a device-committed path (a supervised
+    child, or a process about to build an engine): this call can hang on
+    a wedged tunnel exactly like the engine build that follows it would,
+    so it adds no NEW hang risk there — but it must never appear in a
+    jax-free supervising parent (use ``liveness.check_liveness`` to probe
+    from those).
+    """
+    import jax
+
+    return len(jax.devices())  # device-call-ok: the sanctioned helper — see module docstring

@@ -115,6 +115,11 @@ METRICS: dict[str, tuple[str, str]] = {
                                  "achieved sim-timesteps/s per chunk"),
     "engine.collect_s": ("histogram",
                          "host collect seconds per chunk"),
+    "engine.overlap_hidden_s": ("histogram",
+                                "host collect/checkpoint seconds per "
+                                "chunk PROVABLY hidden behind the next "
+                                "chunk's device execution (pipeline "
+                                "lower bound — aggregator.run_baseline)"),
     "engine.solve_iters": ("histogram",
                            "mean solver iterations per step (one sample "
                            "per chunk)"),

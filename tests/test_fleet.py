@@ -1,0 +1,427 @@
+"""Multi-community fleet axis (round 12 — ISSUE 8, architecture.md §14).
+
+Parity contract: C communities folded into ONE fleet batch must
+reproduce C independent single-community runs — objectives, applied k=0
+actions, physical state — with the established cross-batch-shape
+tolerances (tests/test_bucketed.py convention: the fleet batch buckets /
+shards at different shapes than a standalone community, so per-home
+trajectories are identical math modulo fp reassociation).  Same-shape
+compositions (unbucketed fleet vs unbucketed standalone) are BIT-exact:
+the forecast-noise stream is keyed on (community seed, within-community
+index), invariant to fleet composition by construction
+(engine._prepare).
+
+Heavy parametrizations are slow-marked with lighter siblings in tier-1
+(round-11 budget convention).
+"""
+
+import copy
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+
+from dragg_tpu.config import default_config
+from dragg_tpu.data import load_environment, load_waterdraw_profiles
+from dragg_tpu.engine import OBS_FIELDS, make_engine
+from dragg_tpu.homes import (
+    build_fleet_batch,
+    create_fleet_homes,
+    fleet_spec_for,
+)
+
+
+def _fleet_cfg(n=16, pv=6, bat=2, pvb=2, horizon=2, communities=2,
+               seed_stride=5, weather_off=0):
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = n
+    cfg["community"]["homes_pv"] = pv
+    cfg["community"]["homes_battery"] = bat
+    cfg["community"]["homes_pv_battery"] = pvb
+    cfg["home"]["hems"]["prediction_horizon"] = horizon
+    cfg["fleet"]["communities"] = communities
+    cfg["fleet"]["seed_stride"] = seed_stride
+    cfg["fleet"]["weather_offset_hours"] = weather_off
+    # The IPM's tail compaction gathers the worst ipm_tail_frac of the
+    # BATCH — its membership (hence the tail homes' final iterates within
+    # solver tolerance) legitimately depends on batch composition.  Pin
+    # it off so these tests isolate the fleet fold itself: with a
+    # composition-invariant solver path, same-shape fleet-vs-standalone
+    # comparisons are BIT-exact and cross-shape ones pure fp wobble.
+    cfg["tpu"]["ipm_tail_frac"] = 0.0
+    return cfg
+
+
+def _build(cfg, sharded=False, mesh_devices=8, start_index=0, env=None):
+    # Synthetic weather is seeded by simulation.random_seed — standalone
+    # comparison runs must REUSE the fleet run's environment (pass env),
+    # or a different community seed would also mean different weather.
+    if env is None:
+        env = load_environment(cfg, data_dir="")
+    wd = load_waterdraw_profiles(None, seed=12)
+    dt = int(cfg["agg"]["subhourly_steps"])
+    homes = create_fleet_homes(cfg, 24 * dt, dt, wd)
+    H = int(cfg["home"]["hems"]["prediction_horizon"]) * dt
+    batch, fleet = build_fleet_batch(
+        homes, cfg, H, dt, int(cfg["home"]["hems"]["sub_subhourly_steps"]))
+    if sharded:
+        from dragg_tpu.parallel import make_mesh, make_sharded_engine
+
+        eng = make_sharded_engine(batch, env, cfg, start_index,
+                                  mesh=make_mesh(mesh_devices), fleet=fleet)
+    else:
+        eng = make_engine(batch, env, cfg, start_index, fleet=fleet)
+    return homes, batch, fleet, eng, env
+
+
+# ------------------------------------------------------------------- spec
+def test_fleet_spec_structure():
+    """C communities, own seeds, community-major list with prefixed
+    names, type-major batch order, per-community env offsets."""
+    cfg = _fleet_cfg(communities=3, seed_stride=7, weather_off=2)
+    wd = load_waterdraw_profiles(None, seed=12)
+    homes = create_fleet_homes(cfg, 24, 1, wd)
+    assert len(homes) == 48
+    assert homes[0]["name"].startswith("c0-")
+    assert homes[16]["name"].startswith("c1-")
+    # Distinct populations, not copies: different seeds draw different
+    # parameters for the "same" home slot.
+    assert homes[0]["hvac"]["r"] != homes[16]["hvac"]["r"]
+    spec = fleet_spec_for(homes, cfg)
+    assert spec.n_communities == 3 and spec.homes_per_community == 16
+    assert spec.seeds == (12, 19, 26)
+    # global_idx is a permutation of the community-major order; local =
+    # global % B; env offsets are per community in sim steps (dt=1).
+    assert sorted(spec.global_idx.tolist()) == list(range(48))
+    np.testing.assert_array_equal(spec.local_idx, spec.global_idx % 16)
+    np.testing.assert_array_equal(spec.env_offset, spec.community * 2)
+    # Type-major: each type's rows are contiguous and cover all
+    # communities before the next type starts.
+    types = [homes[i]["type"] for i in spec.global_idx]
+    seen = []
+    for t in types:
+        if t not in seen:
+            seen.append(t)
+    assert seen == ["pv_battery", "pv_only", "battery_only", "base"]
+
+    # A C=1 config is NOT a fleet (the pre-round-12 engine unchanged).
+    cfg1 = _fleet_cfg(communities=1)
+    homes1 = create_fleet_homes(cfg1, 24, 1, wd)
+    assert fleet_spec_for(homes1, cfg1) is None
+    assert not homes1[0]["name"].startswith("c0-")
+
+    # Malformed configs/lists are refused loudly (a negative offset
+    # would undershoot the coverage check while the traced gather clamps
+    # — silently wrong weather).
+    cfg_neg = _fleet_cfg(weather_off=-2)
+    with pytest.raises(ValueError, match="weather_offset_hours"):
+        fleet_spec_for(homes, cfg_neg)
+    with pytest.raises(ValueError, match="divisible"):
+        fleet_spec_for(homes[:-1], cfg)
+    shuffled = homes[:16][::-1] + homes[16:]
+    with pytest.raises(ValueError, match="grouped|partition"):
+        fleet_spec_for(shuffled, cfg)
+
+
+# ----------------------------------------------------------------- parity
+@pytest.fixture(scope="module")
+def fleet_runs():
+    """One C=2 fleet chunk + the two standalone community chunks it must
+    reproduce (module-scoped: three engine compiles shared by the parity
+    assertions).  32 fleet homes with a non-superset-heavy mix →
+    ``tpu.bucketed=auto`` buckets the FLEET while each 16-home standalone
+    stays unbucketed, so this exercises the cross-shape tolerance class
+    too."""
+    cfg = _fleet_cfg()
+    homes, batch, fleet, eng, env = _build(cfg)
+    assert eng.bucketed  # 32 homes, 62% non-superset → auto buckets
+    assert eng.n_communities == 2
+    rps = np.zeros((3, eng.params.horizon), np.float32)
+    _, out_fleet = eng.run_chunk(eng.init_state(), 0, rps)
+
+    solo_outs, solo_cols = [], []
+    for c in range(2):
+        cfg_c = copy.deepcopy(cfg)
+        cfg_c["fleet"]["communities"] = 1
+        cfg_c["simulation"]["random_seed"] = 12 + 5 * c
+        _h, _b, f_c, eng_c, _e = _build(cfg_c, env=env)
+        assert f_c is None and not eng_c.bucketed
+        _, o = eng_c.run_chunk(eng_c.init_state(), 0, rps)
+        solo_outs.append(o)
+        solo_cols.append(eng_c.real_home_cols)
+    return cfg, eng, out_fleet, solo_outs, solo_cols
+
+
+def _per_home(outs, cols):
+    host = {}
+    for f in outs._fields:
+        if f in OBS_FIELDS:
+            continue
+        a = np.asarray(getattr(outs, f))
+        host[f] = a[:, cols] if a.ndim == 2 else a
+    return host
+
+
+def _assert_community_match(fl, so, s):
+    """tests/test_bucketed.py tolerance class: solvedness exact,
+    objectives/state to solver tolerance, applied integer actions within
+    one rounding flip."""
+    np.testing.assert_array_equal(fl["correct_solve"], so["correct_solve"])
+    np.testing.assert_allclose(fl["cost"], so["cost"], rtol=1e-2, atol=2e-3)
+    for key in ("hvac_cool_on", "hvac_heat_on", "wh_heat_on"):
+        assert np.max(np.abs(fl[key] * s - so[key] * s)) <= 1 + 1e-3, key
+    np.testing.assert_allclose(fl["temp_in"], so["temp_in"], atol=1e-3)
+    np.testing.assert_allclose(fl["temp_wh"], so["temp_wh"], atol=1e-3)
+    # Battery coordinates are near-degenerate in the objective at mW
+    # magnitudes (test_bucketed docstring: degenerate variables may
+    # legitimately differ across batch shapes): a ~0.01 kW charge wiggle
+    # costs ~1e-3 — inside the solver's eps — so these carry a loose
+    # 0.02 kW / kWh bound (0.2 % of capacity); the tight invariants are
+    # cost/temps/solvedness/duty counts above.
+    np.testing.assert_allclose(fl["e_batt"], so["e_batt"], atol=2e-2)
+    np.testing.assert_allclose(fl["p_batt_ch"], so["p_batt_ch"], atol=2e-2)
+    np.testing.assert_allclose(fl["p_batt_disch"], so["p_batt_disch"],
+                               atol=2e-2)
+
+
+def test_fleet_matches_standalone_communities(fleet_runs):
+    """Each community's slice of the fleet output equals its standalone
+    run; the fleet aggregate is the sum of the standalone aggregates."""
+    cfg, eng, out_fleet, solo_outs, solo_cols = fleet_runs
+    s = eng.params.s
+    cols = eng.real_home_cols
+    B = eng.fleet.homes_per_community
+    agg_sum = np.zeros_like(np.asarray(out_fleet.agg_load))
+    for c in range(2):
+        fl = _per_home(out_fleet, cols[c * B:(c + 1) * B])
+        so = _per_home(solo_outs[c], solo_cols[c])
+        _assert_community_match(fl, so, s)
+        agg_sum = agg_sum + np.asarray(solo_outs[c].agg_load)
+    np.testing.assert_allclose(np.asarray(out_fleet.agg_load), agg_sum,
+                               rtol=1e-3, atol=1e-2)
+
+
+def test_fleet_real_home_pairs(fleet_runs):
+    """(community, col) mapping: row j names community j//B and the
+    output column carrying home j — consistent with real_home_cols."""
+    _cfg, eng, _o, _so, _sc = fleet_runs
+    pairs = eng.real_home_pairs
+    B = eng.fleet.homes_per_community
+    assert pairs.shape == (2 * B, 2)
+    np.testing.assert_array_equal(pairs[:, 0], np.arange(2 * B) // B)
+    np.testing.assert_array_equal(pairs[:, 1], eng.real_home_cols)
+    # Every true home appears exactly once.
+    assert len(set(pairs[:, 1].tolist())) == 2 * B
+
+
+def test_fleet_checkpoint_roundtrip(fleet_runs):
+    """The fleet state (per-bucket tuple sized C·B_type per bucket)
+    survives save/load through the structure-agnostic pytree checkpoint
+    — the community axis resumes (light sibling of the slow aggregator
+    resume test)."""
+    from dragg_tpu.checkpoint import load_pytree, save_pytree
+
+    _cfg, eng, _o, _so, _sc = fleet_runs
+    # 3-step chunks reuse the fixture's compiled scan (the scan length is
+    # baked into the program — a different length would recompile).
+    rps = np.zeros((3, eng.params.horizon), np.float32)
+    state, _ = eng.run_chunk(eng.init_state(), 0, rps)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "state.npz")
+        save_pytree(path, state)
+        restored = load_pytree(path, eng.init_state())
+    for st, rt in zip(state, restored):
+        for name, a, b in zip(st._fields, st, rt):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+    # Resuming from the restored carry continues identically.
+    _, o1 = eng.run_chunk(state, 3, rps)
+    _, o2 = eng.run_chunk(restored, 3, rps)
+    np.testing.assert_array_equal(np.asarray(o1.p_grid),
+                                  np.asarray(o2.p_grid))
+
+
+def test_fleet_unbucketed_is_bit_exact():
+    """Same-shape composition control: an UNBUCKETED fleet (tiny
+    communities, bucketing off) reproduces each standalone run
+    bit-for-bit — the noise/key/draw streams are provably composition-
+    invariant, not merely tolerance-close."""
+    cfg = _fleet_cfg(n=6, pv=1, bat=1, pvb=1, communities=2)
+    cfg["tpu"]["bucketed"] = "false"
+    _h, _b, fleet, eng, env = _build(cfg)
+    assert not eng.bucketed
+    rps = np.zeros((2, eng.params.horizon), np.float32)
+    _, out = eng.run_chunk(eng.init_state(), 0, rps)
+    cols = eng.real_home_cols
+    for c in range(2):
+        cfg_c = copy.deepcopy(cfg)
+        cfg_c["fleet"]["communities"] = 1
+        cfg_c["simulation"]["random_seed"] = 12 + 5 * c
+        _h2, _b2, _f2, eng_c, _e = _build(cfg_c, env=env)
+        _, o = eng_c.run_chunk(eng_c.init_state(), 0, rps)
+        for f in out._fields:
+            if f in OBS_FIELDS:
+                continue
+            a = np.asarray(getattr(out, f))
+            b = np.asarray(getattr(o, f))
+            if a.ndim == 2:
+                np.testing.assert_array_equal(
+                    a[:, cols[c * 6:(c + 1) * 6]],
+                    b[:, eng_c.real_home_cols], err_msg=f)
+
+
+def test_fleet_weather_offsets():
+    """fleet.weather_offset_hours shifts community c's environment
+    windows by c·offset steps: community 1's trajectory equals a
+    standalone run whose start_index is advanced by the offset, and
+    offset 0 keeps the scalar shared-window program path."""
+    cfg = _fleet_cfg(n=6, pv=1, bat=1, pvb=1, communities=2, weather_off=3)
+    cfg["tpu"]["bucketed"] = "false"
+    _h, fleet_batch, fleet, eng, env = _build(cfg)
+    assert eng._per_home_env
+    rps = np.zeros((2, eng.params.horizon), np.float32)
+    _, out = eng.run_chunk(eng.init_state(), 0, rps)
+    cols = eng.real_home_cols
+
+    cfg1 = copy.deepcopy(cfg)
+    cfg1["fleet"]["communities"] = 1
+    cfg1["simulation"]["random_seed"] = 17
+    _h1, _b1, _f1, eng1, _e1 = _build(cfg1, start_index=3, env=env)
+    assert not eng1._per_home_env  # C=1 stays on the scalar path
+    _, o1 = eng1.run_chunk(eng1.init_state(), 0, rps)
+    np.testing.assert_allclose(
+        np.asarray(out.temp_in)[:, cols[6:]],
+        np.asarray(o1.temp_in)[:, eng1.real_home_cols], atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(out.p_grid)[:, cols[6:]],
+        np.asarray(o1.p_grid)[:, eng1.real_home_cols], atol=1e-3)
+
+
+def test_fleet_sharded_8dev_mesh_tiny():
+    """Light 8-device leg: a C=2 fleet on the conftest CPU mesh (shard-
+    padded type buckets holding both communities) matches the
+    single-device fleet run.  The bench-mix heavy leg is slow-marked
+    below."""
+    assert len(jax.devices()) == 8, "conftest pins the 8-device CPU mesh"
+    cfg = _fleet_cfg(n=8, pv=3, bat=1, pvb=1, communities=2)
+    _h, _b, fleet, eng, env = _build(cfg)     # single-device fleet
+    _h2, _b2, fleet2, sh, _e = _build(cfg, sharded=True, env=env)
+    rps = np.zeros((2, eng.params.horizon), np.float32)
+    _, o1 = eng.run_chunk(eng.init_state(), 0, rps)
+    _, o2 = sh.run_chunk(sh.init_state(), 0, rps)
+    c1, c2 = eng.real_home_cols, sh.real_home_cols
+    assert len(c2) == 16 and len(set(c2.tolist())) == 16
+    np.testing.assert_array_equal(
+        np.asarray(o1.correct_solve)[:, c1],
+        np.asarray(o2.correct_solve)[:, c2])
+    np.testing.assert_allclose(np.asarray(o1.temp_in)[:, c1],
+                               np.asarray(o2.temp_in)[:, c2], atol=1e-3)
+    np.testing.assert_allclose(np.asarray(o1.agg_load),
+                               np.asarray(o2.agg_load),
+                               rtol=1e-3, atol=1e-2)
+
+
+@pytest.mark.slow  # heavy 8-dev leg; light sibling: test_fleet_sharded_8dev_mesh_tiny
+def test_fleet_sharded_8dev_mesh_bench_mix(fleet_runs):
+    """The parity fixture's fleet on the 8-device mesh: per-bucket shard
+    padding over C·B_type homes, outputs mapped back through the fleet's
+    community-major order, vs the standalone community runs."""
+    cfg, eng, _of, solo_outs, solo_cols = fleet_runs
+    _h, _b, fleet, sh, _e = _build(cfg, sharded=True)
+    assert sh.bucketed
+    for b in sh.bucket_info():
+        assert b["n_slots"] % 8 == 0 and b["n_slots"] > 0
+    rps = np.zeros((3, sh.params.horizon), np.float32)
+    _, out = sh.run_chunk(sh.init_state(), 0, rps)
+    cols = sh.real_home_cols
+    B = sh.fleet.homes_per_community
+    for c in range(2):
+        fl = _per_home(out, cols[c * B:(c + 1) * B])
+        so = _per_home(solo_outs[c], solo_cols[c])
+        _assert_community_match(fl, so, sh.params.s)
+
+
+# ----------------------------------------------------- aggregator pipeline
+def _agg_cfg(end="2015-01-03 00", pipeline=True, communities=2):
+    cfg = _fleet_cfg(n=6, pv=1, bat=1, pvb=1, communities=communities)
+    cfg["simulation"]["start_datetime"] = "2015-01-01 00"
+    cfg["simulation"]["end_datetime"] = end
+    cfg["telemetry"]["enabled"] = False
+    cfg["fleet"]["pipeline"] = pipeline
+    return cfg
+
+
+def _run_agg(cfg, outdir, stop_after=None):
+    import json
+
+    from dragg_tpu.aggregator import Aggregator
+
+    a = Aggregator(copy.deepcopy(cfg), data_dir="", outputs_dir=outdir)
+    if stop_after is not None:
+        a.stop_after_chunks = stop_after
+    a.run()
+    with open(os.path.join(a.run_dir, "baseline", "results.json")) as f:
+        return a, json.load(f)
+
+
+def test_fleet_pipeline_identity(tmp_path):
+    """The double-buffered pipeline is a pure scheduling change: a fleet
+    run with fleet.pipeline=true produces byte-identical per-home series
+    and Summary aggregates to the synchronous loop, and reports the new
+    phase keys."""
+    _a1, r1 = _run_agg(_agg_cfg(pipeline=True), str(tmp_path / "on"))
+    _a2, r2 = _run_agg(_agg_cfg(pipeline=False), str(tmp_path / "off"))
+    s1, s2 = r1["Summary"], r2["Summary"]
+    assert s1["p_grid_aggregate"] == s2["p_grid_aggregate"]
+    assert s1["fleet"]["communities"] == 2
+    assert s1["num_homes"] == 12
+    for k in ("overlap_hidden_s", "state_snapshot"):
+        assert k in s1["phase_times"]
+    homes = [k for k in r1 if k != "Summary"]
+    assert len(homes) == 12
+    for h in homes:
+        for series, vals in r1[h].items():
+            if isinstance(vals, list):
+                assert vals == r2[h][series], (h, series)
+
+
+@pytest.mark.slow  # aggregator-level resume (3 runs); light sibling: test_fleet_checkpoint_roundtrip
+def test_fleet_aggregator_resume(tmp_path):
+    """Kill-at-checkpoint + resume across the community axis: a fleet
+    run stopped after its first chunk and resumed reproduces the
+    straight-through run's results.json exactly."""
+    cfg = _agg_cfg()
+    _a, ref = _run_agg(cfg, str(tmp_path / "full"))
+    cfg_r = copy.deepcopy(cfg)
+    cfg_r["simulation"]["resume"] = True
+    a1, _r1 = _run_agg(cfg_r, str(tmp_path / "resumed"), stop_after=1)
+    assert a1.timestep < a1.num_timesteps
+    a2, r2 = _run_agg(cfg_r, str(tmp_path / "resumed"))
+    assert a2.resumed_from is not None
+    for h in (k for k in ref if k != "Summary"):
+        for series, vals in ref[h].items():
+            if isinstance(vals, list):
+                assert vals == r2[h][series], (h, series)
+
+
+def test_fleet_run_shape_invalidates_on_communities(tmp_path):
+    """A checkpoint written at one fleet size must not resume at another
+    — ``communities`` is part of run_shape."""
+    from dragg_tpu.aggregator import Aggregator
+
+    a2 = Aggregator(_agg_cfg(), data_dir="", outputs_dir=str(tmp_path))
+    a1 = Aggregator(_agg_cfg(communities=1), data_dir="",
+                    outputs_dir=str(tmp_path))
+    assert a2._run_shape()["communities"] == 2
+    assert a1._run_shape()["communities"] == 1
+    assert a2._run_shape() != a1._run_shape()
+
+    # RL cases refuse a fleet loudly (ROADMAP item 5 owns that).
+    cfg = _agg_cfg()
+    cfg["simulation"]["run_rl_agg"] = True
+    a = Aggregator(cfg, data_dir="", outputs_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="ROADMAP item 5"):
+        a.run()

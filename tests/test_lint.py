@@ -61,6 +61,32 @@ def test_device_discipline_scoping():
         os.path.join(ROOT, "dragg_tpu", "serve", "worker.py"))
     assert not lint._is_serve_scope(
         os.path.join(ROOT, "dragg_tpu", "engine.py"))
+    # ISSUE 8: the aggregator's entry paths joined the scope — its one
+    # sanctioned device enumeration routes through
+    # resilience.devices.device_count, so any bare jax.devices() that
+    # reappears there is flagged.
+    assert lint._is_entry_point(
+        os.path.join(ROOT, "dragg_tpu", "aggregator.py"))
+    # The sanctioned helper's module itself stays out of entry scope
+    # (documented single escape hatch).
+    assert not lint._is_entry_point(
+        os.path.join(ROOT, "dragg_tpu", "resilience", "devices.py"))
+
+
+def test_aggregator_has_no_bare_device_calls():
+    """The satellite's teeth: aggregator.py must contain no bare
+    jax.devices()/local_devices()/default_backend() (ISSUE 8 routed the
+    round-8 sharding probe through resilience.devices.device_count)."""
+    lint = _load_lint()
+    import ast
+
+    path = os.path.join(ROOT, "dragg_tpu", "aggregator.py")
+    with open(path) as f:
+        src = f.read()
+    problems = lint.check_device_discipline(
+        ast.parse(src), src.splitlines(), "dragg_tpu/aggregator.py")
+    assert problems == [], problems
+    assert "device_count" in src  # the sanctioned route is actually used
 
 
 def test_accept_loop_discipline():

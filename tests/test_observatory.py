@@ -362,6 +362,31 @@ def test_bench_trend_degraded_soft_key(tmp_path):
     assert trend["rows"][0]["rate_verdict"] == "stable"
 
 
+def test_bench_trend_communities_hard_key(tmp_path):
+    """Fleet rows (ISSUE 8): ``communities`` is a HARD series key — a
+    C-community artifact never pairs with single-community history (a
+    fleet's rate at the same per-community shape is a different
+    workload), while same-C fleet rows pair and gate normally.  Era
+    default: artifacts that predate the field read communities=1."""
+    arts = [
+        _bench_line(2.0, 0.50, 1),                      # pre-fleet era → C=1
+        _bench_line(0.3, 0.50, 2, communities=10),      # fleet row: no pair,
+                                                        # would read as an
+                                                        # -85% "regression"
+        _bench_line(0.29, 0.51, 3, communities=10),     # fleet vs fleet: pairs
+    ]
+    rc, trend = _trend(tmp_path, arts, extra=("--gate",))
+    assert rc == 0, trend
+    assert len(trend["rows"]) == 1
+    row = trend["rows"][0]
+    assert row["key"]["communities"] == 10
+    assert row["rate_verdict"] == "stable"
+    # And a genuine fleet-series regression still gates.
+    arts.append(_bench_line(0.15, 0.51, 4, communities=10))
+    rc, trend = _trend(tmp_path, arts, extra=("--gate",))
+    assert rc == 1 and trend["n_regressions"] == 1
+
+
 def test_bench_trend_committed_series():
     """The committed BENCH_r01–r05 artifacts reproduce the known
     trajectory: the r02→r03 1000-home window improved, the r04→r05

@@ -50,7 +50,8 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-HARD_KEY = ("metric", "platform", "solver", "semantics", "data")
+HARD_KEY = ("metric", "platform", "solver", "semantics", "data",
+            "communities")
 
 
 def _round_ordinal(path: str, fallback: int) -> int:
@@ -117,7 +118,7 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
                   for k, v in hists.items() if k.startswith(pfx)}
         return dict(source=source, ordinal=ordinal,
                     metric="metrics_snapshot", platform="?", solver="?",
-                    semantics="?", data="?", bucketed=False,
+                    semantics="?", data="?", communities=1, bucketed=False,
                     fallback=False, degraded=None,
                     value=float(gauges.get("bench.rate_ts_per_s", 0.0)),
                     solve_rate=gauges.get("engine.solve_rate"),
@@ -131,6 +132,12 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         # Era defaults for pre-field artifacts (module docstring).
         semantics=rec.get("semantics", "relaxation"),
         data=rec.get("data", "synthetic"),
+        # Fleet size is a HARD key (round 12): a C-community rate is a
+        # different workload than a single community at the same
+        # per-community shape, so fleet rows form their own series and
+        # never gate against single-community history.  Era default:
+        # pre-fleet artifacts measured one community.
+        communities=int(rec.get("communities", 1)),
         bucketed=bool(rec.get("bucketed", False)),
         fallback=bool(rec.get("fallback", False)),
         degraded=rec.get("degraded"),
@@ -250,8 +257,10 @@ def print_table(trend: dict, out=sys.stderr) -> None:
           file=out)
     for r in trend["rows"]:
         k = r["key"]
+        fleet = (f"/{k['communities']}comm" if k.get("communities", 1) != 1
+                 else "")
         print(f"  {k['metric']} [{k['platform']}/{k['solver']}/"
-              f"{k['semantics']}/{k['data']}] "
+              f"{k['semantics']}/{k['data']}{fleet}] "
               f"{r['from_source']} → {r['to_source']}", file=out)
         print(f"    rate  {r['rate'][0]:.3f} → {r['rate'][1]:.3f} "
               f"({_fmt_pct(r['rate_delta'])}) {r['rate_verdict']}",

@@ -8,8 +8,10 @@ what the stdlib can check:
 * every Python file parses (`check-ast` parity);
 * no unused imports (autoflake parity; `# noqa` opt-out honored);
 * no tabs in indentation, no trailing whitespace, newline at EOF;
-* device-call discipline in `tools/`, `bench.py`, and `dragg_tpu/serve/`
-  (round 6; serve added by ISSUE 7): no bare
+* device-call discipline in `tools/`, `bench.py`, `dragg_tpu/serve/`,
+  and `dragg_tpu/aggregator.py` (round 6; serve added by ISSUE 7, the
+  aggregator's entry paths by ISSUE 8 — its one sanctioned device
+  enumeration is ``resilience.devices.device_count``): no bare
   ``jax.devices()``/``jax.default_backend()``/``jax.local_devices()`` —
   a wedged tunnel hangs backend init, so device calls in entry points
   must run inside a supervised/probed child (dragg_tpu/resilience);
@@ -88,8 +90,13 @@ class ImportUsage(ast.NodeVisitor):
 
 
 # Entry-point files where every device touch must be supervised or
-# probed: tools/ CLIs and the bench harness (CLAUDE.md gotcha — never
-# bare jax.devices()).
+# probed: tools/ CLIs, the bench harness, the serving daemon, and (round
+# 12) the aggregator's engine-build / simulation entry paths — the
+# aggregator runs inside supervised children on every shipped path, and
+# its one legitimate device enumeration routes through the sanctioned
+# helper (dragg_tpu.resilience.devices.device_count) so a future bare
+# call can't sneak back in (CLAUDE.md gotcha — never bare
+# jax.devices()).
 _DEVICE_CALLS = {"devices", "local_devices", "default_backend"}
 _SUBPROCESS_FNS = {"run", "check_output", "check_call", "call"}
 _DEVICE_MARKER = "# device-call-ok:"
@@ -98,6 +105,7 @@ _DEVICE_MARKER = "# device-call-ok:"
 def _is_entry_point(path: str) -> bool:
     rel = os.path.relpath(path, ROOT)
     return (rel == "bench.py" or rel.startswith("tools" + os.sep)
+            or rel == os.path.join("dragg_tpu", "aggregator.py")
             or _is_serve_scope(path))
 
 

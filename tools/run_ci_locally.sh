@@ -58,6 +58,17 @@ step "test/serve-soak-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   bash -c 'python tools/serve_soak.py --smoke | tee /tmp/serve_soak_smoke.json &&
            python -c "import json; r=json.load(open(\"/tmp/serve_soak_smoke.json\")); assert r[\"ok\"], r[\"violations\"]"'
 
+# --- job: fleet smoke (ISSUE 8): 4 communities × 64 homes folded into one
+#     batched fleet engine (type buckets hold C·B_type homes under one
+#     compiled pattern set); asserts solve rate, comfort bands, finiteness,
+#     and the community-major output mapping at a CI-sized shape
+step "test/fleet-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  bash -c 'python tools/validate_scale.py --communities 4 --homes 64 \
+             --horizon-hours 4 --days 1 --chunk 12 --solver ipm \
+             --min-solve-rate 0.8 \
+             | tee /tmp/fleet_smoke.json &&
+           python -c "import json; r=json.load(open(\"/tmp/fleet_smoke.json\")); assert r[\"ok\"] and r[\"communities\"]==4 and r[\"homes_total\"]==256, r"'
+
 # --- job: bench-trend gate (round 9): the committed BENCH_r*.json series
 #     must show no like-for-like regression (comparability rules per
 #     CLAUDE.md; tools/bench_trend.py docstring)

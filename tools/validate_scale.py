@@ -25,7 +25,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--homes", type=int, default=10_000)
+    ap.add_argument("--homes", type=int, default=10_000,
+                    help="homes PER COMMUNITY (fleet total = homes × "
+                         "--communities)")
+    ap.add_argument("--communities", type=int, default=1,
+                    help="fleet size C (round 12): validate C independent "
+                         "communities folded into one batched fleet "
+                         "engine (per-community seeds; type buckets hold "
+                         "C·B_type homes under one compiled pattern set)")
+    ap.add_argument("--weather-offset-hours", type=int, default=0,
+                    help="fleet.weather_offset_hours: community c's "
+                         "environment windows shift c× this many hours")
     ap.add_argument("--horizon-hours", type=int, default=48)
     ap.add_argument("--days", type=int, default=2)
     ap.add_argument("--chunk", type=int, default=8)
@@ -97,12 +107,15 @@ def main():
     from dragg_tpu.config import default_config
     from dragg_tpu.data import load_environment, load_waterdraw_profiles
     from dragg_tpu.engine import make_engine
-    from dragg_tpu.homes import build_home_batch, create_homes
+    from dragg_tpu.homes import build_fleet_batch, create_fleet_homes
     from dragg_tpu.parallel.mesh import make_sharded_engine
 
     cfg = default_config()
     n = args.homes
     cfg["community"]["total_number_homes"] = n
+    cfg["fleet"]["communities"] = args.communities
+    cfg["fleet"]["weather_offset_hours"] = args.weather_offset_hours
+    n_total = n * args.communities
     # Population mix: default is the bench mix; --mix exercises
     # bucket-heavy (0,0,0 = all base) and superset-only (0,0,1)
     # communities without editing config.
@@ -134,22 +147,26 @@ def main():
     dt = int(cfg["agg"]["subhourly_steps"])
     wd = load_waterdraw_profiles(waterdraw_path(cfg, args.data_dir), seed=12)
     num_ts = args.days * 24 * dt
-    homes = create_homes(cfg, num_ts, dt, wd)
+    homes = create_fleet_homes(cfg, num_ts, dt, wd)
     hems = cfg["home"]["hems"]
-    batch = build_home_batch(homes, args.horizon_hours * dt, dt,
-                             int(hems["sub_subhourly_steps"]))
+    batch, fleet = build_fleet_batch(homes, cfg, args.horizon_hours * dt, dt,
+                                     int(hems["sub_subhourly_steps"]))
     if args.sharded:
-        eng = make_sharded_engine(batch, env, cfg, 0)
+        eng = make_sharded_engine(batch, env, cfg, 0, fleet=fleet)
     else:
-        eng = make_engine(batch, env, cfg, 0)
+        eng = make_engine(batch, env, cfg, 0, fleet=fleet)
     state = eng.init_state()
     if args.steps:
         num_ts = args.steps
 
-    tin_min = np.asarray(batch.temp_in_min)
-    tin_max = np.asarray(batch.temp_in_max)
-    twh_min = np.asarray(batch.temp_wh_min)
-    twh_max = np.asarray(batch.temp_wh_max)
+    # Band bounds in COMMUNITY-MAJOR fleet order (the order real_home_cols
+    # maps outputs back to); identical to batch order when C == 1.
+    order = (np.argsort(np.asarray(fleet.global_idx)) if fleet is not None
+             else np.arange(batch.n_homes))
+    tin_min = np.asarray(batch.temp_in_min)[order]
+    tin_max = np.asarray(batch.temp_in_max)[order]
+    twh_min = np.asarray(batch.temp_wh_min)[order]
+    twh_max = np.asarray(batch.temp_wh_max)[order]
     band_tol = 0.05  # fp32 dynamics-row tolerance on ~degC scales
 
     from dragg_tpu.resilience.faults import fault_hook
@@ -195,7 +212,9 @@ def main():
     import resource
 
     result = {
-        "homes": n, "horizon_h": args.horizon_hours, "days": args.days,
+        "homes": n, "communities": args.communities, "homes_total": n_total,
+        "weather_offset_hours": args.weather_offset_hours,
+        "horizon_h": args.horizon_hours, "days": args.days,
         "steps": num_ts,
         "solver": args.solver,
         "platform": jax.devices()[0].platform,  # device-call-ok: supervised child
@@ -208,6 +227,9 @@ def main():
         "solve_rate": round(solve_rate, 4),
         "comfort_violation_max": round(viol_max, 5),
         "timesteps_per_s": round(num_ts / sum(chunk_times), 3),
+        # Scale-comparability rate: home-steps/s (fleet total homes ×
+        # ts/s) — the number that must stay flat as C grows (ISSUE 8).
+        "home_steps_per_s": round(n_total * num_ts / sum(chunk_times), 1),
         "total_s": round(time.perf_counter() - t_all, 1),
         "peak_rss_gb": round(
             resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2),
