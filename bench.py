@@ -90,11 +90,57 @@ def _log(msg: str) -> None:
     beat({"stage": msg[:120]})
 
 
+LEGACY_MIX = {"pv_only": 0.4, "battery_only": 0.1, "pv_battery": 0.1}
+
+
+def parse_mix(text: str | None) -> dict[str, float] | None:
+    """``--mix`` parser: comma-separated ``type=fraction`` pairs over the
+    full six-type vocabulary (homes.HOME_TYPES minus base, which takes the
+    remainder) — e.g. ``pv_only=0.3,ev=0.1,heat_pump=0.1``.  None = the
+    legacy bench mix (0.4/0.1/0.1), so historical invocations and
+    artifacts are unchanged."""
+    if text is None:
+        return None
+    from dragg_tpu.scenarios import MIX_KEYS
+
+    mix: dict[str, float] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        t, sep, frac = part.partition("=")
+        if t not in MIX_KEYS:
+            raise SystemExit(
+                f"--mix: unknown home type {t!r} (known: "
+                f"{','.join(sorted(MIX_KEYS))})")
+        try:
+            val = float(frac)
+        except ValueError:
+            val = -1.0
+        if not sep or not 0.0 <= val <= 1.0:
+            raise SystemExit(
+                f"--mix: {part!r} must be <type>=<fraction in [0, 1]>")
+        mix[t] = val
+    if sum(mix.values()) > 1.0 + 1e-9:
+        raise SystemExit(f"--mix fractions sum to {sum(mix.values()):.3f} > 1")
+    return mix
+
+
+def mix_label(mix: dict[str, float] | None, pack: str | None) -> str:
+    """Canonical composition label — tools/bench_trend.py keys the trend
+    series on it (a mix or pack change is a different workload, never a
+    perf signal), so it must be deterministic across invocations."""
+    base = ("legacy" if mix is None
+            else ",".join(f"{t}={mix[t]:g}" for t in sorted(mix)))
+    return f"{base}+pack:{pack}" if pack else base
+
+
 def build(n_homes: int, horizon_hours: int, admm_iters: int,
           solver: str = "admm", band_kernel: str | None = None,
           data_dir: str | None = None, semantics: str = "default",
           bucketed: str = "auto", per_home_obs: str = "true",
-          communities: int = 1):
+          communities: int = 1, mix: dict[str, float] | None = None,
+          pack: str | None = None):
     """Build THE benchmark community engine (population mix, sim window,
     solver config).  This is the one definition of the measured community —
     tools/bench_engine_kernels.py reuses it so kernel A/B verdicts are
@@ -115,11 +161,18 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
     cfg = default_config()
     cfg["community"]["total_number_homes"] = n_homes
     cfg["fleet"]["communities"] = communities
-    # Mixed population, reference default ratio-ish: 40% PV, 10% battery,
-    # 10% pv_battery.
-    cfg["community"]["homes_pv"] = int(0.4 * n_homes)
-    cfg["community"]["homes_battery"] = int(0.1 * n_homes)
-    cfg["community"]["homes_pv_battery"] = int(0.1 * n_homes)
+    # Mixed population — default is the legacy bench mix (40% PV, 10%
+    # battery, 10% pv_battery); --mix swaps in any six-type composition
+    # and --pack layers a scenario pack (whose [mix] fractions override
+    # these counts — apply_scenarios below).
+    from dragg_tpu.scenarios import MIX_KEYS, apply_scenarios
+
+    for t, key in MIX_KEYS.items():
+        frac = (mix if mix is not None else LEGACY_MIX).get(t, 0.0)
+        cfg["community"][key] = int(frac * n_homes)
+    if pack:
+        cfg["scenarios"]["pack"] = pack
+    cfg = apply_scenarios(cfg, data_dir or None)
     cfg["simulation"]["start_datetime"] = "2015-01-01 00"
     cfg["simulation"]["end_datetime"] = "2015-01-08 00"
     cfg["home"]["hems"]["prediction_horizon"] = horizon_hours
@@ -165,7 +218,8 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
     _log("pallas self-test (first TPU kernel compile)...")
     _log(f"pallas self-test: {pallas_band.available()}")
     _log("constructing engine (device commit + jit wrap)...")
-    engine = make_engine(batch, env, cfg, 0, fleet=fleet)
+    engine = make_engine(batch, env, cfg, 0, fleet=fleet,
+                         data_dir=data_dir or None)
     _log(f"engine ready: band_kernel={engine.band_kernel} "
          f"bw={engine.band_bw} bucketed={engine.bucketed}")
     if engine.bucketed:
@@ -218,12 +272,14 @@ def run_measured(args) -> dict:
         raise RuntimeError("requested TPU but backend resolved to CPU")
 
     _log(f"building engine: {args.homes} homes, {args.horizon_hours}h horizon")
+    mix = parse_mix(args.mix)
     engine, np = build(args.homes, args.horizon_hours, args.admm_iters,
                        solver="admm" if args.solver == "auto" else args.solver,
                        data_dir=args.data_dir, semantics=args.semantics,
                        bucketed=args.bucketed,
                        per_home_obs=args.per_home_obs,
-                       communities=args.communities)
+                       communities=args.communities,
+                       mix=mix, pack=args.pack)
     solver_used = engine.params.solver
     if args.solver == "auto":
         # Race the two solver families over SEVERAL sequential steps and
@@ -240,7 +296,8 @@ def run_measured(args) -> dict:
                                   semantics=args.semantics,
                                   bucketed=args.bucketed,
                                   per_home_obs=args.per_home_obs,
-                                  communities=args.communities)
+                                  communities=args.communities,
+                                  mix=mix, pack=args.pack)
 
             def steps_time(eng, k=6, budget_s=60.0):
                 """Mean warm-step time over up to k steps, stopping early
@@ -421,6 +478,8 @@ def run_measured(args) -> dict:
             "pv_only": "bench.phase.solve_pv_only_s",
             "battery_only": "bench.phase.solve_battery_only_s",
             "base": "bench.phase.solve_base_s",
+            "ev": "bench.phase.solve_ev_s",
+            "heat_pump": "bench.phase.solve_heat_pump_s",
         }
         for bname, bfn in engine.bucket_solve_fns():
             jax.block_until_ready(bfn(state, jt, jrp, refresh, factor0))
@@ -601,6 +660,12 @@ def run_measured(args) -> dict:
         # trend series and never gate against single-community history.
         "communities": args.communities,
         "homes_total": args.homes * args.communities,
+        # Population composition + scenario pack (ROADMAP item 4):
+        # tools/bench_trend.py treats ``mix`` as a HARD series key — a
+        # scenario-pack / mix row is a different workload and never gates
+        # against the legacy 4-type history (era default: "legacy").
+        "mix": mix_label(mix, args.pack),
+        "pack": args.pack,
         # Compiled pattern count — flat in C by construction (the fleet
         # folds into the home axis; each type bucket holds C·B_type homes
         # under ONE pattern).  A value that grows with C is a fleet-axis
@@ -679,6 +744,10 @@ def child_argv(args, platform: str, attempt: int,
         "--per-home-obs", args.per_home_obs,
         "--communities", str(args.communities),
     ]
+    if args.mix is not None:
+        cmd += ["--mix", args.mix]
+    if args.pack is not None:
+        cmd += ["--pack", args.pack]
     if data_dir is not None:
         # "" is meaningful — it forces the synthetic generators (the
         # rounds-2..4 environment); dropping it would silently run the
@@ -700,6 +769,19 @@ def main() -> None:
                          "fleet engine; JSON gains communities/"
                          "homes_total fields and bench_trend keys the "
                          "series on C")
+    ap.add_argument("--mix", default=None,
+                    help="population composition as comma type=fraction "
+                         "pairs over pv_only/battery_only/pv_battery/ev/"
+                         "heat_pump (base takes the remainder), e.g. "
+                         "'pv_only=0.3,ev=0.1,heat_pump=0.1'; default = "
+                         "the legacy 0.4/0.1/0.1 bench mix.  The JSON "
+                         "gains a canonical 'mix' field that bench_trend "
+                         "treats as a HARD series key")
+    ap.add_argument("--pack", default=None,
+                    help="scenario pack name (data/packs/<name>.toml — "
+                         "docs/scenarios.md): its [mix] overrides the "
+                         "community counts and its [[events]] compile a "
+                         "DR/tariff-shock/outage timeline into the step")
     ap.add_argument("--horizon-hours", type=int, default=24)
     ap.add_argument("--steps", type=int, default=16, help="timesteps per timed chunk")
     ap.add_argument("--chunks", type=int, default=3, help="number of timed chunks")

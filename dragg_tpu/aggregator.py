@@ -28,6 +28,7 @@ from dragg_tpu.data import EnvironmentData, load_environment, load_waterdraw_pro
 from dragg_tpu.engine import Engine, StepOutputs, make_engine
 from dragg_tpu.homes import check_home_configs
 from dragg_tpu.logger import Logger
+from dragg_tpu.scenarios import describe_timeline, timeline_digest
 
 # Per-home series appended each timestep, in the reference's result-hash
 # vocabulary (dragg/aggregator.py:741-745) → StepOutputs field name.
@@ -46,6 +47,7 @@ _BASE_KEYS = {
 }
 _PV_KEYS = {"p_pv_opt": "p_pv", "u_pv_curt_opt": "u_pv_curt"}
 _BATT_KEYS = {"e_batt_opt": "e_batt", "p_batt_ch": "p_batt_ch", "p_batt_disch": "p_batt_disch"}
+_EV_KEYS = {"p_ev_ch_opt": "p_ev_ch", "e_ev_opt": "e_ev"}
 
 # Observatory (round 9): per-bucket conv-iters metric literals, the
 # bench.phase.solve_<type>_s precedent — absent buckets never observe.
@@ -54,6 +56,8 @@ _CONV_ITERS_METRICS = {
     "pv_only": "solver.conv_iters_pv_only",
     "battery_only": "solver.conv_iters_battery_only",
     "base": "solver.conv_iters_base",
+    "ev": "solver.conv_iters_ev",
+    "heat_pump": "solver.conv_iters_heat_pump",
     "superset": "solver.conv_iters_superset",
 }
 
@@ -102,6 +106,13 @@ class Aggregator:
             self.config = config
         else:
             self.config = load_config(config)
+        # Scenario packs expand declaratively BEFORE anything reads the
+        # community mix: [mix] fractions become community.homes_* counts
+        # and pack events merge into scenarios.events (idempotent —
+        # dragg_tpu/scenarios, docs/scenarios.md).
+        from dragg_tpu.scenarios import apply_scenarios
+
+        self.config = apply_scenarios(self.config, self.data_dir)
         self.check_type = self.config["simulation"]["check_type"]
         self.case = "baseline"
 
@@ -276,14 +287,16 @@ class Aggregator:
             from dragg_tpu.parallel import make_sharded_engine
 
             self.engine = make_sharded_engine(
-                batch, self.env, self.config, self.start_index, fleet=fleet)
+                batch, self.env, self.config, self.start_index, fleet=fleet,
+                data_dir=self.data_dir)
             self.log.logger.info(
                 f"sharded engine: {self.engine.mesh.devices.size} devices, "
                 f"{self.engine.n_homes} home slots "
                 f"({self.engine.true_n_homes} real)")
         else:
             self.engine = make_engine(batch, self.env, self.config,
-                                      self.start_index, fleet=fleet)
+                                      self.start_index, fleet=fleet,
+                                      data_dir=self.data_dir)
         if fleet is not None:
             self.log.logger.info(
                 f"fleet engine: {fleet.n_communities} communities × "
@@ -295,6 +308,9 @@ class Aggregator:
                 "type-bucketed engine: " + ", ".join(
                     f"{b['name']}×{b['n_real']} (m={b['m_eq']}, n={b['n_var']})"
                     for b in self.engine.bucket_info()))
+        evts = describe_timeline(getattr(self.engine, "_events", None))
+        if evts.get("events"):
+            self.log.logger.info(f"scenario event timeline: {evts}")
 
     # ------------------------------------------------------------- data mgmt
     def _home_selected(self, home: dict) -> bool:
@@ -307,6 +323,8 @@ class Aggregator:
             keys += list(_PV_KEYS)
         if "battery" in home["type"]:
             keys += list(_BATT_KEYS)
+        if home["type"] == "ev":
+            keys += list(_EV_KEYS)
         return keys
 
     def reset_collected_data(self) -> None:
@@ -397,7 +415,8 @@ class Aggregator:
             host[f] = a[:, cols] if a.ndim == 2 and f not in OBS_FIELDS \
                 else a
         n_steps = host["p_grid"].shape[0]
-        for out_key, field in (*_BASE_KEYS.items(), *_PV_KEYS.items(), *_BATT_KEYS.items()):
+        for out_key, field in (*_BASE_KEYS.items(), *_PV_KEYS.items(),
+                               *_BATT_KEYS.items(), *_EV_KEYS.items()):
             self.collector.add_chunk(out_key, host[field])
         agg_loads = host["agg_load"]
         self.baseline_agg_load_list.extend(float(v) for v in agg_loads)
@@ -858,6 +877,19 @@ class Aggregator:
                         if self.engine is not None and self.engine.bucketed
                         else None),
             "horizon": int(self.config["home"]["hems"]["prediction_horizon"]),
+            # Scenario dimension (docs/architecture.md §15): the carry
+            # gained the e_ev leaf (state_rev bump — pre-scenario
+            # checkpoints have fewer leaves and must start fresh, not
+            # crash load_pytree's leaf-count check), and an event
+            # timeline changes step semantics (grid caps / shocks) even
+            # at identical leaf shapes — keyed by a CONTENT digest of
+            # the dense series, so magnitude-only schedule edits (cap
+            # 3 kW → 1 kW) invalidate a resume too, not just window
+            # count changes.
+            "state_rev": 2,
+            "events": (timeline_digest(getattr(self.engine, "_events",
+                                               None))
+                       if self.engine is not None else None),
             # Shard files are per-process; a checkpoint from a different
             # process topology must start fresh, not mis-assemble.
             "process_count": __import__("jax").process_count(),

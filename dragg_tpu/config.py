@@ -132,6 +132,9 @@ _DEFAULT: dict[str, Any] = {
         "homes_battery": 0,
         "homes_pv": 4,
         "homes_pv_battery": 0,
+        "homes_ev": 0,           # scenario types (ROADMAP item 4,
+        "homes_heat_pump": 0,    # docs/architecture.md §15) — 0 keeps the
+                                 # reference's four-type population
         "overwrite_existing": True,
         "house_p_avg": 1.2,
     },
@@ -193,6 +196,23 @@ _DEFAULT: dict[str, Any] = {
             "discharge_eff": [0.97, 0.99],
         },
         "pv": {"area": [20, 32], "efficiency": [0.15, 0.2]},
+        # Scenario-type parameter distributions (uniform bounds, like every
+        # other [home.*] table; homes.EV_PARAM_DEFAULTS mirrors these so an
+        # unmodified reference TOML — which lacks the tables — still runs).
+        "ev": {
+            "capacity": [40.0, 80.0],
+            "max_rate": [3.3, 9.6],
+            "charge_eff": [0.88, 0.95],
+            "target_soc": [0.7, 0.9],
+            "init_soc": [0.3, 0.6],
+            "away_start": [7.0, 9.0],
+            "away_duration": [7.0, 10.0],
+            "trip_kwh": [6.0, 14.0],
+        },
+        "heat_pump": {
+            "cop_base": [2.4, 3.2],
+            "cop_slope": [0.04, 0.08],
+        },
         "hems": {
             "prediction_horizon": 6,
             "sub_subhourly_steps": 6,
@@ -289,6 +309,21 @@ _DEFAULT: dict[str, Any] = {
                                  # CPU serving (transition journaled,
                                  # provenance on every response); false
                                  # + --platform tpu = strict 429s
+    },
+    # Scenario packs + community event timelines (dragg_tpu/scenarios —
+    # ROADMAP item 4, docs/architecture.md §15, docs/scenarios.md; no
+    # reference analog: the reference knows one static tariff and four
+    # home types).
+    "scenarios": {
+        "pack": "",    # scenario-pack name (resolves data/packs/<name>.toml
+                       # or a literal .toml path): [mix] fractions expand
+                       # into community.homes_* counts, [[events]] merge
+                       # after the inline list below
+        "events": [],  # inline [[scenarios.events]] entries — kind =
+                       # tariff_shock|dr|outage with start_hour (sim-
+                       # relative), duration_hours, repeat_hours,
+                       # communities, price_delta / p_cap_kw /
+                       # comfort_relax_degc (schema: docs/scenarios.md)
     },
     # Multi-community fleet engine (round 12 — ROADMAP item 3,
     # architecture.md §14; no reference analog: the reference runs one

@@ -42,13 +42,15 @@ from dragg_tpu.models.fallback import fallback_control
 from dragg_tpu.ops.admm import FactorCarry, admm_solve_qp_cached, init_factor_carry
 from dragg_tpu.ops.qp import (
     QPLayout,
-    SUPERSET_SPEC,
     TAP_TEMP,
     TYPE_SPECS,
     assemble_qp_step,
     build_qp_static,
+    ev_charge_bounds,
+    hp_cops,
     recover_solution,
     shift_warm_start,
+    superset_spec_for,
 )
 
 WINTER_MAX_OAT = 30.0  # season switch threshold, degC (dragg/mpc_calc.py:303)
@@ -128,11 +130,11 @@ class _TypeBucket:
     engine-level constants."""
 
     ARRAY_ATTRS = ("draws", "tank", "check_mask", "home_idx", "noise_idx",
-                   "home_key", "env_off")
+                   "home_key", "env_off", "comm_idx")
 
     def __init__(self, *, name, spec, lay, comm_start, n_real, start_slot,
                  n, static, batch, draws, tank, check_mask, home_idx,
-                 noise_idx, home_key, env_off,
+                 noise_idx, home_key, env_off, comm_idx,
                  band_plan, solve_backend, ordinal=0):
         self.ordinal = ordinal      # position in engine._buckets (= the
                                     # bucket_info() row the observatory's
@@ -159,6 +161,8 @@ class _TypeBucket:
         self.home_key = home_key      # (n, 2) uint32 per-home base PRNG
                                       # key (the home's community seed)
         self.env_off = env_off        # (n,) int32 env-series offset
+        self.comm_idx = comm_idx      # (n,) int32 community index — the
+                                      # event-timeline row each home reads
         self.band_plan = band_plan
         self.solve_backend = solve_backend
 
@@ -171,15 +175,18 @@ class _SupersetView:
     through unchanged."""
 
     name = "superset"
-    spec = SUPERSET_SPEC
     comm_start = 0
     start_slot = 0
     ordinal = 0
 
     def __init__(self, eng):
         self._eng = eng
+        # The union spec of the types present (superset_spec_for) — equals
+        # the historical SUPERSET_SPEC for legacy populations.
+        self.spec = eng.layout.spec
 
     lay = property(lambda s: s._eng.layout)
+    comm_idx = property(lambda s: s._eng._comm_idx)
     static = property(lambda s: s._eng.static)
     batch = property(lambda s: s._eng.batch)
     draws = property(lambda s: s._eng._draws)
@@ -207,6 +214,9 @@ class CommunityState(NamedTuple):
     temp_in: jnp.ndarray     # (n,) one-step deterministic indoor temp
     temp_wh: jnp.ndarray     # (n,) WH temp BEFORE next step's draw mixing
     e_batt: jnp.ndarray      # (n,) battery SoC (kWh)
+    e_ev: jnp.ndarray        # (n,) EV SOC (kWh; zeros for non-EV homes —
+                             # the return-trip drain lands here, engine
+                             # §15 scenario types)
     counter: jnp.ndarray     # (n,) int32 solve_counter
     plan_cool: jnp.ndarray   # (n, H) last feasible raw-duty plans (replay source)
     plan_heat: jnp.ndarray   # (n, H)
@@ -264,6 +274,9 @@ class StepOutputs(NamedTuple):
     e_batt: jnp.ndarray           # (n,) kWh
     p_batt_ch: jnp.ndarray        # (n,) kW
     p_batt_disch: jnp.ndarray     # (n,) kW (non-positive)
+    p_ev_ch: jnp.ndarray          # (n,) kW EV charging (0 for non-EV homes)
+    e_ev: jnp.ndarray             # (n,) kWh EV SOC after this step's
+                                  # action + any return-trip drain
     agg_load: jnp.ndarray         # () sum of p_grid over homes (the one
                                   # reduction in the system; psum-able)
     forecast_load: jnp.ndarray    # ()
@@ -399,10 +412,32 @@ class Engine:
     """
 
     def __init__(self, params: EngineParams, batch, env_oat, env_ghi, env_tou,
-                 check_mask=None, fleet=None):
+                 check_mask=None, fleet=None, events=None, hour0: int = 0):
         self.params = params
         self.batch = batch
-        lay = QPLayout(params.horizon)
+        # Scenario event timeline (docs/architecture.md §15): an inert /
+        # absent timeline keeps the pre-scenario program byte-for-byte
+        # (no gathers, no grid block, no extra device constants).
+        self._events = (None if events is None or events.inert else events)
+        if self._events is not None:
+            want_c = 1 if fleet is None else fleet.n_communities
+            if self._events.n_communities != want_c:
+                raise ValueError(
+                    f"event timeline covers {self._events.n_communities} "
+                    f"communities but the engine runs {want_c}")
+        self._grid_events = (self._events is not None
+                             and self._events.has_grid)
+        self._hour0 = int(hour0)  # hour of day at environment-series index
+                                  # 0 (EV away windows are wall-clock hours)
+        # The one-batch layout pads every home to the UNION of the specs
+        # of the types present (superset_spec_for) — identical to the
+        # historical pv_battery superset for legacy populations; an
+        # active grid-event schedule additionally compiles the explicit
+        # p_grid block into every shape.
+        spec0 = superset_spec_for(batch.type_code)
+        if self._grid_events:
+            spec0 = spec0._replace(has_grid=True)
+        lay = QPLayout(params.horizon, spec0)
         self.layout = lay
         self.n_homes = batch.n_homes
         # ShardedEngine sets true_n_homes to the pre-padding population
@@ -423,6 +458,7 @@ class Engine:
             g_idx = np.arange(n_now)
             n_idx = np.arange(n_now)
             e_off = np.zeros(n_now, np.int32)
+            c_idx = np.zeros(n_now, np.int32)
             keys = np.broadcast_to(
                 np.asarray(jax.random.PRNGKey(params.seed), np.uint32),
                 (n_now, 2)).copy()
@@ -435,6 +471,7 @@ class Engine:
             g_idx = _padded(fleet.global_idx)
             n_idx = _padded(fleet.local_idx)
             e_off = _padded(fleet.env_offset).astype(np.int32)
+            c_idx = _padded(fleet.community).astype(np.int32)
             seed_keys = np.stack(
                 [np.asarray(jax.random.PRNGKey(int(s)), np.uint32)
                  for s in fleet.seeds])
@@ -443,6 +480,7 @@ class Engine:
             "home_idx": g_idx.astype(np.int64),
             "noise_idx": n_idx.astype(np.int32),
             "home_key": keys, "env_off": e_off,
+            "comm_idx": c_idx,
         }
         # Static trace-time switch: all-zero offsets keep the scalar
         # shared-window slice (byte-identical program to the pre-fleet
@@ -464,6 +502,20 @@ class Engine:
         self._oat = jnp.asarray(np.asarray(env_oat), dtype=jnp.float32)
         self._ghi = jnp.asarray(np.asarray(env_ghi), dtype=jnp.float32)
         self._tou = jnp.asarray(np.asarray(env_tou), dtype=jnp.float32)
+        # Device-resident event timeline (C, T) series — shared by every
+        # bucket like the environment series; only the ACTIVE families are
+        # committed (and traced), so e.g. a pure tariff-shock schedule
+        # compiles no grid block and no relax gather.
+        self._evt: dict = {}
+        if self._events is not None:
+            ev = self._events
+            if ev.has_price:
+                self._evt["price"] = jnp.asarray(ev.price, jnp.float32)
+            if ev.has_grid:
+                self._evt["cap"] = jnp.asarray(ev.cap, jnp.float32)
+                self._evt["floor"] = jnp.asarray(ev.floor, jnp.float32)
+            if ev.has_relax:
+                self._evt["relax"] = jnp.asarray(ev.relax, jnp.float32)
         # check_type mask: aggregate reductions include only selected homes
         # (the reference only simulates matching homes, dragg/aggregator.py:
         # 767-770; homes are independent, so simulating all and masking the
@@ -474,8 +526,10 @@ class Engine:
         from dragg_tpu.ops.banded import plan_for
 
         if not self._bucketed:
-            # Superset-shaped per-home device constants.
-            self.static = build_qp_static(batch, params.horizon, params.dt)
+            # Superset-shaped per-home device constants (the union spec of
+            # the types present — see layout above).
+            self.static = build_qp_static(batch, params.horizon, params.dt,
+                                          lay.spec)
             self._draws = jnp.asarray(np.asarray(batch.draws_hourly),
                                       dtype=jnp.float32)
             self._tank = jnp.asarray(np.asarray(batch.tank_size),
@@ -484,6 +538,7 @@ class Engine:
             self._noise_idx = jnp.asarray(self._fleet_rows["noise_idx"])
             self._home_key = jnp.asarray(self._fleet_rows["home_key"])
             self._env_off = jnp.asarray(self._fleet_rows["env_off"])
+            self._comm_idx = jnp.asarray(self._fleet_rows["comm_idx"])
             self._check_mask = jnp.asarray(np.asarray(check_mask),
                                            dtype=jnp.float32)
             # Resolve the "auto" solve backend HERE, where the mesh is
@@ -579,6 +634,11 @@ class Engine:
         slot = 0
         for ordinal, (tname, a, b) in enumerate(self._bucket_ranges):
             spec = TYPE_SPECS[tname]
+            if self._grid_events:
+                # Active grid events compile the explicit p_grid block
+                # into EVERY bucket's shape (events key per community,
+                # never per type).
+                spec = spec._replace(has_grid=True)
             blay = QPLayout(p.horizon, spec)
             sub = slice_batch(batch, a, b)
             sub, pmask = pad_batch(sub, shards)
@@ -604,13 +664,15 @@ class Engine:
                 noise_idx=_row_pad("noise_idx", a, b, n_slots),
                 home_key=_row_pad("home_key", a, b, n_slots),
                 env_off=_row_pad("env_off", a, b, n_slots),
+                comm_idx=_row_pad("comm_idx", a, b, n_slots),
                 band_plan=plan, solve_backend=backend, ordinal=ordinal,
             ))
             slot += n_slots
 
     # ------------------------------------------------- traced constant tree
     _CONST_ATTRS = ("_oat", "_ghi", "_tou", "_draws", "_tank", "_check_mask",
-                    "_home_idx", "_noise_idx", "_home_key", "_env_off")
+                    "_home_idx", "_noise_idx", "_home_key", "_env_off",
+                    "_comm_idx")
     _STATIC_ARRAYS = ("vals", "a_in", "a_wh", "kin", "kwh", "awr")
 
     def _consts(self):
@@ -630,6 +692,7 @@ class Engine:
             batch_t = tuple(self.batch)
         return {
             "attrs": attrs,
+            "events": dict(self._evt),
             "static": static_t,
             "batch": batch_t,
             "buckets": tuple(
@@ -654,10 +717,12 @@ class Engine:
                      {k: getattr(self, k) for k in consts["attrs"]},
                      [(c.static, c.batch,
                        {k: getattr(c, k) for k in _TypeBucket.ARRAY_ATTRS})
-                      for c in self._buckets])
+                      for c in self._buckets],
+                     self._evt)
             try:
                 for k, v in consts["attrs"].items():
                     setattr(self, k, v)
+                self._evt = consts.get("events", self._evt)
                 if consts["static"]:
                     self.static = self.static._replace(**consts["static"])
                 if consts["batch"]:
@@ -676,6 +741,7 @@ class Engine:
                     c.static, c.batch = cst, cb
                     for k, v in carrs.items():
                         setattr(c, k, v)
+                self._evt = saved[4]
 
         return cm()
 
@@ -869,6 +935,9 @@ class Engine:
             temp_in=jnp.asarray(b.temp_in_init, dtype=f32),
             temp_wh=jnp.asarray(b.temp_wh_init, dtype=f32),
             e_batt=jnp.asarray(b.e_batt_init_frac * b.batt_capacity, dtype=f32),
+            e_ev=(jnp.asarray(b.is_ev, dtype=f32)
+                  * jnp.asarray(b.ev_init_frac, dtype=f32)
+                  * jnp.asarray(b.ev_cap, dtype=f32)),
             counter=jnp.zeros((n,), dtype=jnp.int32),
             plan_cool=jnp.zeros((n, H), dtype=f32),
             plan_heat=jnp.zeros((n, H), dtype=f32),
@@ -967,7 +1036,42 @@ class Engine:
             price_total = rp[None, :].astype(f32) + tou_w[None, :]
             oat0, oat1 = oat_w[0], oat_w[1]
             oat_fore = oat_w[None, 1:]
+
+        # --- Community event windows (docs/architecture.md §15): per-step
+        # gathers from the (C, T) timeline series, routed per home through
+        # its community index — the fleet axis runs heterogeneous event
+        # schedules under one compiled pattern set.  Events are scheduled
+        # in SIM time (never weather-offset), so the window anchor is the
+        # scalar ``start`` even under fleet weather offsets.
+        def _evt_window(name, offset=0):
+            series = self._evt[name]                      # (C, T)
+            win = lax.dynamic_slice(
+                series, (0, start + offset), (series.shape[0], H))
+            return win[ctx.comm_idx]                      # (n, H)
+
+        if "price" in self._evt:
+            price_total = price_total + _evt_window("price")
+        grid_cap = _evt_window("cap") if "cap" in self._evt else None
+        grid_floor = _evt_window("floor") if "floor" in self._evt else None
+        # Comfort relief aligns with the BOUNDED T_in entries, which live
+        # at t+k+1 — one step ahead of the control window.
+        relax_w = _evt_window("relax", 1) if "relax" in self._evt else None
         price_total = jnp.broadcast_to(price_total, (n, H))
+
+        # --- EV availability / departure-deadline bounds (data, not
+        # structure — ops/qp.ev_charge_bounds; hour-of-day is wall clock:
+        # environment index → hour via the series' start hour).
+        if lay.has_ev:
+            ks_h = jnp.arange(H)
+            hod_ctrl = ((p.start_index + t + ks_h) // dt
+                        + self._hour0) % 24
+            hod_state = ((p.start_index + t + 1 + ks_h) // dt
+                         + self._hour0) % 24
+            ev_avail, ev_floor = ev_charge_bounds(
+                hod_ctrl, hod_state, b, state.e_ev, dt)
+            e_ev_init = state.e_ev
+        else:
+            ev_avail = ev_floor = e_ev_init = None
 
         # --- Seasonal gate on the noisy forecast (dragg/mpc_calc.py:217-223,302-309).
         # Per-home keys (not one (n, H) draw): each home's noise stream is
@@ -1010,6 +1114,9 @@ class Engine:
             e_batt_init=state.e_batt,
             cool_cap=cool_cap, heat_cap=heat_cap, wh_cap=s,
             discount=p.discount,
+            e_ev_init=e_ev_init, ev_avail=ev_avail, ev_floor=ev_floor,
+            grid_cap=grid_cap, grid_floor=grid_floor,
+            comfort_relax=relax_w,
         )
         aux = StepAux(
             draw0=draw_size[:, 0], temp_wh_init=temp_wh_init, oat1=oat1,
@@ -1185,8 +1292,18 @@ class Engine:
         lay = ctx.lay
         st, b = ctx.static, ctx.batch
         f32 = jnp.float32
-        pc = jnp.asarray(b.hvac_p_c, f32)
-        ph = jnp.asarray(b.hvac_p_h, f32)
+        a_in_eff = jnp.asarray(st.a_in, f32)
+        if len(st.hp_cool_pos):
+            # Heat-pump buckets: the k=0 THERMAL coefficients are the
+            # COP-scaled per-step values assemble wrote into the matrix —
+            # read them back from qp.vals (rows r_tind+0 / r_tin1 share
+            # them), so the closed-form k=1 band arithmetic below stays
+            # exact for COP != 1 homes (and bit-identical for COP == 1).
+            pc = qp.vals[:, int(st.hp_cool_pos[0])].astype(f32) / a_in_eff
+            ph = -qp.vals[:, int(st.hp_heat_pos[0])].astype(f32) / a_in_eff
+        else:
+            pc = jnp.asarray(b.hvac_p_c, f32)
+            ph = jnp.asarray(b.hvac_p_h, f32)
         pwh = jnp.asarray(b.wh_p, f32)
         a_in = jnp.asarray(st.a_in, f32)
         awr = jnp.asarray(st.awr, f32)
@@ -1425,6 +1542,19 @@ class Engine:
         wsol = warm_sol
 
         # --- Fallback for unsolved homes (dragg/mpc_calc.py:527-596).
+        # Heat-pump homes deliver COP(OAT)× thermal watts per electrical
+        # watt, so the fallback's bang-bang thermal simulation runs on the
+        # COP-scaled rates (the ELECTRICAL p_load below keeps the raw
+        # powers — only heat delivery scales).
+        pc_fb = jnp.asarray(b.hvac_p_c, f32)
+        ph_fb = jnp.asarray(b.hvac_p_h, f32)
+        if lay.has_hp:
+            oat1v = jnp.broadcast_to(jnp.asarray(aux.oat1, f32), (n,))
+            cop_c1, cop_h1 = hp_cops(oat1v[:, None], b.hp_cop_base,
+                                     b.hp_cop_slope)
+            is_hp_f = jnp.asarray(b.is_hp, f32)
+            pc_fb = pc_fb * (1.0 + is_hp_f * (cop_c1[:, 0].astype(f32) - 1.0))
+            ph_fb = ph_fb * (1.0 + is_hp_f * (cop_h1[:, 0].astype(f32) - 1.0))
         counter_inc = jnp.where(solved, 0, state.counter + 1)
         ridx = jnp.clip(counter_inc, 0, H - 1)[:, None]
         fb = fallback_control(
@@ -1434,7 +1564,7 @@ class Engine:
             jnp.take_along_axis(state.plan_wh, ridx, axis=1)[:, 0],
             state.temp_in, temp_wh_init, aux.oat1,
             jnp.asarray(b.hvac_r, f32), jnp.asarray(b.hvac_c, f32),
-            jnp.asarray(b.hvac_p_c, f32), jnp.asarray(b.hvac_p_h, f32),
+            pc_fb, ph_fb,
             jnp.asarray(b.wh_r, f32), jnp.asarray(b.wh_c, f32), jnp.asarray(b.wh_p, f32),
             jnp.asarray(b.temp_in_min, f32), jnp.asarray(b.temp_in_max, f32),
             jnp.asarray(b.temp_wh_min, f32), jnp.asarray(b.temp_wh_max, f32),
@@ -1454,12 +1584,34 @@ class Engine:
         p_d0 = pick(mpc.p_disch[:, 0], jnp.zeros((n,), f32))
         p_pv0 = pick(mpc.p_pv[:, 0], jnp.zeros((n,), f32))
         u_curt0 = pick(mpc.u_curt[:, 0], jnp.zeros((n,), f32))
+        # EV: applied k=0 charge + SOC advance; a vehicle returning between
+        # t and t+1 lands with the trip energy drained (the plant-side
+        # disturbance the receding horizon recovers from, like water
+        # draws — docs/architecture.md §15).
+        if lay.has_ev:
+            p_ev0 = pick(mpc.p_ev_ch[:, 0], jnp.zeros((n,), f32))
+            hod_t = ((p.start_index + t) // dt + self._hour0) % 24
+            hod_t1 = ((p.start_index + t + 1) // dt + self._hour0) % 24
+            a_s = jnp.asarray(b.ev_away_start, f32)
+            a_e = jnp.asarray(b.ev_away_end, f32)
+            away_now = (hod_t >= a_s) & (hod_t < a_e)
+            away_next = (hod_t1 >= a_s) & (hod_t1 < a_e)
+            returning = away_now & ~away_next
+            e_ev_next = pick(mpc.e_ev[:, 1], state.e_ev)
+            e_ev_next = jnp.where(
+                (jnp.asarray(b.is_ev, f32) > 0) & returning,
+                jnp.maximum(
+                    e_ev_next - jnp.asarray(b.ev_trip_kwh, f32), 0.0),
+                e_ev_next)
+        else:
+            p_ev0 = jnp.zeros((n,), f32)
+            e_ev_next = state.e_ev
         p_load0 = (
             jnp.asarray(b.hvac_p_c, f32) * cool0
             + jnp.asarray(b.hvac_p_h, f32) * heat0
             + jnp.asarray(b.wh_p, f32) * wh0
         )
-        p_grid0 = p_load0 + (p_ch0 + p_d0) - p_pv0
+        p_grid0 = p_load0 + (p_ch0 + p_d0 + p_ev0) - p_pv0
         price0 = price_total[:, 0]
         # Optimal path records cost on the raw (s-scaled) grid variable,
         # fallback on the physical one (dragg/mpc_calc.py:500 vs :594).
@@ -1487,6 +1639,7 @@ class Engine:
             temp_in=temp_in_next,
             temp_wh=temp_wh_next,
             e_batt=e_batt_next,
+            e_ev=e_ev_next,
             counter=jnp.where(solved, 0, fb.counter).astype(jnp.int32),
             plan_cool=jnp.where(sel2, mpc.cool, state.plan_cool),
             plan_heat=jnp.where(sel2, mpc.heat, state.plan_heat),
@@ -1515,6 +1668,8 @@ class Engine:
             e_batt=e_batt_next,
             p_batt_ch=p_ch0,
             p_batt_disch=p_d0,
+            p_ev_ch=p_ev0,
+            e_ev=e_ev_next,
             agg_load=jnp.sum(p_grid0 * ctx.check_mask),
             forecast_load=jnp.sum(fore * ctx.check_mask),
             agg_cost=jnp.sum(cost0 * ctx.check_mask),
@@ -1802,12 +1957,38 @@ def check_mask_for(batch, config) -> np.ndarray:
     return (np.asarray(batch.type_code) == TYPE_CODES[check_type]).astype(np.float64)
 
 
-def make_engine(batch, env, config, start_index: int, fleet=None) -> Engine:
+def resolve_engine_events(config, env, params, fleet=None, data_dir=None):
+    """The scenario event timeline an engine should close over — the
+    ``[scenarios]`` table resolved against the fleet size and environment
+    span (None when the config schedules nothing).  Shared by
+    :func:`make_engine` and the sharded constructor so the two cannot
+    disagree about what an event schedule means."""
+    from dragg_tpu.scenarios import timeline_for
+
+    n_comm = 1 if fleet is None else fleet.n_communities
+    return timeline_for(config, n_comm, len(np.asarray(env.oat)), params.dt,
+                        params.start_index, data_dir=data_dir)
+
+
+def env_hour0(env) -> int:
+    """Hour of day at environment-series index 0 (EV away windows are
+    wall-clock hours; the series starts at ``env.data_start``)."""
+    ds = getattr(env, "data_start", None)
+    return int(ds.hour) if ds is not None else 0
+
+
+def make_engine(batch, env, config, start_index: int, fleet=None,
+                events=None, data_dir=None) -> Engine:
     """Construct an :class:`Engine` from a HomeBatch + EnvironmentData +
     validated config dict.  ``fleet`` (a :class:`~dragg_tpu.homes.FleetSpec`
     from :func:`~dragg_tpu.homes.build_fleet_batch`) folds C independent
-    communities into the home axis."""
+    communities into the home axis.  ``events`` overrides the scenario
+    event timeline (default: resolved from the config's ``[scenarios]``
+    table — :func:`resolve_engine_events`)."""
     params = engine_params(config, start_index)
     mask = check_mask_for(batch, config)
+    if events is None:
+        events = resolve_engine_events(config, env, params, fleet=fleet,
+                                       data_dir=data_dir)
     return Engine(params, batch, env.oat, env.ghi, env.tou, check_mask=mask,
-                  fleet=fleet)
+                  fleet=fleet, events=events, hour0=env_hour0(env))

@@ -20,6 +20,7 @@ import numpy as np
 def assemble_community_qp(horizon_hours: int = 4, n_homes: int = 6,
                           homes_pv: int = 1, homes_battery: int = 1,
                           homes_pv_battery: int = 1,
+                          homes_ev: int = 0, homes_heat_pump: int = 0,
                           season: str = "heat",
                           return_inputs: bool = False):
     """Assemble the t=0 community QP for a seeded mixed community.
@@ -50,6 +51,8 @@ def assemble_community_qp(horizon_hours: int = 4, n_homes: int = 6,
     cfg["community"]["homes_pv"] = homes_pv
     cfg["community"]["homes_battery"] = homes_battery
     cfg["community"]["homes_pv_battery"] = homes_pv_battery
+    cfg["community"]["homes_ev"] = homes_ev
+    cfg["community"]["homes_heat_pump"] = homes_heat_pump
     cfg["home"]["hems"]["prediction_horizon"] = horizon_hours
     # This fixture extracts ONE superset-shaped QP via the engine's
     # whole-batch attributes (_draws/_tank/_oat/...), which a bucketed
@@ -91,6 +94,24 @@ def assemble_community_qp(horizon_hours: int = 4, n_homes: int = 6,
     heat_cap = np.full(n, float(s) if heat_season else 0.0)
     cool_cap = np.full(n, 0.0 if heat_season else float(s))
 
+    # EV availability / deadline bounds at t=0 — the SAME helper the
+    # engine's traced step uses (ops/qp.ev_charge_bounds), so the
+    # parity-tested EV matrices are the engine's matrices.
+    if lay.has_ev:
+        from dragg_tpu.engine import env_hour0
+        from dragg_tpu.ops.qp import ev_charge_bounds
+
+        hour0 = env_hour0(env)
+        t0 = p.start_index
+        hod_c = ((t0 + np.arange(p.horizon)) // dt + hour0) % 24
+        hod_s = ((t0 + 1 + np.arange(p.horizon)) // dt + hour0) % 24
+        e_ev0 = np.asarray(b.is_ev) * np.asarray(b.ev_init_frac) \
+            * np.asarray(b.ev_cap)
+        ev_avail, ev_floor = ev_charge_bounds(hod_c, hod_s, b, e_ev0, dt)
+        e_ev_init = jnp.asarray(e_ev0, dtype=jnp.float32)
+    else:
+        ev_avail = ev_floor = e_ev_init = None
+
     qp = assemble_qp_step(
         eng.static, lay, b,
         oat_window=oat_w, ghi_window=ghi_w, price_total=jnp.asarray(price),
@@ -102,6 +123,7 @@ def assemble_community_qp(horizon_hours: int = 4, n_homes: int = 6,
         cool_cap=jnp.asarray(cool_cap, dtype=jnp.float32),
         heat_cap=jnp.asarray(heat_cap, dtype=jnp.float32),
         wh_cap=s, discount=p.discount,
+        e_ev_init=e_ev_init, ev_avail=ev_avail, ev_floor=ev_floor,
     )
     if return_inputs:
         # Raw model inputs for INDEPENDENT re-derivations of the program

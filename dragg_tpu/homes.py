@@ -29,8 +29,35 @@ import pandas as pd
 from dragg_tpu.config import configured_solver
 from dragg_tpu.names_data import FIRST_NAMES
 
-HOME_TYPES = ("pv_battery", "pv_only", "battery_only", "base")
+# Home types.  The first four are the reference's (dragg/aggregator.py
+# per-type loops); "ev" and "heat_pump" are scenario types (ROADMAP item 4,
+# docs/architecture.md §15 — no reference analog), APPENDED so the legacy
+# type codes (and every artifact/checkpoint keyed on them) are unchanged.
+# Materialization order in create_homes is pv_battery, pv_only,
+# battery_only, ev, heat_pump, base — new-type parameter draws happen
+# inside their own loops, so a zero-count config consumes no RNG and
+# reproduces the pre-scenario population byte-for-byte.
+HOME_TYPES = ("pv_battery", "pv_only", "battery_only", "base", "ev",
+              "heat_pump")
 TYPE_CODES = {t: i for i, t in enumerate(HOME_TYPES)}
+
+# Scenario-type parameter distributions, used when a config predates the
+# [home.ev] / [home.heat_pump] tables (an unmodified reference TOML must
+# keep loading — config.REQUIRED_KEYS is NOT extended).
+EV_PARAM_DEFAULTS: dict[str, list] = {
+    "capacity": [40.0, 80.0],       # kWh usable pack
+    "max_rate": [3.3, 9.6],         # kW home charger
+    "charge_eff": [0.88, 0.95],
+    "target_soc": [0.7, 0.9],       # fraction of capacity due at departure
+    "init_soc": [0.3, 0.6],
+    "away_start": [7.0, 9.0],       # hour of day the vehicle departs
+    "away_duration": [7.0, 10.0],   # hours away (deadline window length)
+    "trip_kwh": [6.0, 14.0],        # SOC consumed by the daily trip
+}
+HP_PARAM_DEFAULTS: dict[str, list] = {
+    "cop_base": [2.4, 3.2],         # heating COP at 0 degC OAT
+    "cop_slope": [0.04, 0.08],      # COP change per degC (ops/qp.hp_cops)
+}
 
 
 def _uniform(rng_cfg, n):
@@ -62,6 +89,34 @@ def _pv_params(cfg: dict) -> dict:
         "area": np.random.uniform(p["area"][0], p["area"][1]),
         "eff": np.random.uniform(p["efficiency"][0], p["efficiency"][1]),
     }
+
+
+def _scenario_dist(tbl: dict, key: str, defaults: dict) -> float:
+    lo, hi = tbl.get(key, defaults[key])
+    return float(np.random.uniform(lo, hi))
+
+
+def _ev_params(cfg: dict) -> dict:
+    e = cfg["home"].get("ev", {})
+    d = lambda k: _scenario_dist(e, k, EV_PARAM_DEFAULTS)
+    cap = d("capacity")
+    start = d("away_start")
+    return {
+        "capacity": cap,
+        "max_rate": d("max_rate"),
+        "charge_eff": d("charge_eff"),
+        "target_soc": d("target_soc"),
+        "init_soc": d("init_soc"),
+        "away_start": start,
+        "away_end": start + d("away_duration"),
+        "trip_kwh": d("trip_kwh"),
+    }
+
+
+def _hp_params(cfg: dict) -> dict:
+    h = cfg["home"].get("heat_pump", {})
+    d = lambda k: _scenario_dist(h, k, HP_PARAM_DEFAULTS)
+    return {"cop_base": d("cop_base"), "cop_slope": d("cop_slope")}
 
 
 def create_homes(
@@ -152,7 +207,9 @@ def create_homes(
     n_pvb = int(comm.get("homes_pv_battery", 0))
     n_pv = int(comm.get("homes_pv", 0))
     n_b = int(comm.get("homes_battery", 0))
-    n_base = n - n_pvb - n_pv - n_b
+    n_ev = int(comm.get("homes_ev", 0))
+    n_hp = int(comm.get("homes_heat_pump", 0))
+    n_base = n - n_pvb - n_pv - n_b - n_ev - n_hp
     if n_base < 0:
         raise ValueError("Per-type home counts exceed total_number_homes")
 
@@ -174,6 +231,20 @@ def create_homes(
         battery = _battery_params(config)
         all_homes.append({"name": name, "type": "battery_only", **_common(i), "battery": battery})
         i += 1
+    # Scenario types (ROADMAP item 4) draw their parameters inside their
+    # own loops — zero counts consume no RNG, keeping legacy populations
+    # byte-identical — and sit BEFORE base so the list stays grouped by
+    # type (the bucketed engine's slicing invariant).
+    for _ in range(n_ev):
+        name = _make_name()
+        ev = _ev_params(config)
+        all_homes.append({"name": name, "type": "ev", **_common(i), "ev": ev})
+        i += 1
+    for _ in range(n_hp):
+        name = _make_name()
+        hp = _hp_params(config)
+        all_homes.append({"name": name, "type": "heat_pump", **_common(i), "heat_pump": hp})
+        i += 1
     for _ in range(n_base):
         name = _make_name()
         all_homes.append({"name": name, "type": "base", **_common(i)})
@@ -190,6 +261,8 @@ def check_home_configs(all_homes: list[dict], config: dict) -> None:
         "pv_battery": int(comm.get("homes_pv_battery", 0)),
         "pv_only": int(comm.get("homes_pv", 0)),
         "battery_only": int(comm.get("homes_battery", 0)),
+        "ev": int(comm.get("homes_ev", 0)),
+        "heat_pump": int(comm.get("homes_heat_pump", 0)),
     }
     expect["base"] = int(comm["total_number_homes"]) - sum(expect.values())
     for t, c in expect.items():
@@ -379,6 +452,20 @@ class HomeBatch(NamedTuple):
     batt_capacity: np.ndarray
     pv_area: np.ndarray
     pv_eff: np.ndarray
+    # Scenario types (ROADMAP item 4; zeros / identities for absent types
+    # so the legacy batch math is untouched).
+    is_ev: np.ndarray          # float 0/1
+    ev_cap: np.ndarray         # kWh
+    ev_rate: np.ndarray        # kW charger rate
+    ev_ch_eff: np.ndarray      # charge efficiency (1.0 default)
+    ev_init_frac: np.ndarray   # t=0 SOC fraction of ev_cap
+    ev_target_kwh: np.ndarray  # departure-deadline energy, kWh
+    ev_away_start: np.ndarray  # hour of day [0, 24)
+    ev_away_end: np.ndarray    # hour of day (may exceed 24 → clipped window)
+    ev_trip_kwh: np.ndarray    # SOC drained when the vehicle returns
+    is_hp: np.ndarray          # float 0/1
+    hp_cop_base: np.ndarray    # heating COP at 0 degC (1.0 default = resistive)
+    hp_cop_slope: np.ndarray   # COP per degC (0.0 default)
 
     @property
     def n_homes(self) -> int:
@@ -473,6 +560,19 @@ def build_home_batch(all_homes: list[dict], horizon: int, dt: int, sub_steps: in
             dtype=np.float64,
         )
 
+    def ev(key, default=0.0):
+        return np.array(
+            [float(h["ev"][key]) if "ev" in h else default for h in all_homes],
+            dtype=np.float64,
+        )
+
+    def hp(key, default=0.0):
+        return np.array(
+            [float(h["heat_pump"][key]) if "heat_pump" in h else default
+             for h in all_homes],
+            dtype=np.float64,
+        )
+
     capacity = batt("capacity")
     return HomeBatch(
         type_code=type_code,
@@ -502,4 +602,16 @@ def build_home_batch(all_homes: list[dict], horizon: int, dt: int, sub_steps: in
         batt_capacity=capacity,
         pv_area=np.array([float(h["pv"]["area"]) if "pv" in h else 0.0 for h in all_homes]),
         pv_eff=np.array([float(h["pv"]["eff"]) if "pv" in h else 0.0 for h in all_homes]),
+        is_ev=np.array([1.0 if "ev" in h else 0.0 for h in all_homes]),
+        ev_cap=ev("capacity"),
+        ev_rate=ev("max_rate"),
+        ev_ch_eff=ev("charge_eff", 1.0),
+        ev_init_frac=ev("init_soc"),
+        ev_target_kwh=ev("target_soc") * ev("capacity"),
+        ev_away_start=ev("away_start"),
+        ev_away_end=ev("away_end"),
+        ev_trip_kwh=ev("trip_kwh"),
+        is_hp=np.array([1.0 if "heat_pump" in h else 0.0 for h in all_homes]),
+        hp_cop_base=hp("cop_base", 1.0),
+        hp_cop_slope=hp("cop_slope", 0.0),
     )

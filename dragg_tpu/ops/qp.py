@@ -61,10 +61,32 @@ class HomeTypeSpec(NamedTuple):
     drops the absent blocks from the batched program instead of padding
     them to zero-width [0, 0] boxes — the type-bucketed engine solves
     each bucket at its own (n, m) shape (docs/architecture.md §10).
+
+    Scenario blocks (docs/architecture.md §15; no reference analog —
+    the reference knows only the four types above):
+
+    * ``has_ev`` — EV charging: ``p_ev_ch`` columns + ``e_ev`` SOC
+      evolution with pin/dynamics rows; departure deadlines and
+      away-window availability arrive as per-step box bounds (data, not
+      structure — :func:`ev_charge_bounds`).
+    * ``has_hp`` — heat-pump HVAC: no layout change at all; the thermal
+      coefficients of the HVAC dynamics rows become per-step values
+      scaled by the OAT-dependent COP curve (:func:`hp_cops`), exactly
+      like the water-mix band.
+    * ``has_grid`` — explicit grid-power block for community events
+      (DR curtailment caps / outage islanding): ``p_gr`` columns pinned
+      to the per-step physical grid power by equality rows, so event
+      windows are pure per-step box bounds on ``p_gr``.  Enabled
+      engine-wide when the scenario timeline contains any grid event
+      (never by a home type), so event-free runs keep the historical
+      shapes bit-for-bit.
     """
 
-    has_batt: bool   # p_ch / p_disch / e_batt columns + battery dynamics rows
-    has_curt: bool   # PV curtailment column (objective-only; no A_eq rows)
+    has_batt: bool          # p_ch / p_disch / e_batt columns + battery rows
+    has_curt: bool          # PV curtailment column (objective-only)
+    has_ev: bool = False    # EV charge column + SOC pin/dynamics rows
+    has_hp: bool = False    # COP-scaled HVAC thermal coefficients (per-step)
+    has_grid: bool = False  # explicit p_grid columns + defining rows
 
 
 SUPERSET_SPEC = HomeTypeSpec(has_batt=True, has_curt=True)
@@ -75,7 +97,84 @@ TYPE_SPECS: dict[str, HomeTypeSpec] = {
     "pv_only": HomeTypeSpec(has_batt=False, has_curt=True),
     "battery_only": HomeTypeSpec(has_batt=True, has_curt=False),
     "base": HomeTypeSpec(has_batt=False, has_curt=False),
+    "ev": HomeTypeSpec(has_batt=False, has_curt=False, has_ev=True),
+    "heat_pump": HomeTypeSpec(has_batt=False, has_curt=False, has_hp=True),
 }
+
+
+def superset_spec_for(type_code) -> HomeTypeSpec:
+    """The shape the one-batch (unbucketed) engine pads every home to:
+    the HISTORICAL superset (pv_battery — the floor, so every legacy
+    population keeps its pre-scenario program byte-for-byte, dead [0, 0]
+    battery/PV boxes included) unioned with the scenario blocks of the
+    types actually present — EV columns appear only when some home
+    carries them, and the heat-pump COP band only when some home scales
+    by it."""
+    from dragg_tpu.homes import HOME_TYPES
+
+    present = {HOME_TYPES[int(c)]
+               for c in np.unique(np.asarray(type_code))}
+    specs = [SUPERSET_SPEC] + [TYPE_SPECS[t] for t in present]
+    return HomeTypeSpec(*[any(getattr(s, f) for s in specs)
+                          for f in HomeTypeSpec._fields])
+
+
+# Heat-pump COP curve (docs/architecture.md §15): linear in OAT, clipped.
+# Heating COP improves with warmer outdoor air; cooling COP degrades as
+# the heat-rejection lift grows above HP_COOL_PIVOT.  Resistive homes are
+# the COP == 1 special case (the assemble path multiplies by 1 exactly).
+HP_COP_MIN = 1.0
+HP_COP_MAX = 6.0
+HP_COOL_PIVOT = 30.0  # degC: cooling COP = base at this OAT
+
+
+def hp_cops(oat, cop_base, cop_slope):
+    """(cool_cop, heat_cop) for an OAT window — broadcastable: ``oat`` is
+    (H,) or (n, H), ``cop_base``/``cop_slope`` are (n,) or (n, 1)."""
+    base = jnp.asarray(cop_base)
+    slope = jnp.asarray(cop_slope)
+    if base.ndim == 1:
+        base, slope = base[:, None], slope[:, None]
+    oat = jnp.asarray(oat)
+    oat2 = oat if oat.ndim == 2 else oat[None, :]
+    heat = jnp.clip(base + slope * oat2, HP_COP_MIN, HP_COP_MAX)
+    cool = jnp.clip(base + slope * (HP_COOL_PIVOT - oat2),
+                    HP_COP_MIN, HP_COP_MAX)
+    return cool, heat
+
+
+def ev_charge_bounds(hod_ctrl, hod_state, batch, e_ev_init, dt, eps=1e-3):
+    """Per-step EV box data for one assembled timestep (shared by the
+    engine's traced step and the parity fixtures, so the two cannot
+    drift): ``(avail, floor)``, both (n, H).
+
+    * ``avail[k]`` — 1 when the vehicle is home (chargeable) at control
+      step k: hour-of-day outside the [away_start, away_end) window.
+    * ``floor[k]`` — lower bound on ``e_ev[k+1]``: during away hours the
+      SOC must hold the departure target (charging completed BEFORE
+      departure — the deadline constraint), relaxed to the maximum
+      physically reachable SOC (init + cumulative charge capacity along
+      the availability mask, minus an fp32 slack) so a home that starts
+      behind schedule charges flat-out instead of going infeasible.
+
+    Non-EV homes read all-zero floors and all-zero availability masks
+    never bind (their rate bound is already [0, 0])."""
+    is_ev = jnp.asarray(batch.is_ev)[:, None]
+    a_start = jnp.asarray(batch.ev_away_start)[:, None]
+    a_end = jnp.asarray(batch.ev_away_end)[:, None]
+    hod_c = jnp.asarray(hod_ctrl)[None, :]
+    hod_s = jnp.asarray(hod_state)[None, :]
+    away_c = (hod_c >= a_start) & (hod_c < a_end)
+    avail = is_ev * (1.0 - away_c.astype(jnp.float32))
+    rate = jnp.asarray(batch.ev_rate)[:, None]
+    eff = jnp.asarray(batch.ev_ch_eff)[:, None]
+    reach = jnp.asarray(e_ev_init)[:, None] + jnp.cumsum(
+        avail * rate * eff / dt, axis=1)
+    away_s = (hod_s >= a_start) & (hod_s < a_end)
+    target = jnp.asarray(batch.ev_target_kwh)[:, None]
+    floor = jnp.where(away_s & (is_ev > 0),
+                      jnp.minimum(target, reach - eps), 0.0)
+    return avail, jnp.maximum(floor, 0.0)
 
 
 class QPLayout:
@@ -93,6 +192,9 @@ class QPLayout:
         self.spec = spec
         self.has_batt = bool(spec.has_batt)
         self.has_curt = bool(spec.has_curt)
+        self.has_ev = bool(spec.has_ev)
+        self.has_hp = bool(spec.has_hp)
+        self.has_grid = bool(spec.has_grid)
         i = 0
         self.i_cool = i; i += H          # noqa: E702 — index table reads as one block
         self.i_heat = i; i += H          # noqa: E702
@@ -102,16 +204,28 @@ class QPLayout:
             self.i_pd = i; i += H        # noqa: E702
         else:
             self.i_pch = self.i_pd = None
+        if self.has_ev:
+            self.i_evch = i; i += H      # noqa: E702
+        else:
+            self.i_evch = None
         if self.has_curt:
             self.i_curt = i; i += H      # noqa: E702
         else:
             self.i_curt = None
+        if self.has_grid:
+            self.i_pgr = i; i += H       # noqa: E702
+        else:
+            self.i_pgr = None
         self.i_tin = i; i += H + 1       # noqa: E702
         self.i_twh = i; i += H + 1       # noqa: E702
         if self.has_batt:
             self.i_eb = i; i += H + 1    # noqa: E702
         else:
             self.i_eb = None
+        if self.has_ev:
+            self.i_eev = i; i += H + 1   # noqa: E702
+        else:
+            self.i_eev = None
         self.i_tin1 = i; i += 1          # noqa: E702
         self.i_twh1 = i; i += 1          # noqa: E702
         self.n = i
@@ -128,6 +242,15 @@ class QPLayout:
             self.r_ebd = r; r += H       # noqa: E702  (H rows)
         else:
             self.r_eb0 = self.r_ebd = None
+        if self.has_ev:
+            self.r_eev0 = r; r += 1      # noqa: E702
+            self.r_eevd = r; r += H      # noqa: E702  (H rows)
+        else:
+            self.r_eev0 = self.r_eevd = None
+        if self.has_grid:
+            self.r_pgr = r; r += H       # noqa: E702  (H rows)
+        else:
+            self.r_pgr = None
         self.m_eq = r
         self.m = self.m_eq + self.n
 
@@ -279,14 +402,18 @@ def densify_A(pat: SparsePattern, vals) -> jnp.ndarray:
     ].add(vals)
 
 
+_NO_POS = np.zeros(0, dtype=np.int64)  # empty per-step-band position sentinel
+
+
 class HomeQPStatic(NamedTuple):
     """Per-home static pieces: the (row, col) sparsity (shared) plus the
     per-home coefficient values split into static entries and the indices of
-    the timestep-varying water-mix band."""
+    the timestep-varying bands (water mix; under scenarios also the
+    heat-pump COP thermal coefficients and the grid rows' PV terms)."""
 
     rows: np.ndarray          # (nnz,) shared across homes
     cols: np.ndarray          # (nnz,)
-    vals: jnp.ndarray         # (n_homes, nnz) — static values; wh-mix band filled per step
+    vals: jnp.ndarray         # (n_homes, nnz) — static values; per-step bands filled at assemble
     whmix_pos: np.ndarray     # (H,) positions in the nnz axis of the wh-mix coefficients
     pattern: SparsePattern    # gather-padded sparsity for the solver hot loop
     a_in: jnp.ndarray         # (n_homes,) 3600 / (C * dt)
@@ -294,6 +421,16 @@ class HomeQPStatic(NamedTuple):
     kin: jnp.ndarray          # (n_homes,) 1 - a_in / R
     kwh: jnp.ndarray          # (n_homes,) 1 - a_wh / wh_r
     awr: jnp.ndarray          # (n_homes,) a_wh / wh_r
+    # Heat-pump COP band (spec.has_hp): positions of the HVAC thermal
+    # coefficients — entries [0:H] are rows r_tind+k (OAT at t+k+1), entry
+    # [H] is the one-step r_tin1 row (OAT at t+1).  Empty when absent —
+    # the assemble path compiles the band out entirely (byte-identity for
+    # legacy batches).
+    hp_cool_pos: np.ndarray = _NO_POS   # (H+1,) cool-duty thermal entries
+    hp_heat_pos: np.ndarray = _NO_POS   # (H+1,) heat-duty thermal entries
+    # Grid rows' PV terms (spec.has_grid and spec.has_curt): the u_curt
+    # coefficient of each r_pgr+k row is −pvc[k] (GHI-dependent, per step).
+    gridpv_pos: np.ndarray = _NO_POS    # (H,)
 
 
 def build_qp_static(batch, horizon: int, dt: int,
@@ -324,6 +461,12 @@ def build_qp_static(batch, horizon: int, dt: int,
 
     rows, cols, vals = [], [], []
     whmix_pos = np.zeros(H, dtype=np.int64)
+    hp_cool_pos = (np.zeros(H + 1, dtype=np.int64) if lay.has_hp
+                   else _NO_POS)
+    hp_heat_pos = (np.zeros(H + 1, dtype=np.int64) if lay.has_hp
+                   else _NO_POS)
+    gridpv_pos = (np.zeros(H, dtype=np.int64)
+                  if lay.has_grid and lay.has_curt else _NO_POS)
 
     def add(r, c, v):
         rows.append(r)
@@ -332,13 +475,19 @@ def build_qp_static(batch, horizon: int, dt: int,
         return len(rows) - 1
 
     ks = np.arange(H)
-    # Indoor temp: T[0] pin + dynamics (dragg/mpc_calc.py:313-317).
+    # Indoor temp: T[0] pin + dynamics (dragg/mpc_calc.py:313-317).  Under
+    # spec.has_hp the duty coefficients are COP-scaled per step at
+    # assemble time (positions recorded); the static values seeded here
+    # are the resistive COP == 1 case.
     add(lay.r_tin0, lay.i_tin, 1.0)
     for k in range(H):
         add(lay.r_tind + k, lay.i_tin + k + 1, 1.0)
         add(lay.r_tind + k, lay.i_tin + k, -kin)
-        add(lay.r_tind + k, lay.i_cool + k, a_in * pc)
-        add(lay.r_tind + k, lay.i_heat + k, -a_in * ph)
+        pos_c = add(lay.r_tind + k, lay.i_cool + k, a_in * pc)
+        pos_h = add(lay.r_tind + k, lay.i_heat + k, -a_in * ph)
+        if lay.has_hp:
+            hp_cool_pos[k] = pos_c
+            hp_heat_pos[k] = pos_h
     # WH temp: T[0] pin + dynamics with draw mixing (dragg/mpc_calc.py:329-332).
     add(lay.r_twh0, lay.i_twh, 1.0)
     for k in range(H):
@@ -348,8 +497,11 @@ def build_qp_static(batch, horizon: int, dt: int,
         add(lay.r_twhd + k, lay.i_wh + k, -a_wh * pwh)
     # One-step deterministic temps (dragg/mpc_calc.py:321-324,336-338).
     add(lay.r_tin1, lay.i_tin1, 1.0)
-    add(lay.r_tin1, lay.i_cool, a_in * pc)
-    add(lay.r_tin1, lay.i_heat, -a_in * ph)
+    pos_c1 = add(lay.r_tin1, lay.i_cool, a_in * pc)
+    pos_h1 = add(lay.r_tin1, lay.i_heat, -a_in * ph)
+    if lay.has_hp:
+        hp_cool_pos[H] = pos_c1
+        hp_heat_pos[H] = pos_h1
     add(lay.r_twh1, lay.i_twh1, 1.0)
     add(lay.r_twh1, lay.i_tin + 1, -awr)
     add(lay.r_twh1, lay.i_wh, -a_wh * pwh)
@@ -361,6 +513,33 @@ def build_qp_static(batch, horizon: int, dt: int,
             add(lay.r_ebd + k, lay.i_eb + k, -1.0)
             add(lay.r_ebd + k, lay.i_pch + k, -che / dt)
             add(lay.r_ebd + k, lay.i_pd + k, -1.0 / (dse * dt))
+    # EV SOC: pin + charge-only dynamics (battery row structure minus the
+    # discharge term; docs/architecture.md §15).  Deadlines / availability
+    # are per-step BOX data (ev_charge_bounds), never structure.
+    if lay.has_ev:
+        evche = np.asarray(batch.ev_ch_eff)
+        add(lay.r_eev0, lay.i_eev, 1.0)
+        for k in range(H):
+            add(lay.r_eevd + k, lay.i_eev + k + 1, 1.0)
+            add(lay.r_eevd + k, lay.i_eev + k, -1.0)
+            add(lay.r_eevd + k, lay.i_evch + k, -evche / dt)
+    # Explicit grid power (community events): p_gr[k] equals the PHYSICAL
+    # kW grid draw — p_gr − Σ load/storage terms − pvc[k]·u_curt = −pvc[k]
+    # (the pvc entries and RHS are GHI-dependent, filled per step), so DR
+    # caps and outage islanding are pure per-step box bounds on p_gr.
+    if lay.has_grid:
+        for k in range(H):
+            add(lay.r_pgr + k, lay.i_pgr + k, 1.0)
+            add(lay.r_pgr + k, lay.i_cool + k, -pc)
+            add(lay.r_pgr + k, lay.i_heat + k, -ph)
+            add(lay.r_pgr + k, lay.i_wh + k, -pwh)
+            if lay.has_batt:
+                add(lay.r_pgr + k, lay.i_pch + k, -1.0)
+                add(lay.r_pgr + k, lay.i_pd + k, -1.0)
+            if lay.has_ev:
+                add(lay.r_pgr + k, lay.i_evch + k, -1.0)
+            if lay.has_curt:
+                gridpv_pos[k] = add(lay.r_pgr + k, lay.i_curt + k, 0.0)
     del ks
 
     rows_np = np.array(rows, dtype=np.int64)
@@ -376,6 +555,9 @@ def build_qp_static(batch, horizon: int, dt: int,
         kin=jnp.asarray(kin),
         kwh=jnp.asarray(kwh),
         awr=jnp.asarray(awr),
+        hp_cool_pos=hp_cool_pos,
+        hp_heat_pos=hp_heat_pos,
+        gridpv_pos=gridpv_pos,
     )
 
 
@@ -410,10 +592,23 @@ def assemble_qp_step(
     heat_cap,          # (n_homes,)
     wh_cap: float,     # s
     discount,          # scalar
+    e_ev_init=None,    # (n_homes,) EV SOC kWh (required when lay.has_ev)
+    ev_avail=None,     # (n_homes, H) 0/1 charge availability (has_ev;
+                       # None = always available)
+    ev_floor=None,     # (n_homes, H) e_ev[k+1] lower bound, kWh (has_ev;
+                       # None = 0 — see ev_charge_bounds)
+    grid_cap=None,     # (n_homes, H) p_gr upper bound, kW (has_grid;
+                       # None = +inf — no event this window)
+    grid_floor=None,   # (n_homes, H) p_gr lower bound, kW (has_grid;
+                       # None = -inf)
+    comfort_relax=None,  # (n_homes, H) degC indoor-band widening for the
+                         # bounded T_in entries (DR/outage comfort relief)
 ) -> QPStep:
-    """Fill the per-timestep QP: A_eq values (water-mix band), RHS, box
-    bounds (seasonal HVAC gating, dragg/mpc_calc.py:298-309), and the linear
-    objective q (discounted price on grid power, dragg/mpc_calc.py:441-446).
+    """Fill the per-timestep QP: A_eq values (water-mix band; HP COP band
+    and grid-row PV terms under scenario specs), RHS, box bounds (seasonal
+    HVAC gating, dragg/mpc_calc.py:298-309; EV availability/deadline and
+    event windows as per-step data), and the linear objective q (discounted
+    price on grid power, dragg/mpc_calc.py:441-446).
     """
     H = lay.H
     n_homes = static.vals.shape[0]
@@ -421,7 +616,41 @@ def assemble_qp_step(
 
     rem = 1.0 - draw_frac  # remainder_frac (dragg/mpc_calc.py:202-204)
     whmix_vals = -(rem[:, 1:] * static.kwh[:, None])  # (n_homes, H)
-    vals = static.vals.at[:, static.whmix_pos].set(whmix_vals).astype(dtype)
+    vals64 = static.vals.at[:, static.whmix_pos].set(whmix_vals)
+    oat_hp = jnp.asarray(oat_window)
+    oat_hp = oat_hp if oat_hp.ndim == 2 else oat_hp[None, :]
+    if len(static.hp_cool_pos):
+        # Heat-pump COP band: thermal coefficients of the HVAC dynamics
+        # rows scale by COP(OAT) per step.  Resistive homes in the same
+        # batch multiply by exactly 1.0 — their entries are bit-identical
+        # to the static seed values.
+        is_hp = jnp.asarray(batch.is_hp)[:, None]
+        cop_c, cop_h = hp_cops(oat_hp[:, 1:H + 1], batch.hp_cop_base,
+                               batch.hp_cop_slope)
+        cop_c = 1.0 + is_hp * (cop_c - 1.0)
+        cop_h = 1.0 + is_hp * (cop_h - 1.0)
+        # Entries [0:H] are rows r_tind+k (OAT at t+k+1); entry [H] is the
+        # one-step r_tin1 row, which shares k=0's OAT (t+1).  The band
+        # SCALES the seeded static coefficients (a_in·P with the right
+        # signs) rather than recomputing them, so resistive homes'
+        # COP == 1 entries stay bit-identical to the legacy matrices.
+        cop_c_full = jnp.concatenate([cop_c, cop_c[:, :1]], axis=1)
+        cop_h_full = jnp.concatenate([cop_h, cop_h[:, :1]], axis=1)
+        vals64 = vals64.at[:, static.hp_cool_pos].multiply(cop_c_full)
+        vals64 = vals64.at[:, static.hp_heat_pos].multiply(cop_h_full)
+    # Grid rows' PV terms (−pvc[k] on u_curt; the matching RHS lands below).
+    pvc_grid = None
+    if lay.has_grid and lay.has_curt:
+        ghi_g = jnp.asarray(ghi_window)
+        ghi_g = ghi_g if ghi_g.ndim == 2 else ghi_g[None, :]
+        pvc_grid = (
+            jnp.asarray(batch.pv_area)[:, None]
+            * jnp.asarray(batch.pv_eff)[:, None]
+            * jnp.asarray(batch.has_pv)[:, None]
+            * ghi_g[:, :H] / 1000.0
+        )
+        vals64 = vals64.at[:, static.gridpv_pos].set(-pvc_grid)
+    vals = vals64.astype(dtype)
 
     oat = jnp.asarray(oat_window)
     # Per-home windows (fleet weather offsets) arrive 2-D; the shared
@@ -441,6 +670,13 @@ def assemble_qp_step(
     if lay.has_batt:
         b = b.at[:, lay.r_eb0].set(e_batt_init)
         # battery dynamics rows rhs = 0 already
+    if lay.has_ev:
+        ev0 = (jnp.zeros((n_homes,), dtype) if e_ev_init is None
+               else jnp.asarray(e_ev_init).astype(dtype))
+        b = b.at[:, lay.r_eev0].set(ev0)
+    if pvc_grid is not None:
+        # p_gr − (loads/storage) − pvc·u_curt = −pvc (see build_qp_static).
+        b = b.at[:, lay.r_pgr:lay.r_pgr + H].set(-pvc_grid.astype(dtype))
 
     inf = jnp.full((n_homes,), BIG, dtype=dtype)
     zeros = jnp.zeros((n_homes,), dtype=dtype)
@@ -459,12 +695,38 @@ def assemble_qp_step(
         rate = jnp.asarray(batch.batt_max_rate) * jnp.asarray(batch.has_batt)
         seg(zeros, rate, lay.i_pch, H)
         seg(-rate, zeros, lay.i_pd, H)
+    if lay.has_ev:
+        ev_rate = (jnp.asarray(batch.ev_rate)
+                   * jnp.asarray(batch.is_ev)).astype(dtype)[:, None]
+        ev_hi = (ev_rate * jnp.asarray(ev_avail).astype(dtype)
+                 if ev_avail is not None
+                 else jnp.broadcast_to(ev_rate, (n_homes, H)))
+        u = u.at[:, lay.i_evch:lay.i_evch + H].set(ev_hi)
+        # (lower bound stays the zero init — charge-only)
     if lay.has_curt:
         seg(zeros, jnp.ones((n_homes,), dtype=dtype), lay.i_curt, H)
+    if lay.has_grid:
+        g_lo = (jnp.asarray(grid_floor).astype(dtype)
+                if grid_floor is not None
+                else jnp.full((n_homes, H), -BIG, dtype))
+        g_hi = (jnp.asarray(grid_cap).astype(dtype)
+                if grid_cap is not None
+                else jnp.full((n_homes, H), BIG, dtype))
+        l = l.at[:, lay.i_pgr:lay.i_pgr + H].set(g_lo)
+        u = u.at[:, lay.i_pgr:lay.i_pgr + H].set(g_hi)
     # T_in_ev[0] is pinned by equality; bounds apply to [1:] only
-    # (dragg/mpc_calc.py:318-319).
+    # (dragg/mpc_calc.py:318-319).  DR / outage windows widen the band by
+    # the per-step comfort_relax (docs/architecture.md §15).
     seg(-inf, inf, lay.i_tin, 1)
-    seg(jnp.asarray(batch.temp_in_min).astype(dtype), jnp.asarray(batch.temp_in_max).astype(dtype), lay.i_tin + 1, H)
+    tin_lo = jnp.asarray(batch.temp_in_min).astype(dtype)[:, None]
+    tin_hi = jnp.asarray(batch.temp_in_max).astype(dtype)[:, None]
+    relax = (jnp.asarray(comfort_relax).astype(dtype)
+             if comfort_relax is not None else None)
+    if relax is not None:
+        l = l.at[:, lay.i_tin + 1:lay.i_tin + 1 + H].set(tin_lo - relax)
+        u = u.at[:, lay.i_tin + 1:lay.i_tin + 1 + H].set(tin_hi + relax)
+    else:
+        seg(jnp.asarray(batch.temp_in_min).astype(dtype), jnp.asarray(batch.temp_in_max).astype(dtype), lay.i_tin + 1, H)
     # T_wh_ev bounds apply to ALL H+1 entries including the pinned index 0
     # (dragg/mpc_calc.py:333-334) — an out-of-band initial WH temp makes the
     # problem infeasible, which routes the home to the fallback controller
@@ -475,7 +737,21 @@ def assemble_qp_step(
         cap_min = jnp.asarray(batch.batt_cap_min).astype(dtype)
         cap_max = jnp.asarray(batch.batt_cap_max).astype(dtype)
         seg(cap_min, cap_max, lay.i_eb + 1, H)
-    seg(jnp.asarray(batch.temp_in_min).astype(dtype), jnp.asarray(batch.temp_in_max).astype(dtype), lay.i_tin1, 1)
+    if lay.has_ev:
+        seg(-inf, inf, lay.i_eev, 1)  # e_ev[0] pinned by equality
+        ev_cap = (jnp.asarray(batch.ev_cap)
+                  * jnp.asarray(batch.is_ev)).astype(dtype)[:, None]
+        ev_lo = (jnp.asarray(ev_floor).astype(dtype)
+                 if ev_floor is not None
+                 else jnp.zeros((n_homes, H), dtype))
+        l = l.at[:, lay.i_eev + 1:lay.i_eev + 1 + H].set(ev_lo)
+        u = u.at[:, lay.i_eev + 1:lay.i_eev + 1 + H].set(
+            jnp.broadcast_to(ev_cap, (n_homes, H)))
+    if relax is not None:
+        l = l.at[:, lay.i_tin1].set(tin_lo[:, 0] - relax[:, 0])
+        u = u.at[:, lay.i_tin1].set(tin_hi[:, 0] + relax[:, 0])
+    else:
+        seg(jnp.asarray(batch.temp_in_min).astype(dtype), jnp.asarray(batch.temp_in_max).astype(dtype), lay.i_tin1, 1)
     seg(jnp.asarray(batch.temp_wh_min).astype(dtype), jnp.asarray(batch.temp_wh_max).astype(dtype), lay.i_twh1, 1)
 
     # Objective: sum_k w[k] * price[k] * p_grid[k], p_grid affine in controls
@@ -491,6 +767,10 @@ def assemble_qp_step(
     if lay.has_batt:
         q = q.at[:, lay.i_pch : lay.i_pch + H].set(wp * s)
         q = q.at[:, lay.i_pd : lay.i_pd + H].set(wp * s)
+    if lay.has_ev:
+        # EV charging is paid grid energy, same convention as battery
+        # charge (p_grid gains s·p_ev_ch — recover_solution).
+        q = q.at[:, lay.i_evch : lay.i_evch + H].set(wp * s)
     if lay.has_curt:
         # PV: p_grid -= s * pvc[k] * (1 - u_curt[k]); the constant term is
         # dropped from q (it shifts the objective, not the argmin) and the
@@ -521,10 +801,12 @@ def shift_warm_start(x, lay: QPLayout):
     def sh(v, i0, L):
         return v.at[:, i0 : i0 + L - 1].set(v[:, i0 + 1 : i0 + L])
 
-    for i0 in (lay.i_cool, lay.i_heat, lay.i_wh, lay.i_pch, lay.i_pd, lay.i_curt):
+    for i0 in (lay.i_cool, lay.i_heat, lay.i_wh, lay.i_pch, lay.i_pd,
+               lay.i_evch, lay.i_curt, lay.i_pgr):
         if i0 is not None:
             x = sh(x, i0, H)
-    for i0, L in ((lay.i_tin, H + 1), (lay.i_twh, H + 1), (lay.i_eb, H + 1)):
+    for i0, L in ((lay.i_tin, H + 1), (lay.i_twh, H + 1), (lay.i_eb, H + 1),
+                  (lay.i_eev, H + 1)):
         if i0 is not None:
             x = sh(x, i0, L)
     return x
@@ -549,6 +831,8 @@ class MPCSolution(NamedTuple):
     e_batt: jnp.ndarray      # (n_homes, H+1)
     temp_in1: jnp.ndarray    # (n_homes,) one-step deterministic indoor temp
     temp_wh1: jnp.ndarray
+    p_ev_ch: jnp.ndarray = None   # (n_homes, H) EV charge kW (zeros when absent)
+    e_ev: jnp.ndarray = None      # (n_homes, H+1) EV SOC kWh (zeros when absent)
 
 
 def recover_solution(x, lay: QPLayout, batch, ghi_window, price_total, s: float) -> MPCSolution:
@@ -578,15 +862,18 @@ def recover_solution(x, lay: QPLayout, batch, ghi_window, price_total, s: float)
         / 1000.0
     )
     p_pv = pvc * (1.0 - u_curt)
+    p_ev = x[:, lay.i_evch : lay.i_evch + H] if lay.has_ev else zH
     p_load = s * (
         jnp.asarray(batch.hvac_p_c)[:, None] * cool
         + jnp.asarray(batch.hvac_p_h)[:, None] * heat
         + jnp.asarray(batch.wh_p)[:, None] * wh
     )
-    p_grid = p_load + s * (p_ch + p_disch) - s * p_pv
+    p_grid = p_load + s * (p_ch + p_disch + p_ev) - s * p_pv
     cost = price_total * p_grid
     e_batt = (x[:, lay.i_eb : lay.i_eb + H + 1] if lay.has_batt
               else jnp.zeros((B, H + 1), dtype=x.dtype))
+    e_ev = (x[:, lay.i_eev : lay.i_eev + H + 1] if lay.has_ev
+            else jnp.zeros((B, H + 1), dtype=x.dtype))
     return MPCSolution(
         cool=cool, heat=heat, wh=wh, p_ch=p_ch, p_disch=p_disch, u_curt=u_curt,
         p_pv=p_pv, p_load=p_load, p_grid=p_grid, cost=cost,
@@ -595,4 +882,6 @@ def recover_solution(x, lay: QPLayout, batch, ghi_window, price_total, s: float)
         e_batt=e_batt,
         temp_in1=x[:, lay.i_tin1],
         temp_wh1=x[:, lay.i_twh1],
+        p_ev_ch=p_ev,
+        e_ev=e_ev,
     )

@@ -74,7 +74,8 @@ class ShardedEngine(Engine):
 
     def __init__(self, params: EngineParams, batch, env_oat, env_ghi, env_tou,
                  check_mask=None, mesh: Mesh | None = None,
-                 axis_name: str = HOMES_AXIS, fleet=None):
+                 axis_name: str = HOMES_AXIS, fleet=None, events=None,
+                 hour0: int = 0):
         if mesh is None:
             mesh = make_mesh(axis_name=axis_name)
         self.mesh = mesh
@@ -100,17 +101,21 @@ class ShardedEngine(Engine):
             check_mask = np.pad(np.asarray(check_mask, dtype=np.float64),
                                 (0, batch.n_homes - self.true_n_homes)) * pad_mask
         super().__init__(params, batch, env_oat, env_ghi, env_tou,
-                         check_mask=check_mask, fleet=fleet)
+                         check_mask=check_mask, fleet=fleet, events=events,
+                         hour0=hour0)
 
         shard = NamedSharding(mesh, P(axis_name))
         rep = NamedSharding(mesh, P())
         put_s = lambda a: jax.device_put(jnp.asarray(np.asarray(a)), shard)
         put_r = lambda a: jax.device_put(jnp.asarray(np.asarray(a)), rep)
 
-        # Replicated environment series.
+        # Replicated environment series (+ the event-timeline series,
+        # which are per-community, not per-home — every shard reads its
+        # homes' community rows).
         self._oat = put_r(self._oat)
         self._ghi = put_r(self._ghi)
         self._tou = put_r(self._tou)
+        self._evt = {k: put_r(v) for k, v in self._evt.items()}
         if self._bucketed:
             # Per-home constants live in the bucket contexts (each bucket
             # padded to a mesh multiple); commit each bucket's arrays with
@@ -121,9 +126,10 @@ class ShardedEngine(Engine):
 
             for c in self._buckets:
                 st = c.static
-                c.static = type(st)(
-                    rows=st.rows, cols=st.cols, whmix_pos=st.whmix_pos,
-                    pattern=st.pattern,
+                # _replace keeps the host-side index members (sparsity,
+                # per-step band positions) intact while committing the
+                # per-home coefficient arrays with the homes sharding.
+                c.static = st._replace(
                     vals=put_s(st.vals), a_in=put_s(st.a_in),
                     a_wh=put_s(st.a_wh), kin=put_s(st.kin),
                     kwh=put_s(st.kwh), awr=put_s(st.awr),
@@ -144,12 +150,12 @@ class ShardedEngine(Engine):
         self._noise_idx = put_s(self._noise_idx)
         self._home_key = put_s(self._home_key)
         self._env_off = put_s(self._env_off)
-        # QP static: shared sparsity indices stay host-side numpy constants;
-        # per-home coefficient arrays are sharded.
+        self._comm_idx = put_s(self._comm_idx)
+        # QP static: shared sparsity indices (and per-step band positions)
+        # stay host-side numpy constants; per-home coefficient arrays are
+        # sharded.
         st = self.static
-        self.static = type(st)(
-            rows=st.rows, cols=st.cols, whmix_pos=st.whmix_pos,
-            pattern=st.pattern,
+        self.static = st._replace(
             vals=put_s(st.vals), a_in=put_s(st.a_in), a_wh=put_s(st.a_wh),
             kin=put_s(st.kin), kwh=put_s(st.kwh), awr=put_s(st.awr),
         )
@@ -164,13 +170,19 @@ class ShardedEngine(Engine):
 
 def make_sharded_engine(batch, env, config, start_index: int,
                         mesh: Mesh | None = None,
-                        fleet=None) -> ShardedEngine:
+                        fleet=None, events=None,
+                        data_dir=None) -> ShardedEngine:
     """Sharded counterpart of :func:`dragg_tpu.engine.make_engine`."""
-    from dragg_tpu.engine import check_mask_for, engine_params
+    from dragg_tpu.engine import (check_mask_for, engine_params, env_hour0,
+                                  resolve_engine_events)
 
     axis = config.get("tpu", {}).get("mesh_axis", HOMES_AXIS)
+    params = engine_params(config, start_index)
+    if events is None:
+        events = resolve_engine_events(config, env, params, fleet=fleet,
+                                       data_dir=data_dir)
     return ShardedEngine(
-        engine_params(config, start_index), batch, env.oat, env.ghi, env.tou,
+        params, batch, env.oat, env.ghi, env.tou,
         check_mask=check_mask_for(batch, config), mesh=mesh, axis_name=axis,
-        fleet=fleet,
+        fleet=fleet, events=events, hour0=env_hour0(env),
     )

@@ -1,0 +1,27 @@
+"""Scenario subsystem: EV / heat-pump home types as data-driven specs, and
+community event timelines (tariff shocks, DR curtailment, outage
+islanding) compiled into the engine step as per-step gathers.
+
+ROADMAP item 4 / docs/architecture.md §15 / docs/scenarios.md.  The home
+types themselves live where home types live (homes.HOME_TYPES +
+ops/qp.TYPE_SPECS); this package owns the DECLARATIVE layer — pack files,
+mix expansion, and the event timeline the engine closes over.
+"""
+
+from dragg_tpu.scenarios.packs import (  # noqa: F401 — re-exported API
+    MIX_KEYS,
+    apply_scenarios,
+    load_pack,
+    pack_path,
+    packs_dir,
+)
+from dragg_tpu.scenarios.timeline import (  # noqa: F401 — re-exported API
+    EVENT_KINDS,
+    EventTimeline,
+    ScenarioError,
+    build_timeline,
+    describe_timeline,
+    empty_timeline,
+    timeline_digest,
+    timeline_for,
+)

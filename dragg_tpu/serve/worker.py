@@ -90,6 +90,12 @@ class EngineRunner:
         self._np = np
         enable_compile_cache(config)
         beat({"stage": "serve:build"})
+        # Scenario packs expand BEFORE home synthesis (mix counts) — the
+        # same one-entry-point rule as the Aggregator (dragg_tpu/scenarios;
+        # a pack's events reach the engine only through this expansion).
+        from dragg_tpu.scenarios import apply_scenarios
+
+        config = apply_scenarios(config)
         seed = int(config["simulation"]["random_seed"])
         env = load_environment(config)
         dt = env.dt

@@ -164,6 +164,13 @@ METRICS: dict[str, tuple[str, str]] = {
     "bench.phase.solve_base_s": ("histogram",
                                  "bench base-bucket solve seconds per "
                                  "step (bucketed)"),
+    "bench.phase.solve_ev_s": ("histogram",
+                               "bench ev-bucket solve seconds per step "
+                               "(bucketed; scenario type)"),
+    "bench.phase.solve_heat_pump_s": ("histogram",
+                                      "bench heat_pump-bucket solve "
+                                      "seconds per step (bucketed; "
+                                      "scenario type)"),
     "bench.rate_ts_per_s": ("gauge", "headline sim-timesteps/s"),
     "bench.flops_per_step": ("gauge",
                              "analytic FLOPs per sim step — the MFU "
@@ -187,6 +194,13 @@ METRICS: dict[str, tuple[str, str]] = {
     "solver.conv_iters_base": ("histogram",
                                "mean per-home convergence iterations per "
                                "chunk, base bucket"),
+    "solver.conv_iters_ev": ("histogram",
+                             "mean per-home convergence iterations per "
+                             "chunk, ev bucket (scenario type)"),
+    "solver.conv_iters_heat_pump": ("histogram",
+                                    "mean per-home convergence iterations "
+                                    "per chunk, heat_pump bucket "
+                                    "(scenario type)"),
     "solver.conv_iters_superset": ("histogram",
                                    "mean per-home convergence iterations "
                                    "per chunk, unbucketed superset batch"),

@@ -38,6 +38,11 @@ def test_layout_specs_shapes():
         "pv_only": (6 * H + 4, 2 * H + 4),
         "battery_only": (8 * H + 5, 3 * H + 5),
         "base": (5 * H + 4, 2 * H + 4),
+        # Scenario types (ISSUE 10): ev = base + H charge columns +
+        # (H+1) SOC columns + (H+1) pin/dynamics rows; heat_pump changes
+        # coefficients (COP band), never shapes.
+        "ev": (7 * H + 5, 3 * H + 5),
+        "heat_pump": (5 * H + 4, 2 * H + 4),
     }
     for name, spec in TYPE_SPECS.items():
         lay_t = QPLayout(H, spec)
@@ -47,6 +52,10 @@ def test_layout_specs_shapes():
                 and lay_t.r_ebd is None
         if not spec.has_curt:
             assert lay_t.i_curt is None
+        if not spec.has_ev:
+            assert lay_t.i_evch is None and lay_t.i_eev is None \
+                and lay_t.r_eevd is None
+        assert lay_t.i_pgr is None  # grid block is an engine upgrade
         # The shared blocks keep their relative order: controls first,
         # then evolution states, then the one-step deterministic temps.
         assert lay_t.i_cool == 0 and lay_t.i_twh1 == lay_t.n - 1

@@ -134,7 +134,8 @@ def test_config_reference_doc_covers_all_keys():
                 yield body
 
     # Distribution keys are documented as a family, not per key.
-    families = ("home.hvac.", "home.wh.", "home.battery.", "home.pv.")
+    families = ("home.hvac.", "home.wh.", "home.battery.", "home.pv.",
+                "home.ev.", "home.heat_pump.")
     missing = [
         path for path, key in leaves(default_config())
         if not path.startswith(families)

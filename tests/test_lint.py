@@ -178,3 +178,22 @@ def test_kkt_inverse_scope():
     assert not lint._is_kkt_inv_scope(
         os.path.join(ROOT, "dragg_tpu", "ops", "reluqp.py"))
     assert not lint._is_kkt_inv_scope(os.path.join(ROOT, "tests", "x.py"))
+
+
+def test_home_type_registry_rule():
+    """ISSUE 10: every HOME_TYPES entry must carry a TYPE_SPECS spec, a
+    parity-bearing test mention, and a docs/config.md mention — the live
+    repo passes, and the checker actually reads the live tables."""
+    lint = _load_lint()
+    assert lint.check_home_type_registry() == []
+    # The checker reads the REAL type lists (not a stale copy).
+    from dragg_tpu.homes import HOME_TYPES
+    from dragg_tpu.ops.qp import TYPE_SPECS
+
+    got = lint._literal_names(
+        os.path.join(ROOT, "dragg_tpu", "homes.py"), "HOME_TYPES")
+    assert tuple(got) == HOME_TYPES
+    got_specs = lint._literal_names(
+        os.path.join(ROOT, "dragg_tpu", "ops", "qp.py"), "TYPE_SPECS")
+    assert set(got_specs) == set(TYPE_SPECS)
+    assert {"ev", "heat_pump"} <= set(got)

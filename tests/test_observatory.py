@@ -387,6 +387,30 @@ def test_bench_trend_communities_hard_key(tmp_path):
     assert rc == 1 and trend["n_regressions"] == 1
 
 
+def test_bench_trend_mix_hard_key(tmp_path):
+    """Scenario-pack rows (ISSUE 10): ``mix`` is a HARD series key — a
+    bench row measured on an EV/heat-pump mix (or under a scenario pack's
+    event timeline) never pairs with the legacy 4-type history, while
+    same-mix rows pair and gate normally.  Era default: artifacts that
+    predate the field read mix="legacy"."""
+    scenario_mix = "ev=0.1,heat_pump=0.1,pv_only=0.3+pack:stress_dr_outage"
+    arts = [
+        _bench_line(2.0, 0.50, 1),                      # pre-scenario era
+        _bench_line(0.8, 0.50, 2, mix=scenario_mix),    # pack row: no pair
+        _bench_line(0.78, 0.51, 3, mix=scenario_mix),   # pack vs pack: pairs
+    ]
+    rc, trend = _trend(tmp_path, arts, extra=("--gate",))
+    assert rc == 0, trend
+    assert len(trend["rows"]) == 1
+    row = trend["rows"][0]
+    assert row["key"]["mix"] == scenario_mix
+    assert row["rate_verdict"] == "stable"
+    # A genuine scenario-series regression still gates.
+    arts.append(_bench_line(0.4, 0.51, 4, mix=scenario_mix))
+    rc, trend = _trend(tmp_path, arts, extra=("--gate",))
+    assert rc == 1 and trend["n_regressions"] == 1
+
+
 def test_bench_trend_committed_series():
     """The committed BENCH_r01–r05 artifacts reproduce the known
     trajectory: the r02→r03 1000-home window improved, the r04→r05

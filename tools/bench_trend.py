@@ -51,7 +51,7 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 HARD_KEY = ("metric", "platform", "solver", "semantics", "data",
-            "communities")
+            "communities", "mix")
 
 
 def _round_ordinal(path: str, fallback: int) -> int:
@@ -118,7 +118,8 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
                   for k, v in hists.items() if k.startswith(pfx)}
         return dict(source=source, ordinal=ordinal,
                     metric="metrics_snapshot", platform="?", solver="?",
-                    semantics="?", data="?", communities=1, bucketed=False,
+                    semantics="?", data="?", communities=1, mix="?",
+                    bucketed=False,
                     fallback=False, degraded=None,
                     value=float(gauges.get("bench.rate_ts_per_s", 0.0)),
                     solve_rate=gauges.get("engine.solve_rate"),
@@ -138,6 +139,13 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         # never gate against single-community history.  Era default:
         # pre-fleet artifacts measured one community.
         communities=int(rec.get("communities", 1)),
+        # Population composition + scenario pack is a HARD key (ISSUE 10):
+        # a scenario-pack row (EV/heat-pump mixes, DR/outage timelines) is
+        # a different workload than the legacy 4-type bench at the same
+        # shape, so it forms its own series and never gates against the
+        # pre-scenario history.  Era default: pre-field artifacts all
+        # measured the legacy 0.4/0.1/0.1 mix.
+        mix=str(rec.get("mix", "legacy")),
         bucketed=bool(rec.get("bucketed", False)),
         fallback=bool(rec.get("fallback", False)),
         degraded=rec.get("degraded"),
@@ -259,8 +267,9 @@ def print_table(trend: dict, out=sys.stderr) -> None:
         k = r["key"]
         fleet = (f"/{k['communities']}comm" if k.get("communities", 1) != 1
                  else "")
+        mix = (f"/{k['mix']}" if k.get("mix", "legacy") != "legacy" else "")
         print(f"  {k['metric']} [{k['platform']}/{k['solver']}/"
-              f"{k['semantics']}/{k['data']}{fleet}] "
+              f"{k['semantics']}/{k['data']}{fleet}{mix}] "
               f"{r['from_source']} → {r['to_source']}", file=out)
         print(f"    rate  {r['rate'][0]:.3f} → {r['rate'][1]:.3f} "
               f"({_fmt_pct(r['rate_delta'])}) {r['rate_verdict']}",

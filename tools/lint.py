@@ -32,6 +32,11 @@ what the stdlib can check:
   fragment the unified stream the registry exists to keep analyzable.
   Computed names carry a ``# telemetry-name-ok: <why>`` marker (e.g.
   the taxonomy-kind events, whose kinds are each registered literally);
+* home-type co-registration (ISSUE 10): every ``homes.HOME_TYPES`` entry
+  must carry an ``ops/qp.TYPE_SPECS`` block spec, appear (quoted) in a
+  parity-bearing test file under ``tests/``, and be documented in
+  ``docs/config.md`` — a new scenario home type cannot ship half-wired
+  (solving in a bucket nobody parity-checked or documented);
 * KKT-inverse discipline in the same scope (round 10): no direct
   ``np.linalg.inv``/``jnp.linalg.inv`` outside ``dragg_tpu/ops/`` — the
   dense rho-bank operators of the reluqp family must be built through
@@ -278,6 +283,86 @@ def check_device_discipline(tree, lines: list[str], rel: str) -> list[str]:
     return problems
 
 
+# Home-type co-registration (ISSUE 10; see the module docstring bullet).
+def _literal_names(path: str, var: str) -> list[str] | None:
+    """String members of a top-level tuple/dict literal assigned to
+    ``var`` in ``path`` (tuple → elements, dict → keys); None on parse
+    failure so the rule degrades quietly rather than crashing lint."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        for t in targets:
+            if not (isinstance(t, ast.Name) and t.id == var):
+                continue
+            v = node.value
+            if isinstance(v, ast.Tuple):
+                return [e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+            if isinstance(v, ast.Dict):
+                return [k.value for k in v.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+    return None
+
+
+def check_home_type_registry() -> list[str]:
+    home_types = _literal_names(
+        os.path.join(ROOT, "dragg_tpu", "homes.py"), "HOME_TYPES")
+    specs = _literal_names(
+        os.path.join(ROOT, "dragg_tpu", "ops", "qp.py"), "TYPE_SPECS")
+    if home_types is None or specs is None:
+        return []  # parse problems are reported per-file already
+    try:
+        with open(os.path.join(ROOT, "docs", "config.md"),
+                  encoding="utf-8") as f:
+            doc = f.read()
+    except OSError:
+        doc = ""
+    # Parity evidence: the quoted type name appears in a test file whose
+    # source mentions parity (the test_qp_parity / test_bucketed /
+    # test_scenarios convention).
+    parity_src = ""
+    tests_dir = os.path.join(ROOT, "tests")
+    try:
+        test_files = sorted(os.listdir(tests_dir))
+    except OSError:
+        test_files = []
+    for fn in test_files:
+        if not fn.endswith(".py"):
+            continue
+        try:
+            with open(os.path.join(tests_dir, fn), encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        if "parity" in src.lower():
+            parity_src += src
+    problems = []
+    for t in home_types:
+        if t not in specs:
+            problems.append(
+                f"dragg_tpu/homes.py: HOME_TYPES entry {t!r} has no "
+                f"ops/qp.TYPE_SPECS block spec — the bucketed engine "
+                f"cannot shape-specialize it")
+        if f"`{t}`" not in doc and f"homes_{t}" not in doc:
+            problems.append(
+                f"docs/config.md: HOME_TYPES entry {t!r} undocumented — "
+                f"mention `{t}` (or its homes_{t} count key)")
+        if f'"{t}"' not in parity_src and f"'{t}'" not in parity_src:
+            problems.append(
+                f"tests/: HOME_TYPES entry {t!r} appears in no parity-"
+                f"bearing test file — add objective-parity coverage "
+                f"(tests/test_qp_parity.py pattern)")
+    return problems
+
+
 def check_file(path: str) -> list[str]:
     problems = []
     rel = os.path.relpath(path, ROOT)
@@ -326,6 +411,7 @@ def main() -> int:
     for path in sorted(iter_py_files()):
         n += 1
         all_problems.extend(check_file(path))
+    all_problems.extend(check_home_type_registry())
     for p in all_problems:
         print(p)
     print(f"lint: {n} files, {len(all_problems)} problem(s)",

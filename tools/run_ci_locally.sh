@@ -69,6 +69,20 @@ step "test/fleet-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              | tee /tmp/fleet_smoke.json &&
            python -c "import json; r=json.load(open(\"/tmp/fleet_smoke.json\")); assert r[\"ok\"] and r[\"communities\"]==4 and r[\"homes_total\"]==256, r"'
 
+# --- job: scenario smoke (ISSUE 10): EV + heat-pump home types plus a
+#     DR + tariff-shock + outage pack on the CPU mesh — asserts the six-
+#     type mix solves in its own bucket patterns, event windows clamp the
+#     grid, and the output mapping survives (solve-rate floor is loose:
+#     outage islanding routes all-electric homes to the fallback BY
+#     DESIGN, docs/scenarios.md)
+step "test/scenario-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  bash -c 'python tools/validate_scale.py --homes 64 --horizon-hours 4 \
+             --days 1 --chunk 12 --solver ipm \
+             --mix 0.3,0.1,0.1,0.1,0.1 --pack stress_dr_outage \
+             --min-solve-rate 0.5 \
+             | tee /tmp/scenario_smoke.json &&
+           python -c "import json; r=json.load(open(\"/tmp/scenario_smoke.json\")); assert r[\"ok\"] and r[\"events\"][\"events\"] and r[\"bucket_patterns\"]>=5, r"'
+
 # --- job: bench-trend gate (round 9): the committed BENCH_r*.json series
 #     must show no like-for-like regression (comparability rules per
 #     CLAUDE.md; tools/bench_trend.py docstring)

@@ -42,11 +42,18 @@ def main():
     ap.add_argument("--solver", choices=["admm", "ipm", "reluqp"],
                     default="admm")
     ap.add_argument("--mix", default=None,
-                    help="comma fractions pv,battery,pv_battery of the "
-                         "population (default 0.4,0.1,0.1 — the bench "
-                         "mix); e.g. --mix 0,0,0 for an all-base "
-                         "bucket-heavy community or --mix 0,0,1 for "
-                         "superset-only")
+                    help="comma fractions pv,battery,pv_battery[,ev,"
+                         "heat_pump] of the population (default "
+                         "0.4,0.1,0.1 — the bench mix; 3 fractions keep "
+                         "the legacy form); e.g. --mix 0,0,0 for an "
+                         "all-base bucket-heavy community or "
+                         "--mix 0.3,0.1,0.1,0.1,0.1 for the full "
+                         "six-type scenario mix")
+    ap.add_argument("--pack", default=None,
+                    help="scenario pack name (data/packs/<name>.toml — "
+                         "docs/scenarios.md): [mix] fractions override "
+                         "--mix and [[events]] compile a DR/tariff-"
+                         "shock/outage timeline into the validated step")
     ap.add_argument("--bucketed", choices=["auto", "true", "false"],
                     default="auto",
                     help="tpu.bucketed override for the scale check "
@@ -109,6 +116,7 @@ def main():
     from dragg_tpu.engine import make_engine
     from dragg_tpu.homes import build_fleet_batch, create_fleet_homes
     from dragg_tpu.parallel.mesh import make_sharded_engine
+    from dragg_tpu.scenarios import describe_timeline
 
     cfg = default_config()
     n = args.homes
@@ -117,29 +125,39 @@ def main():
     cfg["fleet"]["weather_offset_hours"] = args.weather_offset_hours
     n_total = n * args.communities
     # Population mix: default is the bench mix; --mix exercises
-    # bucket-heavy (0,0,0 = all base) and superset-only (0,0,1)
-    # communities without editing config.
+    # bucket-heavy (0,0,0 = all base), superset-only (0,0,1), and — with
+    # 5 fractions — the scenario types (ev, heat_pump; ISSUE 10).
     try:
-        f_pv, f_bat, f_pvb = (
-            (0.4, 0.1, 0.1) if args.mix is None
-            else tuple(float(v) for v in args.mix.split(",")))
+        fracs = ((0.4, 0.1, 0.1) if args.mix is None
+                 else tuple(float(v) for v in args.mix.split(",")))
+        if len(fracs) == 3:
+            fracs = fracs + (0.0, 0.0)
+        f_pv, f_bat, f_pvb, f_ev, f_hp = fracs
     except ValueError:
         print(json.dumps({"ok": False,
-                          "error": f"--mix must be 3 comma fractions, got "
-                                   f"{args.mix!r}"}))
+                          "error": f"--mix must be 3 or 5 comma fractions, "
+                                   f"got {args.mix!r}"}))
         sys.exit(2)
-    if any(f < 0 for f in (f_pv, f_bat, f_pvb)) \
-            or f_pv + f_bat + f_pvb > 1.0 + 1e-9:
+    if any(f < 0 for f in fracs) or sum(fracs) > 1.0 + 1e-9:
         print(json.dumps({"ok": False,
                           "error": f"--mix fractions must be >= 0 and sum "
-                                   f"<= 1, got {[f_pv, f_bat, f_pvb]}"}))
+                                   f"<= 1, got {list(fracs)}"}))
         sys.exit(2)
     cfg["community"]["homes_pv"] = int(f_pv * n)
     cfg["community"]["homes_battery"] = int(f_bat * n)
     cfg["community"]["homes_pv_battery"] = int(f_pvb * n)
+    cfg["community"]["homes_ev"] = int(f_ev * n)
+    cfg["community"]["homes_heat_pump"] = int(f_hp * n)
     cfg["home"]["hems"]["prediction_horizon"] = args.horizon_hours
     cfg["home"]["hems"]["solver"] = args.solver
     cfg["tpu"]["bucketed"] = args.bucketed
+    if args.pack:
+        # Scenario pack: [mix] overrides the counts above, [[events]]
+        # become the engine's event timeline (dragg_tpu/scenarios).
+        from dragg_tpu.scenarios import apply_scenarios
+
+        cfg["scenarios"]["pack"] = args.pack
+        cfg = apply_scenarios(cfg, args.data_dir or None)
 
     from dragg_tpu.data import waterdraw_path
 
@@ -168,6 +186,12 @@ def main():
     twh_min = np.asarray(batch.temp_wh_min)[order]
     twh_max = np.asarray(batch.temp_wh_max)[order]
     band_tol = 0.05  # fp32 dynamics-row tolerance on ~degC scales
+    # Scenario event windows legitimately widen the indoor band by the
+    # scheduled comfort relief (DR / outage relaxation — ops/qp.py), so
+    # the static-band check must grant the same headroom.
+    evts = getattr(eng, "_events", None)
+    if evts is not None:
+        band_tol += float(np.max(evts.relax))
 
     from dragg_tpu.resilience.faults import fault_hook
     from dragg_tpu.resilience.heartbeat import beat
@@ -222,7 +246,10 @@ def main():
         "sharded": bool(args.sharded),
         "n_devices": len(jax.devices()) if args.sharded else 1,  # device-call-ok: supervised child
         "home_slots": eng.n_homes,
-        "mix": [f_pv, f_bat, f_pvb],
+        "mix": list(fracs),
+        "pack": args.pack,
+        "events": describe_timeline(getattr(eng, "_events", None)),
+        "bucket_patterns": len(eng.bucket_info()),
         "bucketed": eng.bucketed,
         "solve_rate": round(solve_rate, 4),
         "comfort_violation_max": round(viol_max, 5),
