@@ -36,8 +36,12 @@ comparison environment (VERDICT r5 weak #3).
 
 Besides the headline rate the JSON carries per-phase timers
 (assemble / solve / merge+collect), the solver iteration count, XLA's FLOP
-estimate for the compiled chunk, and an MFU estimate against the chip's
-peak (device_kind-keyed table).
+estimate for the compiled chunk, and an MFU estimate whose denominator is
+resolved by :func:`peak_flops_for` (device_kind-keyed TPU spec table,
+``--peak-tflops`` override, or the clearly-labelled CPU estimate) and
+named in ``mfu_basis`` — the key is never silently dropped (ISSUE 11).
+``precision`` (the hot-loop matmul policy) rides the JSON as a HARD
+bench_trend series key.
 
 The benchmarked config defaults to the SHIPPED bundled-data environment
 (VERDICT r5 weak #3); ``--synthetic`` pins the rounds-2..4 generators for
@@ -69,6 +73,37 @@ PEAK_FLOPS = [
     ("v5p", 459e12), ("v5e", 394e12), ("v5 lite", 394e12), ("v5", 459e12),
     ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
 ]
+
+# CPU fallback peak: an ORDER-OF-MAGNITUDE estimate, clearly labelled as
+# such in ``mfu_basis`` (ISSUE 11 satellite — ``peak`` was silently None
+# off-TPU, which dropped MFU from every committed artifact since all
+# five are CPU fallbacks).  Model: ~32 host cores × ~64 GFLOP/s f32 FMA
+# (AVX-512-class) ≈ 2 TFLOP/s.  CPU-MFU values are for ROOFLINE-DISTANCE
+# reading only, never cross-platform comparison — the basis field keys
+# that.
+CPU_PEAK_FLOPS_EST = 2.0e12
+
+
+def peak_flops_for(device_kind: str, platform: str,
+                   override_tflops: float | None = None
+                   ) -> tuple[float | None, str | None]:
+    """(peak FLOPs/s, mfu_basis label) for the measured device.
+
+    Resolution: an explicit ``--peak-tflops`` override wins (basis
+    ``"override"``; argparse rejects non-positive values, so the
+    override can never silently void the denominator), then the
+    device_kind-keyed TPU spec table (basis ``"tpu_spec:<key>"``), then
+    the labelled CPU estimate (basis ``"cpu_estimate"``).  An unmatched
+    accelerator returns (None, None) → the JSON carries ``mfu: null``
+    WITH the null basis instead of silently dropping the key."""
+    if override_tflops is not None:
+        return float(override_tflops) * 1e12, "override"
+    for key, val in PEAK_FLOPS:
+        if key in str(device_kind).lower():
+            return val, f"tpu_spec:{key}"
+    if platform == "cpu":
+        return CPU_PEAK_FLOPS_EST, "cpu_estimate"
+    return None, None
 
 # Peak HBM bandwidth per chip (bytes/s, public spec numbers).  The IPM's
 # band kernels have negligible matmul FLOPs — the meaningful utilization
@@ -140,7 +175,8 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
           data_dir: str | None = None, semantics: str = "default",
           bucketed: str = "auto", per_home_obs: str = "true",
           communities: int = 1, mix: dict[str, float] | None = None,
-          pack: str | None = None):
+          pack: str | None = None, precision: str = "f32",
+          iter_kernel: str | None = None):
     """Build THE benchmark community engine (population mix, sim window,
     solver config).  This is the one definition of the measured community —
     tools/bench_engine_kernels.py reuses it so kernel A/B verdicts are
@@ -185,6 +221,12 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
     cfg["telemetry"]["per_home"] = per_home_obs == "true"
     if band_kernel is not None:
         cfg["tpu"]["band_kernel"] = band_kernel
+    # Hot-loop matmul policy + fused iteration kernel (ISSUE 11): the
+    # precision is a HARD bench_trend series key, so it must land in the
+    # engine exactly as the artifact will record it.
+    cfg["tpu"]["precision"] = precision
+    if iter_kernel is not None:
+        cfg["tpu"]["iter_kernel"] = iter_kernel
     if semantics != "default":
         # "integer"/"relaxation" override the shipped default so on-chip
         # A/Bs and cross-round comparisons (rounds <=4 measured the
@@ -279,7 +321,7 @@ def run_measured(args) -> dict:
                        bucketed=args.bucketed,
                        per_home_obs=args.per_home_obs,
                        communities=args.communities,
-                       mix=mix, pack=args.pack)
+                       mix=mix, pack=args.pack, precision=args.precision)
     solver_used = engine.params.solver
     if args.solver == "auto":
         # Race the two solver families over SEVERAL sequential steps and
@@ -297,7 +339,8 @@ def run_measured(args) -> dict:
                                   bucketed=args.bucketed,
                                   per_home_obs=args.per_home_obs,
                                   communities=args.communities,
-                                  mix=mix, pack=args.pack)
+                                  mix=mix, pack=args.pack,
+                                  precision=args.precision)
 
             def steps_time(eng, k=6, budget_s=60.0):
                 """Mean warm-step time over up to k steps, stopping early
@@ -524,11 +567,9 @@ def run_measured(args) -> dict:
     # for buckets that freeze earlier).
     K = max(1, engine.params.admm_refactor_every)
     mean_iters = float(np.mean(iters_per_step))
-    mfu = peak = None
-    for key, val in PEAK_FLOPS:
-        if key in str(device_kind).lower():
-            peak = val
-            break
+    mfu = None
+    peak, mfu_basis = peak_flops_for(device_kind, platform,
+                                     args.peak_tflops)
     hbm_util = bytes_per_step = None
     if solver_used == "admm":
         flops_iter = sum(6.0 * b["n_slots"] * b["m_eq"] ** 2 for b in binfo)
@@ -679,6 +720,19 @@ def run_measured(args) -> dict:
         # every headline artifact must state which semantics ran).
         "semantics": ("integer" if engine.params.integer_first_action
                       else "relaxation"),
+        # Hot-loop matmul policy (ISSUE 11): tools/bench_trend.py treats
+        # ``precision`` as a HARD series key (era default "f32") — a
+        # bf16x3 rate is a different numerical contract and never gates
+        # against the f32 history.  The EFFECTIVE policy is recorded:
+        # the ipm has no dense matmuls and ignores the key (its math is
+        # bit-identical to f32), so labelling such a run "bf16x3" would
+        # fork its trend series with numerically identical rows and
+        # silently ungate real regressions.  ``iter_kernel`` is the
+        # RESOLVED fused-window implementation (reluqp only).
+        "precision": (engine.params.precision
+                      if solver_used in ("admm", "reluqp") else "f32"),
+        "iter_kernel": (engine.iter_kernel
+                        if solver_used == "reluqp" else None),
         "data": data_label,
         "band_kernel": (engine.admm_band_kernel if solver_used == "admm"
                         else engine.band_kernel),
@@ -701,6 +755,13 @@ def run_measured(args) -> dict:
         "phase_s_per_step": {k: round(v, 4) for k, v in phases.items()} if phases else None,
         "flops_per_step_est": flops_per_step,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        # What ``mfu`` was computed AGAINST (ISSUE 11 satellite):
+        # "tpu_spec:<key>" = the device_kind-keyed public spec table,
+        # "cpu_estimate" = the clearly-labelled order-of-magnitude CPU
+        # peak (roofline-distance reading only, never cross-platform),
+        # "override" = --peak-tflops; null with mfu null = unmatched
+        # accelerator (the key is never silently dropped).
+        "mfu_basis": mfu_basis if mfu is not None else None,
         "hbm_bytes_per_step_est": bytes_per_step,
         "hbm_util": round(hbm_util, 4) if hbm_util is not None else None,
         # reluqp only: whether the pre-factorized path sufficed, or some
@@ -743,7 +804,10 @@ def child_argv(args, platform: str, attempt: int,
         "--bucketed", args.bucketed,
         "--per-home-obs", args.per_home_obs,
         "--communities", str(args.communities),
+        "--precision", args.precision,
     ]
+    if args.peak_tflops is not None:
+        cmd += ["--peak-tflops", str(args.peak_tflops)]
     if args.mix is not None:
         cmd += ["--mix", args.mix]
     if args.pack is not None:
@@ -808,6 +872,24 @@ def main() -> None:
                          "attribution fold (histograms + worst-k on the "
                          "StepOutputs transfer); false compiles it out — "
                          "for the observatory overhead A/B")
+    ap.add_argument("--precision", choices=["f32", "bf16x3"], default="f32",
+                    help="tpu.precision hot-loop matmul policy (ISSUE 11): "
+                         "bf16x3 = 3-pass bf16 compute with f32 "
+                         "accumulation in the dense solver iterations "
+                         "(reluqp/admm), f32 residual path; a HARD "
+                         "bench_trend series key — bf16x3 rows never "
+                         "gate against f32 history")
+    def _positive_tflops(text):
+        v = float(text)
+        if v <= 0:
+            raise argparse.ArgumentTypeError(
+                f"--peak-tflops must be > 0, got {v}")
+        return v
+
+    ap.add_argument("--peak-tflops", type=_positive_tflops, default=None,
+                    help="override the per-platform peak-FLOPs table "
+                         "(TFLOP/s) for the MFU denominator; the JSON's "
+                         "mfu_basis then reads 'override'")
     ap.add_argument("--semantics", choices=["default", "integer", "relaxation"],
                     default="default",
                     help="integer = integer_first_action repair (the shipped "

@@ -855,6 +855,14 @@ class Aggregator:
             # the other, not silently cross-seed it (round 10).
             "solver": (self.engine.params.solver
                        if self.engine is not None else None),
+            # Hot-loop matmul policy (ISSUE 11): warm iterates written
+            # under bf16x3 sit at a different fixed-point accuracy than
+            # f32 ones even at identical leaf shapes/dtypes (the carry
+            # itself stays f32 by the ops/precision discipline), and a
+            # mid-run policy flip would silently mix the two trajectories
+            # — invalidate, don't cross-seed.
+            "precision": (self.engine.params.precision
+                          if self.engine is not None else None),
             # Sharded engines pad the home axis, so the carry leaves are
             # sized by the SLOT count — a checkpoint from a different
             # device count / sharding mode must start fresh, not crash in

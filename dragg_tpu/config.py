@@ -402,6 +402,20 @@ _DEFAULT: dict[str, Any] = {
                                    # measured rescue depth for warm steps
                                    # jammed by a stale bank — see
                                    # ops/reluqp.py tail_iters)
+        # Mixed-precision MXU policy (ISSUE 11 — ops/precision.py,
+        # docs/architecture.md §16): "bf16x3" runs the dense families'
+        # hot-loop matmuls (reluqp x-update, admm dense_inv apply) as
+        # 3-pass bf16 with f32 accumulation; residual/check/warm-start
+        # tensors stay f32 ALWAYS (rounds 2/9 measured bf16 storage
+        # diverging — the policy is compute-only by construction).
+        # "f32" (default) is bit-identical to the pre-policy engine.
+        "precision": "f32",
+        # Fused reluqp check-window kernel (ops/pallas_iter.py): one
+        # Pallas launch per check window (matmuls + clamp + residual-max
+        # reduction, VMEM-resident).  "auto" resolves to "lax" until the
+        # on-chip A/B (tools/bench_engine_kernels.py --iter-kernels)
+        # records a verdict; "pallas" forces it (f32-only, unsharded).
+        "iter_kernel": "auto",
         "ipm_warm_start": False,  # seed the IPM from the receding-horizon
                                   # shift — measured PESSIMIZATION (+55%
                                   # steady-state iterations, warm-start

@@ -402,6 +402,17 @@ class EngineParams(NamedTuple):
     reluqp_bank: int = 5           # bank size R
     reluqp_iters: int = 2000       # banked-loop iteration cap
     reluqp_tail_iters: int = 300   # fallback exact-refactor tail budget
+    # Mixed-precision MXU policy (ISSUE 11; trailing defaults keep direct
+    # constructions valid).  ``precision`` applies to the DENSE families'
+    # hot-loop matmuls only (reluqp x-update, admm dense_inv apply) —
+    # residual/check/warm-start tensors stay f32 by construction
+    # (ops/precision.py; docs/architecture.md §16).  ``iter_kernel``
+    # selects the fused Pallas check-window kernel for reluqp
+    # (ops/pallas_iter.py): "auto" resolves to "lax" until the on-chip
+    # A/B (tools/bench_engine_kernels.py --iter-kernels) records a
+    # verdict — the perf_notes rule: no default without a measurement.
+    precision: str = "f32"         # "f32" | "bf16x3"
+    iter_kernel: str = "auto"      # "auto" | "pallas" | "lax"
 
 
 class Engine:
@@ -578,6 +589,25 @@ class Engine:
         # FactorCarry; the CR "factor" is a pytree, so the ADMM path keeps
         # the scan kernels when cr is selected (the IPM uses cr fully).
         self._admm_band_kernel = "xla" if kern == "cr" else kern
+        # Resolve the fused iteration kernel (ISSUE 11): "auto" stays on
+        # the lax path EVERYWHERE until the engine-level on-chip A/B
+        # (tools/bench_engine_kernels.py --iter-kernels) records a
+        # verdict in docs/perf_notes.md — unlike band_kernel's auto,
+        # there is no measured pallas win to encode yet.  An explicit
+        # "pallas" is honored (interpret mode off-TPU, same contract as
+        # the band kernels) except under a multi-device mesh, where the
+        # kernel is not shard_map-wired — degrade to lax rather than
+        # miscompile.
+        ik = params.iter_kernel
+        if ik not in ("auto", "pallas", "lax"):
+            raise ValueError(
+                f"tpu.iter_kernel must be auto|pallas|lax, got {ik!r}")
+        if ik == "auto":
+            ik = "lax"
+        if ik == "pallas" and (params.precision != "f32"
+                               or getattr(self, "_mesh_shards", 1) > 1):
+            ik = "lax"
+        self._iter_kernel = ik
         # Whether CommunityState carries the receding-horizon warm start:
         # only the ADMM solver and the (measured-pessimal, opt-in)
         # ipm_warm_start consume it — see init_state / warm_cols.
@@ -783,6 +813,15 @@ class Engine:
         when the ADMM solver ran, or a cr-configured ADMM run would look
         like a cr measurement."""
         return self._admm_band_kernel
+
+    @property
+    def iter_kernel(self) -> str:
+        """The RESOLVED fused-iteration kernel for the reluqp family
+        ("pallas" | "lax") — "auto" has been settled (to "lax", pending
+        the on-chip A/B verdict), and a forced "pallas" has been degraded
+        to "lax" under a multi-device mesh or a non-f32 precision, so
+        A/B artifacts record which window implementation actually ran."""
+        return self._iter_kernel
 
     @property
     def warm_cols(self):
@@ -1206,6 +1245,8 @@ class Engine:
                     iters=p.reluqp_iters,
                     patience=p.admm_patience,
                     tail_iters=p.reluqp_tail_iters,
+                    precision=p.precision,
+                    iter_kernel=self._iter_kernel,
                     x0=x0, y_box0=y0, rho_warm=rho_w,
                 )
 
@@ -1236,6 +1277,7 @@ class Engine:
                 patience=p.admm_patience,
                 rho_update_every=p.admm_rho_update_every,
                 matvec_dtype=p.admm_matvec_dtype,
+                precision=p.precision,
                 refine=p.admm_refine,
                 anderson=p.admm_anderson,
                 banded_factor=p.admm_banded_factor,
@@ -1898,6 +1940,21 @@ def engine_params(config, start_index: int) -> EngineParams:
         raise ValueError(
             f"tpu.bucketed must be auto|true|false, got "
             f"{tpu_cfg.get('bucketed')!r}")
+    # Mixed-precision policy + fused iteration kernel (ISSUE 11):
+    # validated against the ops/precision registry so a typo'd policy
+    # fails the build, not the first solve.
+    from dragg_tpu.ops.precision import validate_precision
+
+    precision = validate_precision(str(tpu_cfg.get("precision", "f32")))
+    iter_kernel = str(tpu_cfg.get("iter_kernel", "auto"))
+    if iter_kernel not in ("auto", "pallas", "lax"):
+        raise ValueError(
+            f"tpu.iter_kernel must be auto|pallas|lax, got {iter_kernel!r}")
+    if iter_kernel == "pallas" and precision != "f32":
+        raise ValueError(
+            "tpu.iter_kernel='pallas' requires tpu.precision='f32' — the "
+            "fused window computes its residual reduction in-kernel and "
+            "is f32 end-to-end (ops/pallas_iter.py)")
     return EngineParams(
         solver=solver,
         horizon=horizon,
@@ -1944,6 +2001,8 @@ def engine_params(config, start_index: int) -> EngineParams:
         reluqp_bank=max(1, int(tpu_cfg.get("reluqp_bank", 5))),
         reluqp_iters=int(tpu_cfg.get("reluqp_iters", 2000)),
         reluqp_tail_iters=int(tpu_cfg.get("reluqp_tail_iters", 300)),
+        precision=precision,
+        iter_kernel=iter_kernel,
     )
 
 

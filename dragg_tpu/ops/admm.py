@@ -66,6 +66,7 @@ from jax import lax
 
 from dragg_tpu.ops import pallas_band
 from dragg_tpu.ops.banded import banded_explicit_inverse, plan_for
+from dragg_tpu.ops.precision import f32_guard, mxu_einsum, validate_precision
 from dragg_tpu.ops.qp import (
     SparsePattern,
     build_schur_structure,
@@ -242,6 +243,14 @@ def _admm_impl(
                                 # matvec; refinement against the f32 S
                                 # recovers accuracy (opt-in: effective only
                                 # when cond(Ŝ) stays modest)
+    precision: str = "f32",  # hot-loop matmul policy (ops/precision.py):
+                             # "bf16x3" runs the dense_inv backend's
+                             # per-iteration Sinv apply as 3-pass bf16
+                             # with f32 accumulation; residuals,
+                             # refinement, and the factorization stay
+                             # f32 (round-2/9 negative results).  The
+                             # band backend has no dense matmuls and
+                             # ignores the policy.
     refine: int = 1,         # iterative-refinement passes per in-loop solve
     banded_factor: bool = True,  # factor S via RCM + banded Cholesky scans
                                  # (O(Bm·bw²)) instead of batched dense
@@ -287,6 +296,7 @@ def _admm_impl(
     B = vals.shape[0]
     m_eq, n = pat.m, pat.n
     dtype = vals.dtype
+    validate_precision(precision)
     store_dtype = jnp.bfloat16 if matvec_dtype == "bf16" else dtype
 
     rows = jnp.asarray(pat.rows)
@@ -343,7 +353,7 @@ def _admm_impl(
             return form_schur_sparse(schur, m_eq, vals_s, Dinv)
         As_dense = jnp.zeros((B, m_eq, n), dtype=dtype).at[:, rows, cols].add(vals_s)
         ADi = As_dense * Dinv[:, None, :]
-        return jnp.einsum("bmn,bkn->bmk", ADi, As_dense, precision=lax.Precision.HIGHEST)
+        return mxu_einsum("bmn,bkn->bmk", ADi, As_dense, precision="f32")
 
     band_plan = plan_for(schur, m_eq) if (banded_factor and schur is not None) else None
     backend = resolve_backend(solve_backend, B, m_eq, band_plan is not None,
@@ -385,8 +395,9 @@ def _admm_impl(
             Linv = lax.linalg.triangular_solve(
                 L, jnp.broadcast_to(eye_m, S.shape), left_side=True, lower=True
             )
-            Sinv = jnp.einsum("bkm,bkn->bmn", Linv, Linv,
-                              precision=lax.Precision.HIGHEST)
+            # Factorization-path Gram product: pinned f32 regardless of
+            # the hot-loop policy (the factor must be accurate).
+            Sinv = mxu_einsum("bkm,bkn->bmn", Linv, Linv, precision="f32")
         return Dinv, Sinv.astype(store_dtype), S
 
     def stale_factor(rho_b):
@@ -408,14 +419,16 @@ def _admm_impl(
             v = band_solve_fn(Lb, Sb, r[:, perm_ix], refine)
             return v[:, invp_ix]
         _, Sinv, S = F
-        pinv = lambda rr: jnp.einsum(
+        # The dominant per-iteration matmul — runs at the configured
+        # hot-loop policy; the refinement residual against the exact S
+        # stays pinned f32 (it is what corrects the low-precision apply).
+        pinv = lambda rr: mxu_einsum(
             "bmn,bn->bm", Sinv, rr.astype(Sinv.dtype),
-            precision=lax.Precision.HIGHEST,
-            preferred_element_type=dtype,
+            precision=precision, out_dtype=dtype,
         )
         v = pinv(r)
         for _ in range(refine):
-            resid = r - jnp.einsum("bmn,bn->bm", S, v, precision=lax.Precision.HIGHEST)
+            resid = r - mxu_einsum("bmn,bn->bm", S, v, precision="f32")
             v = v + pinv(resid)
         return v
 
@@ -433,7 +446,12 @@ def _admm_impl(
     z_box = jnp.clip(w * x, ls, us)
 
     def residuals(x, z_box, nu, y_box):
-        """Unscaled residuals + relative scalings (OSQP sec. 3.4, 5.1)."""
+        """Unscaled residuals + relative scalings (OSQP sec. 3.4, 5.1).
+        ALWAYS f32 — trace-time guarded (ops/precision.py): the sparse
+        matvecs and every reduction below decide convergence and may
+        never inherit the hot loop's reduced precision."""
+        x = f32_guard(x, "admm residual iterate x")
+        y_box = f32_guard(y_box, "admm residual dual y_box")
         Ax = mv(x)
         wx = w * x
         r_p_eq = jnp.max(jnp.abs((Ax - bs) / e_eq), axis=1)
@@ -524,8 +542,8 @@ def _admm_impl(
         ages = jnp.mod(widx - jnp.arange(K_aa), K_aa)        # (K,)
         valid = ages[None, :] < cnt[:, None]                 # (B, K)
         G = jnp.transpose(hist_s - hist_t, (1, 0, 2)) * valid[..., None]  # (B, K, D)
-        M = jnp.einsum("bkd,bjd->bkj", G, G, precision=lax.Precision.HIGHEST)
-        gnorm = jnp.maximum(jnp.einsum("bkk->b", M), 1e-12)
+        M = mxu_einsum("bkd,bjd->bkj", G, G, precision="f32")
+        gnorm = jnp.maximum(jnp.einsum("bkk->b", M), 1e-12)  # precision-ok: diagonal trace, not a matmul
         M = M + (1e-8 * gnorm)[:, None, None] * jnp.eye(K_aa, dtype=dtype)
         # Invalid slots: unit diagonal, excluded from the sum-to-one row.
         inv = ~valid
@@ -539,7 +557,7 @@ def _admm_impl(
         rhs = jnp.zeros((B, K_aa + 1), dtype).at[:, -1].set(1.0)
         gamma = jnp.linalg.solve(kkt, rhs[..., None])[..., 0][:, :K_aa]  # (B, K)
         gamma = gamma * o
-        s_acc = jnp.einsum("bk,kbd->bd", gamma, hist_t)
+        s_acc = jnp.einsum("bk,kbd->bd", gamma, hist_t)  # precision-ok: AA extrapolation weights (check-window work, historical default precision kept bit-exact)
         finite = jnp.all(jnp.isfinite(s_acc), axis=1)
         use = (cnt >= 2) & ~done & ~revert & finite
         s_next = jnp.where(use[:, None], s_acc, base)
@@ -656,7 +674,8 @@ def _admm_impl(
 
 
 _STATIC = ("pat", "iters", "check_every", "ruiz_iters", "adaptive_rho",
-           "rho_update_every", "patience", "matvec_dtype", "refine", "anderson",
+           "rho_update_every", "patience", "matvec_dtype", "precision",
+           "refine", "anderson",
            "banded_factor", "solve_backend", "band_kernel", "mesh", "mesh_axis")
 
 

@@ -1,0 +1,109 @@
+"""Mixed-precision policy for the dense solver hot loops (ISSUE 11).
+
+One module owns the cast discipline so no call site hand-rolls dtypes:
+every dense matmul/einsum in the MXU families (``ops/reluqp.py``'s
+banked iteration, ``ops/admm.py``'s dense_inv apply path) routes through
+:func:`mxu_einsum`, and the residual/convergence path declares itself
+with :func:`f32_guard` — ``tools/lint.py`` rejects bare ``jnp.einsum``
+in those files so the discipline cannot erode silently.
+
+Two policies (``tpu.precision``):
+
+* ``"f32"`` (default): BIT-IDENTICAL to the pre-policy code —
+  ``jnp.einsum(..., precision=lax.Precision.HIGHEST)``, nothing cast.
+* ``"bf16x3"``: each f32 operand splits into a bf16 high part and a
+  bf16 low remainder (``hi = bf16(x)``, ``lo = bf16(x - f32(hi))``) and
+  the contraction runs as THREE bf16-input matmuls accumulated in f32
+  (``lo·hi + hi·lo + hi·hi`` — the classical 3-product scheme, dropping
+  the O(2⁻¹⁶)-squared ``lo·lo`` term).  On the MXU each pass runs at
+  bf16 throughput with native f32 accumulation, so the x-update costs
+  ~3/6 of XLA's default HIGHEST-precision f32 emulation; the combined
+  relative error is ~2⁻¹⁶ per contraction — well under the solvers'
+  1e-4 tolerances when the residual path stays f32.
+
+Why this exact shape and not plain bf16 storage: rounds 2 and 9 both
+measured bf16 STORAGE diverging (docs/perf_notes.md "Matvec-precision
+and refinement experiments" — bf16 Sinv with refine=0 solved 0/6; and
+"Negative result: bf16 storage for the IPM's gathered A-tables" — the
+primal residual floor sits above eps once A itself is rounded).  The
+prescription recorded there is bf16 COMPUTE with fp32 accumulation and
+an f32 residual/convergence path — which is precisely the split this
+module enforces: the ITERATION may run low precision (it only has to
+land near the fixed point), the residual DECIDING convergence may not.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+# The policy registry — config validation (engine.engine_params), the
+# bench --precision flag, and tools/bench_engine_kernels.py all resolve
+# against this tuple.
+PRECISIONS = ("f32", "bf16x3")
+
+
+def validate_precision(name: str) -> str:
+    """Raise ValueError unless ``name`` is a registered policy."""
+    if name not in PRECISIONS:
+        raise ValueError(
+            f"tpu.precision must be one of {'|'.join(PRECISIONS)}, "
+            f"got {name!r}")
+    return name
+
+
+def _split_bf16(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(hi, lo) bf16 split of ``x``: hi carries the top ~8 mantissa bits,
+    lo the next ~8 (computed against hi in f32).  An already-bf16 operand
+    (the ADMM's opt-in bf16 Sinv storage) splits to (x, 0) — correct,
+    just redundant."""
+    hi = x.astype(jnp.bfloat16)
+    lo = (x.astype(jnp.float32) - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def mxu_einsum(spec: str, a: jnp.ndarray, b: jnp.ndarray, *,
+               precision: str = "f32", out_dtype=None) -> jnp.ndarray:
+    """THE dense contraction of the solver hot paths.
+
+    ``precision="f32"`` reproduces the historical call bit-for-bit:
+    ``jnp.einsum(spec, a, b, precision=lax.Precision.HIGHEST,
+    preferred_element_type=out_dtype)`` — the f32 default engine is
+    therefore identical to the pre-policy engine by construction
+    (pinned in tests/test_precision.py).
+
+    ``precision="bf16x3"`` runs the 3-product bf16 split with f32
+    accumulation (module docstring).  The result is f32 (cast to
+    ``out_dtype`` when given); accumulation is ALWAYS f32 — there is no
+    policy under which a contraction accumulates in bf16, per the
+    round-2/9 negative results.
+    """
+    if precision == "f32":
+        return jnp.einsum(spec, a, b, precision=lax.Precision.HIGHEST,
+                          preferred_element_type=out_dtype)
+    validate_precision(precision)
+    a_hi, a_lo = _split_bf16(a)
+    b_hi, b_lo = _split_bf16(b)
+
+    def p(x, y):
+        return jnp.einsum(spec, x, y, preferred_element_type=jnp.float32)
+
+    # Small cross terms first, head term last (adds the large term into
+    # an already-combined small correction — marginally better rounding).
+    out = (p(a_lo, b_hi) + p(a_hi, b_lo)) + p(a_hi, b_hi)
+    return out if out_dtype is None else out.astype(out_dtype)
+
+
+def f32_guard(x: jnp.ndarray, what: str) -> jnp.ndarray:
+    """Trace-time assertion that a residual/convergence-path tensor is
+    f32.  Dtypes are static under tracing, so this costs nothing at run
+    time and fails at ENGINE BUILD if a low-precision value ever leaks
+    into the path that decides convergence (the round-2/9 divergence
+    mode).  Returns ``x`` so call sites can wrap in place."""
+    if x.dtype != jnp.float32:
+        raise TypeError(
+            f"precision discipline: {what} must be float32 on the "
+            f"residual/convergence path, got {x.dtype} — only the "
+            f"x-update matmuls may run reduced precision "
+            f"(ops/precision.py, docs/architecture.md §16)")
+    return x

@@ -68,6 +68,7 @@ from dragg_tpu.ops.admm import (
     _schur_structure_for,
     ruiz_equilibrate_sparse,
 )
+from dragg_tpu.ops.precision import f32_guard, mxu_einsum, validate_precision
 from dragg_tpu.ops.qp import SparsePattern, scatter_schur, schur_contrib
 
 
@@ -144,8 +145,9 @@ def equilibrated_spd_inverse(S: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
         L = jnp.linalg.cholesky(Sx)
         Linv = lax.linalg.triangular_solve(
             L, jnp.broadcast_to(eye, Sx.shape), left_side=True, lower=True)
-        Sinv = jnp.einsum("bkm,bkn->bmn", Linv, Linv,
-                          precision=lax.Precision.HIGHEST)
+        # Factorization-path Gram product: pinned f32 regardless of the
+        # hot-loop policy (the bank must be an accurate inverse).
+        Sinv = mxu_einsum("bkm,bkn->bmn", Linv, Linv, precision="f32")
         ok = jnp.all(jnp.isfinite(Sinv), axis=(1, 2))
         return Sinv, ok
 
@@ -202,6 +204,18 @@ def _reluqp_impl(
                              # depth — 100 left 3/64 homes unsolved at
                              # the 64-home mixed fixture, 300 solves all
                              # (tests/test_reluqp.py equivalence suite)
+    precision: str = "f32",  # hot-loop matmul policy (ops/precision.py):
+                             # "bf16x3" runs the x-update einsums as
+                             # 3-pass bf16 with f32 accumulation; the
+                             # residual/check path below is ALWAYS f32
+                             # (the round-2/9 divergence mode lives
+                             # exactly in low-precision residuals)
+    iter_kernel: str = "lax",  # "pallas": run each check window as ONE
+                               # fused kernel (ops/pallas_iter.py —
+                               # matmuls + clamp + residual-max without
+                               # HBM round trips); f32-only, engine-
+                               # resolved ("auto" stays lax until the
+                               # on-chip A/B records a verdict)
     x0: jnp.ndarray | None = None,
     y_box0: jnp.ndarray | None = None,
     rho_warm: jnp.ndarray | None = None,  # (B,) unscaled rho hint — snapped
@@ -216,6 +230,13 @@ def _reluqp_impl(
     m_eq, n = pat.m, pat.n
     dtype = vals.dtype
     R = int(bank)
+    validate_precision(precision)
+    if iter_kernel not in ("lax", "pallas"):
+        raise ValueError(f"iter_kernel must be lax|pallas, got {iter_kernel!r}")
+    if iter_kernel == "pallas" and precision != "f32":
+        # The fused window is f32 end-to-end (its residual reduction runs
+        # in-kernel); a bf16x3 hot loop composes with the lax path only.
+        raise ValueError("iter_kernel='pallas' requires precision='f32'")
 
     rows = np.asarray(pat.rows)
     cols = np.asarray(pat.cols)
@@ -244,15 +265,15 @@ def _reluqp_impl(
     # The dense scaled Â — materialized per call (it is transient; only
     # the bank persists in the carry).  Both hot-loop matvec directions
     # become batched dense einsums over it: MXU work by construction.
+    # ``prec="f32"`` (the default everywhere below except the x-update)
+    # is bit-identical to the historical HIGHEST-precision einsums.
     A_dense = jnp.zeros((B, m_eq, n), dtype=dtype).at[:, rows, cols].add(vals_s)
 
-    def mv(x):
-        return jnp.einsum("bmn,bn->bm", A_dense, x,
-                          precision=lax.Precision.HIGHEST)
+    def mv(x, prec="f32"):
+        return mxu_einsum("bmn,bn->bm", A_dense, x, precision=prec)
 
-    def mvt(y):
-        return jnp.einsum("bmn,bm->bn", A_dense, y,
-                          precision=lax.Precision.HIGHEST)
+    def mvt(y, prec="f32"):
+        return mxu_einsum("bmn,bm->bn", A_dense, y, precision=prec)
 
     def mvt_raw(y):
         """A_eqᵀ y with UNSCALED values (infeasibility certificate —
@@ -273,8 +294,7 @@ def _reluqp_impl(
             return scatter_schur(schur, m_eq,
                                  schur_contrib(schur, vals_s, Dinv))
         ADi = A_dense * Dinv[:, None, :]
-        return jnp.einsum("bmn,bkn->bmk", ADi, A_dense,
-                          precision=lax.Precision.HIGHEST)
+        return mxu_einsum("bmn,bkn->bmk", ADi, A_dense, precision="f32")
 
     def build_bank():
         """The pre-factorized operator bank: one equilibrated,
@@ -317,7 +337,12 @@ def _reluqp_impl(
 
     def residuals(x, z_box, nu, y_box):
         """Unscaled residuals + relative scalings (OSQP §3.4, §5.1) —
-        identical math to ops/admm.py, dense matvecs."""
+        identical math to ops/admm.py, dense matvecs.  ALWAYS f32: the
+        matvecs here run at full precision whatever the hot-loop policy,
+        and the guard fails the trace if a reduced-precision iterate ever
+        leaks in un-upcast (ops/precision.py discipline)."""
+        x = f32_guard(x, "reluqp residual iterate x")
+        y_box = f32_guard(y_box, "reluqp residual dual y_box")
         Ax = mv(x)
         wx = w * x
         r_p_eq = jnp.max(jnp.abs((Ax - bs) / e_eq), axis=1)
@@ -358,13 +383,15 @@ def _reluqp_impl(
         return cond1 & (sup <= -eps_inf) & (norm_dy > 1e-10)
 
     def one_iter(Sinv_sel, Dinv, rho_b, carry):
-        """One dense iteration: 3 einsums + clamp — branch-free."""
+        """One dense iteration: 3 einsums + clamp — branch-free.  The
+        three matmuls run at the configured hot-loop policy; everything
+        elementwise stays f32 (the bf16x3 products re-accumulate in f32,
+        so the carry never leaves f32)."""
         x, z_box, nu, y_box = carry
         rhs = sigma * x - qs + w * (rho_b[:, None] * z_box - y_box)
-        t = mv(Dinv * rhs) - bs
-        nu_t = jnp.einsum("bmn,bn->bm", Sinv_sel, t,
-                          precision=lax.Precision.HIGHEST)
-        x_t = Dinv * (rhs - mvt(nu_t))
+        t = mv(Dinv * rhs, precision) - bs
+        nu_t = mxu_einsum("bmn,bn->bm", Sinv_sel, t, precision=precision)
+        x_t = Dinv * (rhs - mvt(nu_t, precision))
         z_t = w * x_t
         x_new = alpha * x_t + (1.0 - alpha) * x
         v = alpha * z_t + (1.0 - alpha) * z_box + y_box / rho_b[:, None]
@@ -377,6 +404,24 @@ def _reluqp_impl(
         return lax.fori_loop(
             0, k, lambda _, cc: one_iter(Sinv_sel, Dinv, rho_b, cc), state)
 
+    def window_resid(Sinv_sel, Dinv, rho_b, state, k):
+        """One check window + its residual evaluation.  Under the fused
+        Pallas kernel both run in ONE launch (ops/pallas_iter.py) with
+        the residual-max reduction computed in-kernel f32; the lax path
+        is the historical window + residuals composition, bit-identical
+        to pre-kernel code."""
+        if iter_kernel == "pallas":
+            from dragg_tpu.ops import pallas_iter
+
+            st, (r_prim, r_dual, p_sc, d_sc) = pallas_iter.fused_window(
+                A_dense, Sinv_sel, Dinv, w, qs, bs, ls, us, rho_b, *state,
+                e_eq, e_box, c * d, p_diag, k=k, sigma=sigma, alpha=alpha)
+            ok = ((r_prim <= eps_abs + eps_rel * p_sc)
+                  & (r_dual <= eps_abs + eps_rel * d_sc))
+            return st, (r_prim, r_dual, p_sc, d_sc, ok)
+        st = window(Sinv_sel, Dinv, rho_b, state, k)
+        return st, residuals(*st)
+
     def chunk(carry):
         (state, idx, it, _, pinf, best_done, best_r, last_improve,
          conv_it) = carry
@@ -384,9 +429,9 @@ def _reluqp_impl(
         rho_b = bank_arr[idx]
         Dinv = diag_inv(rho_b)
         Sinv_sel = select(idx)
-        state = window(Sinv_sel, Dinv, rho_b, state, check_every)
+        state, res = window_resid(Sinv_sel, Dinv, rho_b, state, check_every)
         x, z_box, nu, y_box = state
-        r_prim, r_dual, p_sc, d_sc, ok = residuals(x, z_box, nu, y_box)
+        r_prim, r_dual, p_sc, d_sc, ok = res
         pinf = pinf | primal_infeasible(nu - nu_prev, y_box - y_box_prev)
         done = ok | pinf
         it = it + check_every
@@ -470,12 +515,13 @@ def _reluqp_impl(
     Sinv_sel = select(idx)
 
     def s_solve(r):
-        pinv = lambda rr: jnp.einsum("bmn,bn->bm", Sinv_sel, rr,
-                                     precision=lax.Precision.HIGHEST)
+        # Polish/refinement path: pinned f32 — it corrects the hot loop's
+        # (possibly reduced-precision) iterate against the exact S.
+        pinv = lambda rr: mxu_einsum("bmn,bn->bm", Sinv_sel, rr,
+                                     precision="f32")
         v = pinv(r)
         for _ in range(2):
-            resid = r - jnp.einsum("bmn,bn->bm", S_ex, v,
-                                   precision=lax.Precision.HIGHEST)
+            resid = r - mxu_einsum("bmn,bn->bm", S_ex, v, precision="f32")
             v = v + pinv(resid)
         return v
 
@@ -494,8 +540,12 @@ def _reluqp_impl(
                             Sinv_bank=Sinv_bank)
 
 
+# sigma/alpha are config constants and must be STATIC: the fused window
+# kernel (ops/pallas_iter.py) bakes them into the compiled program — a
+# traced scalar would fail the pallas_call lowering (and they never vary
+# within a run anyway).
 _STATIC = ("pat", "bank", "iters", "check_every", "ruiz_iters", "patience",
-           "tail_iters")
+           "tail_iters", "precision", "iter_kernel", "sigma", "alpha")
 
 
 @partial(jax.jit, static_argnames=_STATIC)

@@ -96,12 +96,24 @@ def solver_cache_scope(config: dict | None) -> str:
         fam = resolve_solver_family(config)
     except Exception:
         return "shared"
+    tpu_cfg = config.get("tpu") or {}
     if fam == "reluqp":
         # Same clamp as engine_params — the scope token must name the bank
         # size actually compiled, not the raw config value.
-        bank = max(1, int((config.get("tpu") or {}).get("reluqp_bank", 5)))
-        return f"reluqp-bank{bank}"
-    return fam
+        bank = max(1, int(tpu_cfg.get("reluqp_bank", 5)))
+        token = f"reluqp-bank{bank}"
+    else:
+        token = fam
+    # Mixed-precision policy (ISSUE 11): a non-default precision changes
+    # every dense-family executable (the hot-loop matmuls lower to
+    # different programs), so bf16x3 sweeps must not LRU-churn or
+    # hit/miss-confuse the f32 history.  The ipm ignores the policy —
+    # its scope stays unsuffixed, and so does the f32 default (existing
+    # cache dirs keep their names).
+    prec = str(tpu_cfg.get("precision", "f32"))
+    if fam in ("admm", "reluqp") and prec != "f32":
+        token += f"-{prec}"
+    return token
 
 
 def _resolve_cache_dir(config: dict | None = None) -> tuple[str, str, bool]:

@@ -26,7 +26,8 @@ def test_bench_smoke_contract():
     result = json.loads(lines[0])
     for key in ("metric", "value", "unit", "vs_baseline", "solver",
                 "solve_rate", "phase_s_per_step", "admm_iters_per_step",
-                "band_kernel", "pallas_selftest", "semantics", "data"):
+                "band_kernel", "pallas_selftest", "semantics", "data",
+                "precision", "mfu", "mfu_basis", "iter_kernel"):
         assert key in result, key
     # The shipped default is integer semantics (round 5) and the artifact
     # must say so; likewise the data environment (round 6 — bundled
@@ -48,12 +49,20 @@ def test_bench_smoke_contract():
     assert result["band_kernel"] == "xla"
     assert result["pallas_selftest"] is None
     # flops_per_step is ALWAYS populated (round 7 — analytic model,
-    # platform-free) so MFU can be back-filled from telemetry the moment
-    # a chip is reachable; mfu itself stays null off-chip (no CPU entry
-    # in the peak table).
+    # platform-free); since ISSUE 11 the MFU key is never silently
+    # dropped either: off-TPU it is computed against the clearly-
+    # labelled CPU estimate, and mfu_basis names what the denominator
+    # was (the schema satellite — ``peak`` used to be silently None
+    # off-TPU, leaving every committed CPU artifact without MFU).
     assert result["flops_per_step_est"] is not None
     assert result["flops_per_step_est"] > 0
-    assert result["mfu"] is None
+    assert result["mfu"] is not None and result["mfu"] >= 0
+    assert result["mfu_basis"] == "cpu_estimate"
+    # Precision is a HARD bench_trend series key; the smoke default is
+    # the bit-identical f32 policy.  iter_kernel reports only for the
+    # reluqp family (null for the ipm smoke).
+    assert result["precision"] == "f32"
+    assert result["iter_kernel"] is None
 
 
 @pytest.mark.slow  # round-11 tier-1 budget trim: tier-1 keeps test_bench_smoke_contract (the child contract) and the resilience ladder tests; the dual-report ladder compiles two bench children

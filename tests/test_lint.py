@@ -197,3 +197,47 @@ def test_home_type_registry_rule():
         os.path.join(ROOT, "dragg_tpu", "ops", "qp.py"), "TYPE_SPECS")
     assert set(got_specs) == set(TYPE_SPECS)
     assert {"ev", "heat_pump"} <= set(got)
+
+
+def test_precision_discipline(tmp_path):
+    """ISSUE 11: dense contractions in the precision-disciplined solver
+    files must route through ops/precision.mxu_einsum — bare
+    jnp.einsum/dot/matmul/lax.dot_general are rejected unless the line
+    carries the precision-ok marker (non-matmul einsums like a trace)."""
+    import ast
+
+    lint = _load_lint()
+    src = (
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "from dragg_tpu.ops.precision import mxu_einsum\n"
+        "a = jnp.einsum('bmn,bn->bm', A, x)\n"                    # bad
+        "b = jnp.matmul(A, x)\n"                                  # bad
+        "c = lax.dot_general(A, x, d)\n"                          # bad
+        "d = jnp.einsum('bkk->b', M)  # precision-ok: trace\n"    # marked
+        "e = mxu_einsum('bmn,bn->bm', A, x, precision='f32')\n"   # routed
+        "f = jnp.linalg.cholesky(S)\n"                            # fine
+    )
+    problems = lint.check_precision_discipline(
+        ast.parse(src), src.splitlines(), "dragg_tpu/ops/reluqp.py")
+    assert len(problems) == 3, problems
+    assert any(":4:" in p for p in problems)
+    assert any(":5:" in p for p in problems)
+    assert any(":6:" in p for p in problems)
+
+
+def test_precision_discipline_scope():
+    """The rule covers exactly the two dense solver files — the helper
+    module itself (which owns the bare einsum) and everything else stay
+    out of scope."""
+    lint = _load_lint()
+    assert lint._is_precision_scope(
+        os.path.join(ROOT, "dragg_tpu", "ops", "reluqp.py"))
+    assert lint._is_precision_scope(
+        os.path.join(ROOT, "dragg_tpu", "ops", "admm.py"))
+    assert not lint._is_precision_scope(
+        os.path.join(ROOT, "dragg_tpu", "ops", "precision.py"))
+    assert not lint._is_precision_scope(
+        os.path.join(ROOT, "dragg_tpu", "ops", "ipm.py"))
+    assert not lint._is_precision_scope(
+        os.path.join(ROOT, "dragg_tpu", "engine.py"))

@@ -1,0 +1,338 @@
+"""Mixed-precision MXU policy (ISSUE 11 — ops/precision.py).
+
+Three contracts, in the order they can fail:
+
+1. ``tpu.precision="f32"`` (the default) is BIT-IDENTICAL to the
+   pre-policy engine: ``mxu_einsum(..., precision="f32")`` is literally
+   the historical HIGHEST-precision einsum, pinned bitwise here, and the
+   solver reproduces its default output exactly.
+2. ``"bf16x3"`` passes HiGHS objective parity on ALL SIX home types at a
+   documented looser budget (the round-10 first-order-family convention:
+   objectives, never iterates) — while every residual/check tensor stays
+   f32 (the rounds-2/9 divergence mode is a low-precision residual, and
+   ``f32_guard`` fails the TRACE if one leaks in).
+3. The plumbing cannot drift: the compile cache scopes bf16x3
+   executables away from the f32 LRU domain, a junk policy fails at
+   config validation, and tools/bench_trend.py treats ``precision`` as a
+   hard series key with era default f32 (the round-12 ``communities`` /
+   round-13 ``mix`` pattern).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from scipy.optimize import linprog
+
+import jax
+import jax.numpy as jnp
+
+from dragg_tpu.config import default_config
+from dragg_tpu.fixtures import assemble_community_qp
+from dragg_tpu.ops.precision import (PRECISIONS, _split_bf16, f32_guard,
+                                     mxu_einsum, validate_precision)
+from dragg_tpu.ops.qp import densify_A
+from dragg_tpu.ops.reluqp import reluqp_solve_qp
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- the helper
+def test_f32_policy_is_bitwise_the_historical_einsum():
+    """precision="f32" must reproduce jnp.einsum(..., HIGHEST) EXACTLY —
+    this is what makes the default engine pre-change bit-identical by
+    construction."""
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(4, 9, 7).astype(np.float32))
+    b = jnp.asarray(rng.randn(4, 7).astype(np.float32))
+    ours = mxu_einsum("bmn,bn->bm", a, b, precision="f32")
+    ref = jnp.einsum("bmn,bn->bm", a, b,
+                     precision=jax.lax.Precision.HIGHEST)
+    assert ours.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(ours), np.asarray(ref))
+
+
+def test_bf16x3_split_is_exactly_recomposable_and_accurate():
+    """The hi/lo split must recompose to ~f32 (16-ish mantissa bits kept)
+    and the 3-product contraction must sit orders of magnitude closer to
+    f32 than a plain single-pass bf16 matmul — the whole point of x3."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(64, 48).astype(np.float32) * 37.0
+    hi, lo = _split_bf16(jnp.asarray(x))
+    assert hi.dtype == jnp.bfloat16 and lo.dtype == jnp.bfloat16
+    recomposed = np.asarray(hi, np.float32) + np.asarray(lo, np.float32)
+    rel = np.max(np.abs(recomposed - x) / np.maximum(np.abs(x), 1e-6))
+    assert rel < 2e-5, rel  # two bf16 limbs ≈ 16 mantissa bits
+
+    a = jnp.asarray(rng.randn(8, 33, 48).astype(np.float32))
+    b = jnp.asarray(rng.randn(8, 48).astype(np.float32))
+    exact = np.asarray(mxu_einsum("bmn,bn->bm", a, b, precision="f32"),
+                       np.float64)
+    x3 = np.asarray(mxu_einsum("bmn,bn->bm", a, b, precision="bf16x3"),
+                    np.float64)
+    plain = np.asarray(jnp.einsum(
+        "bmn,bn->bm", a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32), np.float64)
+    # Absolute error on O(1)-normed operands (a relative metric divides
+    # by near-zero cancelling outputs and measures nothing): the 3-pass
+    # product must land ~2⁻¹⁶-accurate — measured 9.4e-5 vs plain
+    # bf16's 6.8e-2 on this fixture, a ~700x gap.
+    err_x3 = np.max(np.abs(x3 - exact))
+    err_plain = np.max(np.abs(plain - exact))
+    assert err_x3 < 5e-4, err_x3
+    assert err_x3 < err_plain / 50, (err_x3, err_plain)
+
+
+def test_f32_guard_and_registry():
+    x = jnp.zeros((3,), jnp.float32)
+    assert f32_guard(x, "test tensor") is x
+    with pytest.raises(TypeError, match="must be float32"):
+        f32_guard(x.astype(jnp.bfloat16), "test tensor")
+    assert validate_precision("f32") == "f32"
+    with pytest.raises(ValueError, match="precision"):
+        validate_precision("fp8")
+    assert PRECISIONS == ("f32", "bf16x3")
+
+
+# ------------------------------------------------- solver-level contracts
+@pytest.fixture(scope="module")
+def six_type_qp():
+    """t=0 community QP covering ALL SIX home types (base, pv_only,
+    battery_only, pv_battery, ev, heat_pump) — the scenario-round parity
+    fixture shape (tests/test_scenarios.py).  Module-scoped: the engine
+    build behind the assembly is the expensive part and every solver-
+    level test below reads the same matrices."""
+    return assemble_community_qp(
+        horizon_hours=4, n_homes=8, homes_pv=1, homes_battery=1,
+        homes_pv_battery=1, homes_ev=2, homes_heat_pump=2)
+
+
+def test_f32_default_solver_output_is_bit_identical(six_type_qp):
+    """The precision kwarg's default path must not perturb a single bit
+    of the default solve (same compiled math, same numbers)."""
+    qp, pat, _lay, _s = six_type_qp
+    base = reluqp_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box,
+                           qp.q, iters=3000)
+    pinned = reluqp_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box,
+                             qp.q, iters=3000, precision="f32")
+    for a, b in zip(base, pinned):
+        if a is None:
+            assert b is None
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bf16x3_highs_parity_all_six_types(six_type_qp):
+    """bf16x3 objective parity vs HiGHS, home by home, across the six
+    types.  Budget 2% — DOCUMENTED looser than the f32 families' 1%
+    (round-10 convention): the 3-pass product carries ~2⁻¹⁶ relative
+    error per contraction, so the converged objective sits a little
+    further from the LP optimum while the f32 residual check still
+    certifies feasibility at the unchanged tolerance."""
+    qp, pat, _lay, _s = six_type_qp
+    sol = reluqp_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                          iters=4000, precision="bf16x3")
+    A = np.asarray(densify_A(pat, qp.vals), dtype=np.float64)
+    beq = np.asarray(qp.b_eq, np.float64)
+    l = np.asarray(qp.l_box, np.float64)
+    u = np.asarray(qp.u_box, np.float64)
+    q = np.asarray(qp.q, np.float64)
+    x = np.asarray(sol.x, np.float64)
+    solved = np.asarray(sol.solved)
+    n_checked = 0
+    for i in range(A.shape[0]):
+        bounds = [(lo if np.isfinite(lo) else None,
+                   hi if np.isfinite(hi) else None)
+                  for lo, hi in zip(l[i], u[i])]
+        ref = linprog(q[i], A_eq=A[i], b_eq=beq[i], bounds=bounds,
+                      method="highs")
+        if not ref.success:
+            assert not solved[i], f"home {i}: HiGHS infeasible, we solved"
+            continue
+        assert solved[i], f"home {i}: HiGHS feasible but unsolved"
+        gap = (float(q[i] @ x[i]) - float(ref.fun)) / max(abs(ref.fun), 1e-3)
+        assert gap < 0.02, f"home {i}: bf16x3 cost gap {gap:.4%}"
+        assert gap > -0.01, f"home {i}: beat the optimum — infeasible"
+        viol = np.max(np.abs(A[i] @ x[i] - beq[i]))
+        assert viol < 2e-2, f"home {i}: equality violation {viol}"
+        n_checked += 1
+    assert n_checked >= 6
+
+
+def test_bf16x3_residual_and_warm_tensors_stay_f32(six_type_qp):
+    """Regression for the cast discipline: EVERY solution leaf that feeds
+    the residual/check/warm-start path must come back f32 under bf16x3
+    — a bf16 leak would reproduce the rounds-2/9 divergence and, via the
+    warm-start carry, poison the next step's trace."""
+    qp, pat, _lay, _s = six_type_qp
+    # Same iters cap as the parity test above so the jitted solve is a
+    # cache hit, not a third compile (dtypes don't need a fresh trace).
+    sol = reluqp_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                          iters=4000, precision="bf16x3")
+    for name in ("x", "y_eq", "y_box", "r_prim", "r_dual", "rho"):
+        leaf = getattr(sol, name)
+        assert leaf.dtype == jnp.float32, (name, leaf.dtype)
+    assert np.asarray(sol.solved).dtype == bool
+
+
+def test_bf16x3_admm_dense_inv_converges():
+    """The ADMM's dense_inv apply path under bf16x3: same matrices, same
+    tolerance, all homes still solve (the f32 refinement/residual path
+    absorbs the 3-pass product error)."""
+    from dragg_tpu.ops.admm import admm_solve_qp
+
+    qp, pat, _lay, _s = assemble_community_qp(horizon_hours=4, n_homes=6)
+    sol32 = admm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                          iters=3000, banded_factor=False,
+                          solve_backend="dense_inv")
+    solx3 = admm_solve_qp(pat, qp.vals, qp.b_eq, qp.l_box, qp.u_box, qp.q,
+                          iters=3000, banded_factor=False,
+                          solve_backend="dense_inv", precision="bf16x3")
+    assert np.asarray(sol32.solved).all()
+    assert np.asarray(solx3.solved).all()
+    q64 = np.asarray(qp.q, np.float64)
+    o32 = (q64 * np.asarray(sol32.x, np.float64)).sum(1)
+    ox3 = (q64 * np.asarray(solx3.x, np.float64)).sum(1)
+    np.testing.assert_allclose(ox3, o32, rtol=2e-2, atol=1e-2)
+
+
+@pytest.mark.slow  # tier-1 budget: three engine+chunk compiles; the solver-level bitwise pin above keeps the bit-identity axis in tier-1 (round-11 heavy-sibling convention)
+def test_engine_f32_default_bit_identical_and_pattern_count(tiny_config):
+    """Engine-level acceptance pin: the default engine and an explicit
+    precision="f32" engine produce BIT-IDENTICAL step outputs, and a
+    bf16x3 engine compiles the SAME bucket pattern set (the policy
+    changes matmul lowering, never shapes/patterns)."""
+    import copy
+
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_home_batch, create_homes
+
+    cfg = copy.deepcopy(tiny_config)
+    cfg["home"]["hems"]["solver"] = "reluqp"
+    env = load_environment(cfg)
+    waterdraw = load_waterdraw_profiles(None, seed=12)
+    homes = create_homes(cfg, 24 * env.dt, env.dt, waterdraw)
+    batch = build_home_batch(homes, 4 * env.dt, env.dt, 6)
+
+    def run(precision=None):
+        c = copy.deepcopy(cfg)
+        if precision is not None:
+            c["tpu"]["precision"] = precision
+        eng = make_engine(batch, env, c, 0)
+        rps = np.zeros((2, eng.params.horizon), np.float32)
+        _, out = eng.run_chunk(eng.init_state(), 0, rps)
+        return eng, out
+
+    eng_d, out_d = run()
+    eng_f, out_f = run("f32")
+    np.testing.assert_array_equal(np.asarray(out_d.agg_load),
+                                  np.asarray(out_f.agg_load))
+    np.testing.assert_array_equal(np.asarray(out_d.correct_solve),
+                                  np.asarray(out_f.correct_solve))
+    eng_x, out_x = run("bf16x3")
+    assert len(eng_x.bucket_info()) == len(eng_d.bucket_info())
+    assert np.isfinite(np.asarray(out_x.agg_load)).all()
+
+
+# --------------------------------------------------- config/cache plumbing
+def test_engine_params_validates_precision_and_iter_kernel():
+    from dragg_tpu.engine import engine_params
+
+    cfg = default_config()
+    p = engine_params(cfg, 0)
+    assert p.precision == "f32" and p.iter_kernel == "auto"
+    cfg["tpu"]["precision"] = "bf16x3"
+    assert engine_params(cfg, 0).precision == "bf16x3"
+    cfg["tpu"]["precision"] = "fp8"
+    with pytest.raises(ValueError, match="precision"):
+        engine_params(cfg, 0)
+    cfg["tpu"]["precision"] = "f32"
+    cfg["tpu"]["iter_kernel"] = "mosaic"
+    with pytest.raises(ValueError, match="iter_kernel"):
+        engine_params(cfg, 0)
+    # The fused window is f32-only: the combination fails at build.
+    cfg["tpu"]["iter_kernel"] = "pallas"
+    cfg["tpu"]["precision"] = "bf16x3"
+    with pytest.raises(ValueError, match="pallas"):
+        engine_params(cfg, 0)
+
+
+def test_precision_scopes_the_compile_cache(tmp_path, monkeypatch):
+    """bf16x3 executables get their own LRU domain for the dense
+    families; the ipm (which ignores the policy) and the f32 default
+    keep their historical directory names."""
+    from dragg_tpu.utils import compile_cache as cc
+
+    monkeypatch.setenv("DRAGG_COMPILE_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+
+    def cfg(solver, **tpu):
+        return {"home": {"hems": {"solver": solver}}, "tpu": tpu}
+
+    assert os.path.basename(
+        cc._resolve_cache_dir(cfg("reluqp"))[1]) == "reluqp-bank5"
+    assert os.path.basename(
+        cc._resolve_cache_dir(cfg("reluqp", precision="bf16x3"))[1]) \
+        == "reluqp-bank5-bf16x3"
+    assert os.path.basename(
+        cc._resolve_cache_dir(cfg("admm", precision="bf16x3"))[1]) \
+        == "admm-bf16x3"
+    # ipm ignores the policy — scope unchanged either way.
+    assert os.path.basename(
+        cc._resolve_cache_dir(cfg("ipm", precision="bf16x3"))[1]) == "ipm"
+
+
+def test_run_shape_keys_checkpoints_on_precision():
+    """A checkpoint written under one precision must invalidate (not
+    cross-seed) a resume under the other — the warm iterates sit at
+    different fixed-point accuracies (aggregator._run_shape)."""
+    import inspect
+
+    from dragg_tpu import aggregator
+
+    src = inspect.getsource(aggregator.Aggregator._run_shape)
+    assert '"precision"' in src
+
+
+# ------------------------------------------------------ bench_trend gate
+def _trend(tmp_path, artifacts):
+    paths = []
+    for i, obj in enumerate(artifacts):
+        p = tmp_path / f"BENCH_r{i + 1:02d}.json"
+        p.write_text(json.dumps(obj))
+        paths.append(str(p))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "bench_trend.py"),
+         *paths, "--gate"],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+    return proc.returncode, json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_trend_gate_precision_is_a_hard_key(tmp_path):
+    """Satellite: bf16x3 rows form their own trend series (round-12
+    ``communities`` / round-13 ``mix`` pattern).  A bf16x3 artifact 5x
+    slower than the f32 history must NOT gate; a regression WITHIN the
+    bf16x3 series must; and era-default f32 still pairs with pre-field
+    artifacts that lack the key entirely."""
+    def line(value, solve, **kw):
+        return dict(metric="m", platform="cpu", solver="reluqp",
+                    value=value, semantics="integer", data="bundled",
+                    phase_s_per_step={"solve": solve}, **kw)
+
+    # f32 history + slower first bf16x3 row: different hard key → pass.
+    rc, trend = _trend(tmp_path, [line(10.0, 0.1, precision="f32"),
+                                  line(2.0, 0.5, precision="bf16x3")])
+    assert rc == 0 and not trend["rows"], trend
+    # Regression INSIDE the bf16x3 series still gates.
+    rc, trend = _trend(tmp_path, [line(10.0, 0.1, precision="bf16x3"),
+                                  line(2.0, 0.5, precision="bf16x3")])
+    assert rc == 1 and trend["n_regressions"] == 1, trend
+    # Era default: a pre-field artifact (no precision key) pairs with an
+    # explicit f32 row — one comparable stable pair, no gate.
+    rc, trend = _trend(tmp_path, [line(10.0, 0.1),
+                                  line(10.2, 0.1, precision="f32")])
+    assert rc == 0 and len(trend["rows"]) == 1, trend

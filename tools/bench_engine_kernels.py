@@ -13,11 +13,21 @@ to solver families (round 10: the reluqp engine-level A/B the runbook
 runs on chip) — same build recipe, same warm-step timing loop, one
 engine per family, ``solver_s_per_step`` in the JSON.
 
+``--precision f32,bf16x3`` (ISSUE 11) crosses whatever axis is swept
+with the hot-loop matmul policy (labels become ``<label>@<precision>``
+when more than one precision is listed) — the engine-level A/B that
+decides whether bf16x3 earns a default on chip.  ``--iter-kernels
+lax,pallas`` sweeps the fused reluqp check-window kernel
+(ops/pallas_iter.py) at the fixed reluqp solver — the A/B that settles
+``tpu.iter_kernel``'s ``auto`` policy (currently lax: no recorded
+on-chip number).
+
 Prints one JSON line: {kernel-or-solver: s/step} + the winner.
 
 Usage: python tools/bench_engine_kernels.py [--homes 1000]
        [--horizon-hours 24] [--steps 6] [--kernels pallas,xla,cr]
-       [--solvers ipm,admm,reluqp] [--bucketed auto|true|false]
+       [--solvers ipm,admm,reluqp] [--iter-kernels lax,pallas]
+       [--precision f32,bf16x3] [--bucketed auto|true|false]
 """
 
 import argparse
@@ -41,6 +51,17 @@ def main():
                     help="comma list of solver families (ipm,admm,reluqp): "
                          "sweep SOLVERS at a fixed auto band kernel "
                          "instead of band kernels at the fixed ipm solver")
+    ap.add_argument("--iter-kernels", default="", dest="iter_kernels",
+                    help="comma list of reluqp check-window kernels "
+                         "(lax,pallas — ops/pallas_iter.py): sweep the "
+                         "fused-iteration implementation at the fixed "
+                         "reluqp solver; decides tpu.iter_kernel's auto "
+                         "policy (ISSUE 11)")
+    ap.add_argument("--precision", default="f32",
+                    help="comma list of hot-loop matmul policies "
+                         "(f32,bf16x3 — ops/precision.py) crossed with "
+                         "the swept axis; >1 entry labels timings "
+                         "<label>@<precision>")
     ap.add_argument("--bucketed", choices=["auto", "true", "false"],
                     default="false",
                     help="tpu.bucketed for the timed engine.  Default "
@@ -69,27 +90,64 @@ def main():
     }
 
     solver_mode = bool(args.solvers.strip())
-    sweep = (args.solvers if solver_mode else args.kernels).split(",")
+    iter_mode = bool(args.iter_kernels.strip())
+    if solver_mode and iter_mode:
+        raise SystemExit("--solvers and --iter-kernels are exclusive axes")
+    sweep = (args.iter_kernels if iter_mode
+             else args.solvers if solver_mode
+             else args.kernels).split(",")
+    precisions = [p.strip() for p in args.precision.split(",") if p.strip()]
+    res["precision"] = ",".join(precisions)
 
-    def build_variant(label):
+    def build_variant(label, precision):
         """One engine per sweep point: solver families at the auto band
-        kernel (--solvers), or band kernels at the fixed ipm solver —
-        always THE benchmark community (bench.build: same population mix
-        and sim window as the headline bench, one definition)."""
+        kernel (--solvers), reluqp iteration kernels (--iter-kernels),
+        or band kernels at the fixed ipm solver — always THE benchmark
+        community (bench.build: same population mix and sim window as
+        the headline bench, one definition), crossed with the hot-loop
+        precision policy."""
+        if iter_mode:
+            eng, _ = bench_mod.build(args.homes, args.horizon_hours, 1000,
+                                     solver="reluqp", bucketed=args.bucketed,
+                                     precision=precision, iter_kernel=label)
+            return eng if eng.iter_kernel == label else None
         if solver_mode:
             eng, _ = bench_mod.build(args.homes, args.horizon_hours, 1000,
-                                     solver=label, bucketed=args.bucketed)
+                                     solver=label, bucketed=args.bucketed,
+                                     precision=precision)
             return eng if eng.params.solver == label else None
         eng, _ = bench_mod.build(args.homes, args.horizon_hours, 1000,
                                  solver="ipm", band_kernel=label,
-                                 bucketed=args.bucketed)
+                                 bucketed=args.bucketed,
+                                 precision=precision)
         return eng if eng.band_kernel == label else None
 
+    def consumes_precision(label):
+        """Only the dense families consume the policy: the band-kernel
+        sweep runs the fixed ipm solver (no dense matmuls — bit-identical
+        under any policy), so crossing it with --precision would time
+        identical engines twice and emit noise rows a reader could take
+        as a precision verdict."""
+        if iter_mode:
+            return True   # fixed reluqp solver
+        if solver_mode:
+            return label in ("admm", "reluqp")
+        return False
+
     timings = {}
-    for label in sweep:
-        label = label.strip()
+    points = []
+    for lbl in sweep:
+        lbl = lbl.strip()
+        if consumes_precision(lbl):
+            points += [(lbl, prec, len(precisions) > 1)
+                       for prec in (precisions or ["f32"])]
+        else:
+            points.append((lbl, "f32", False))
+    for label, precision, tag in points:
+        if tag:
+            label = f"{label}@{precision}"
         try:
-            eng = build_variant(label)
+            eng = build_variant(label.split("@")[0], precision)
             if eng is None:
                 timings[label] = None
                 res[f"{label}_err"] = "variant did not resolve as requested"
@@ -111,15 +169,20 @@ def main():
                 if time.perf_counter() - t0 > 120:
                     break
             timings[label] = round((time.perf_counter() - t0) / done, 4)
-            if solver_mode and label == "reluqp":
+            if (solver_mode or iter_mode) \
+                    and label.split("@")[0] in ("reluqp", "lax", "pallas"):
                 # Whether the pre-factorized path sufficed on the timed
-                # steps, or the rho bank's fallback refactorization ran.
-                res["reluqp_bank_fallback_home_steps"] = int(fb_total)
+                # steps, or the rho bank's fallback refactorization ran
+                # (per sweep point — a precision/kernel flip can change
+                # who needs the tail).
+                res[f"{label}_bank_fallback_home_steps"] = int(fb_total)
         except Exception as e:
             timings[label] = None
             res[f"{label}_err"] = repr(e)[:300]
 
-    res["solver_s_per_step" if solver_mode else "s_per_step"] = timings
+    res["iter_kernel_s_per_step" if iter_mode
+        else "solver_s_per_step" if solver_mode
+        else "s_per_step"] = timings
     alive = {k: v for k, v in timings.items() if v}
     if alive:
         res["winner"] = min(alive, key=alive.get)

@@ -51,7 +51,7 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 HARD_KEY = ("metric", "platform", "solver", "semantics", "data",
-            "communities", "mix")
+            "communities", "mix", "precision")
 
 
 def _round_ordinal(path: str, fallback: int) -> int:
@@ -119,6 +119,7 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         return dict(source=source, ordinal=ordinal,
                     metric="metrics_snapshot", platform="?", solver="?",
                     semantics="?", data="?", communities=1, mix="?",
+                    precision="?",
                     bucketed=False,
                     fallback=False, degraded=None,
                     value=float(gauges.get("bench.rate_ts_per_s", 0.0)),
@@ -146,6 +147,13 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         # pre-scenario history.  Era default: pre-field artifacts all
         # measured the legacy 0.4/0.1/0.1 mix.
         mix=str(rec.get("mix", "legacy")),
+        # Hot-loop matmul policy is a HARD key (ISSUE 11): a bf16x3 rate
+        # runs a different numerical contract (3-pass bf16 compute in the
+        # dense solver iterations) than the f32 history at the same
+        # shape, so bf16x3 rows form their own series and never gate
+        # against f32 artifacts.  Era default: every pre-field artifact
+        # ran full f32.
+        precision=str(rec.get("precision", "f32")),
         bucketed=bool(rec.get("bucketed", False)),
         fallback=bool(rec.get("fallback", False)),
         degraded=rec.get("degraded"),
@@ -268,8 +276,10 @@ def print_table(trend: dict, out=sys.stderr) -> None:
         fleet = (f"/{k['communities']}comm" if k.get("communities", 1) != 1
                  else "")
         mix = (f"/{k['mix']}" if k.get("mix", "legacy") != "legacy" else "")
+        prec = (f"/{k['precision']}"
+                if k.get("precision", "f32") != "f32" else "")
         print(f"  {k['metric']} [{k['platform']}/{k['solver']}/"
-              f"{k['semantics']}/{k['data']}{fleet}{mix}] "
+              f"{k['semantics']}/{k['data']}{fleet}{mix}{prec}] "
               f"{r['from_source']} → {r['to_source']}", file=out)
         print(f"    rate  {r['rate'][0]:.3f} → {r['rate'][1]:.3f} "
               f"({_fmt_pct(r['rate_delta'])}) {r['rate_verdict']}",

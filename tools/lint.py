@@ -37,6 +37,15 @@ what the stdlib can check:
   parity-bearing test file under ``tests/``, and be documented in
   ``docs/config.md`` — a new scenario home type cannot ship half-wired
   (solving in a bucket nobody parity-checked or documented);
+* precision discipline in the dense solver files (ISSUE 11):
+  ``dragg_tpu/ops/reluqp.py`` and ``dragg_tpu/ops/admm.py`` may not call
+  ``jnp.einsum``/``jnp.dot``/``jnp.matmul``/``jnp.tensordot``/
+  ``lax.dot_general`` directly — every dense contraction routes through
+  ``dragg_tpu/ops/precision.py`` (``mxu_einsum``), which owns the
+  f32/bf16x3 cast discipline (bf16 compute with f32 accumulation; f32
+  residual path — the rounds-2/9 divergence mode was exactly a
+  hand-rolled dtype).  Non-matmul einsums (e.g. a diagonal trace) carry
+  a ``# precision-ok: <why>`` marker;
 * KKT-inverse discipline in the same scope (round 10): no direct
   ``np.linalg.inv``/``jnp.linalg.inv`` outside ``dragg_tpu/ops/`` — the
   dense rho-bank operators of the reluqp family must be built through
@@ -224,6 +233,40 @@ def check_telemetry_names(tree, lines: list[str], rel: str) -> list[str]:
     return problems
 
 
+# Precision discipline (ISSUE 11; see the module docstring bullet).
+_PRECISION_MARKER = "# precision-ok:"
+_PRECISION_FILES = (os.path.join("dragg_tpu", "ops", "reluqp.py"),
+                    os.path.join("dragg_tpu", "ops", "admm.py"))
+_DENSE_CONTRACTIONS = {"einsum", "dot", "matmul", "tensordot",
+                       "dot_general"}
+
+
+def _is_precision_scope(path: str) -> bool:
+    return os.path.relpath(path, ROOT) in _PRECISION_FILES
+
+
+def check_precision_discipline(tree, lines: list[str], rel: str) -> list[str]:
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        # Matches jnp.einsum / np.dot / lax.dot_general / lax.linalg...
+        # — any attribute call named like a dense contraction.
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in _DENSE_CONTRACTIONS):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if _PRECISION_MARKER not in line:
+            problems.append(
+                f"{rel}:{node.lineno}: bare dense contraction "
+                f"({fn.attr}) in a precision-disciplined solver file — "
+                f"route it through ops/precision.mxu_einsum (which owns "
+                f"the f32/bf16x3 cast policy), or mark the line "
+                f"'{_PRECISION_MARKER} <why>' if it is not a matmul")
+    return problems
+
+
 # KKT-inverse discipline (round 10; see the module docstring bullet).
 _INV_MARKER = "# kkt-inv-ok:"
 
@@ -402,6 +445,8 @@ def check_file(path: str) -> list[str]:
         problems.extend(check_telemetry_names(tree, lines, rel))
     if _is_kkt_inv_scope(path):
         problems.extend(check_kkt_inverse_discipline(tree, lines, rel))
+    if _is_precision_scope(path):
+        problems.extend(check_precision_discipline(tree, lines, rel))
     return problems
 
 

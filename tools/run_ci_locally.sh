@@ -50,6 +50,13 @@ step "test/smoke-bench" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   bash -c 'python bench.py --smoke | tee /tmp/bench_smoke.json &&
            python -c "import json; r=json.load(open(\"/tmp/bench_smoke.json\")); assert r[\"value\"]>0"'
 
+# --- job: mixed-precision smoke (ISSUE 11): the bf16x3 hot-loop policy
+#     must run end-to-end on the dense reluqp family and the artifact
+#     must carry the precision + MFU-basis fields bench_trend keys on
+step "test/smoke-bench-bf16x3" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  bash -c 'python bench.py --smoke --solver reluqp --precision bf16x3 | tee /tmp/bench_smoke_bf16.json &&
+           python -c "import json; r=json.load(open(\"/tmp/bench_smoke_bf16.json\")); assert r[\"value\"]>0 and r[\"precision\"]==\"bf16x3\" and r[\"mfu_basis\"]==\"cpu_estimate\", r"'
+
 # --- job: serve-soak smoke (ISSUE 7): the serving daemon's chaos soak on
 #     the CPU mesh — all six taxonomy fault kinds plus kill -9 mid-batch;
 #     asserts zero lost / zero double-answered requests, degradation

@@ -121,6 +121,34 @@ def stages(out: str) -> list[dict]:
              env={"BENCH_TPU_TIMEOUT": "300", "BENCH_CPU_TIMEOUT": "300"},
              argv=bench + ["--homes", "1000", "--horizon-hours", "24",
                            "--solver", "reluqp"]),
+        # 4c. Mixed-precision + fused-iteration A/Bs (ISSUE 11), probe-
+        #     gated like every stage.  The precision A/B decides whether
+        #     bf16x3 (3-pass bf16 MXU compute, f32 residuals —
+        #     ops/precision.py) earns the dense families a default on
+        #     chip; the iter-kernel A/B settles tpu.iter_kernel's auto
+        #     policy (ops/pallas_iter.py — currently lax, no recorded
+        #     on-chip number).  CPU control for both is recorded in
+        #     docs/perf_notes.md round 14 (expected ~neutral-to-negative
+        #     off-chip).
+        dict(name="precision_ab_512_reluqp", timeout=1200,
+             argv=[PY, "tools/bench_engine_kernels.py", "--homes", "512",
+                   "--horizon-hours", "24",
+                   "--solvers", "reluqp,admm",
+                   "--precision", "f32,bf16x3"]),
+        dict(name="iter_kernel_ab_512_reluqp", timeout=1200,
+             argv=[PY, "tools/bench_engine_kernels.py", "--homes", "512",
+                   "--horizon-hours", "24",
+                   "--iter-kernels", "lax,pallas"]),
+        #     Headline-style bf16x3 bench at 1k: its own bench_trend
+        #     series (precision is a hard key), with MFU now real —
+        #     mfu_basis names the spec-table entry it was computed
+        #     against.  Budget: probe 60 + attempt 300 + backoff 10 +
+        #     probe 60 + retry 150 + CPU 300 = 880 < 900.
+        dict(name="bench_1k_24h_reluqp_bf16x3", timeout=900,
+             env={"BENCH_TPU_TIMEOUT": "300", "BENCH_CPU_TIMEOUT": "300"},
+             argv=bench + ["--homes", "1000", "--horizon-hours", "24",
+                           "--solver", "reluqp",
+                           "--precision", "bf16x3"]),
         # 5. Headline bench, BASELINE row-3 config (10k x 24h), SHIPPED
         #    semantics, DUAL-REPORT: one line on the bundled shipped
         #    default, one on the rounds-2..4 synthetic environment
