@@ -731,6 +731,12 @@ def run_measured(args) -> dict:
         # RESOLVED fused-window implementation (reluqp only).
         "precision": (engine.params.precision
                       if solver_used in ("admm", "reluqp") else "f32"),
+        # RL series key (ROADMAP item 1): bench.py measures the MPC
+        # baseline — always "none" here.  RL training rows come from
+        # tools/bench_rl_fleet.py with rl="<policy>_<agent>";
+        # tools/bench_trend.py treats ``rl`` as a HARD series key, so
+        # those rows never gate against this baseline history.
+        "rl": "none",
         "iter_kernel": (engine.iter_kernel
                         if solver_used == "reluqp" else None),
         "data": data_label,

@@ -664,10 +664,25 @@ class Aggregator:
 
     def _max_possible_load(self) -> float:
         """Sum of each home's max simultaneous load (dragg/mpc_calc.py:191)."""
-        total = 0.0
-        for h in self.all_homes:
-            total += max(float(h["hvac"]["p_c"]), float(h["hvac"]["p_h"])) + float(h["wh"]["p"])
-        return total
+        return float(self._max_possible_load_per_community().sum())
+
+    def _max_possible_load_per_community(self) -> np.ndarray:
+        """(C,) per-community max possible load — the fleet RL
+        observation normalizers (communities are distinct seeded
+        populations, so their normalizers differ; dragg_tpu/rl/fleet),
+        and the ONE home of the per-home expression
+        (dragg/mpc_calc.py:191) that :meth:`_max_possible_load` sums.
+        ``all_homes`` is community-major, so community c is the c-th
+        block of B homes."""
+        C = self.n_communities
+        B = len(self.all_homes) // C
+        out = np.zeros(C)
+        for c in range(C):
+            out[c] = sum(
+                max(float(h["hvac"]["p_c"]), float(h["hvac"]["p_h"]))
+                + float(h["wh"]["p"])
+                for h in self.all_homes[c * B:(c + 1) * B])
+        return out
 
     # ------------------------------------------------------------ checkpoint
     def _checkpoint_root(self) -> str:
@@ -898,10 +913,43 @@ class Aggregator:
             "events": (timeline_digest(getattr(self.engine, "_events",
                                                None))
                        if self.engine is not None else None),
+            # Fleet RL agent-carry layout (ROADMAP item 1): the batched
+            # carry's leaf structure depends on the policy layout
+            # (shared vs per-community), the core (linear vs ddpg), and
+            # the learner batch — a checkpoint written under one must
+            # start fresh under another, not crash load_pytree's
+            # leaf-count/shape check.
+            "rl_fleet": self._rl_fleet_shape(),
             # Shard files are per-process; a checkpoint from a different
             # process topology must start fresh, not mis-assemble.
             "process_count": __import__("jax").process_count(),
         }
+
+    def _rl_fleet_shape(self) -> list | None:
+        """The fleet-RL checkpoint-shape key (None when no fleet RL case
+        can run — single community, or RL cases disabled).  Besides the
+        policy layout it carries every hyperparameter that SIZES a carry
+        leaf: the DDPG MLP width (network/Adam pytrees), the linear
+        core's critic count (θ_q columns), and the setpoint-tracker
+        window (EnvCarry.tracker) — an edit to any of these must start
+        fresh, not crash load_pytree's leaf-shape check."""
+        sim = self.config["simulation"]
+        if self.n_communities == 1 or not (
+                sim.get("run_rl_agg", False)
+                or sim.get("run_rl_simplified", False)):
+            return None
+        from dragg_tpu.rl.fleet import fleet_params_from_config
+
+        fp = fleet_params_from_config(self.config, self.n_communities)
+        p = self.config["rl"]["parameters"]
+        kind = str(p.get("agent", "linear"))
+        core_shape = (int(self.config.get("tpu", {}).get("ddpg_hidden", 64))
+                      if kind == "ddpg"
+                      else (2 if p.get("twin_q", True) else 1))
+        prev_n = int(self.config["agg"].get("rl", {})
+                     .get("prev_timesteps", 12))
+        return [fp.policy, kind, fp.learner_batch, fp.gradient,
+                bool(fp.event_features), core_shape, prev_n]
 
     def try_resume(self, template_state):
         """Restore (state, t) from the latest complete checkpoint if one
@@ -1494,18 +1542,14 @@ class Aggregator:
                 self._telemetry_on = False
 
     def _run_cases(self) -> None:
-        """The enabled simulation cases, in reference order."""
-        if self.n_communities > 1 and (
-                self.config["simulation"].get("run_rl_agg", False)
-                or self.config["simulation"].get("run_rl_simplified", False)):
-            # The RL cases drive ONE community's reward price; the
-            # vectorized fleet policy is ROADMAP item 5 (it builds on this
-            # community axis) — refuse loudly rather than train a single
-            # agent against a silently-merged fleet aggregate.
-            raise ValueError(
-                "fleet.communities > 1 currently supports the baseline MPC "
-                "case only (run_rbo_mpc); the fleet RL aggregator is "
-                "ROADMAP item 5")
+        """The enabled simulation cases, in reference order.
+
+        Fleet RL (ROADMAP item 1, shipped): ``fleet.communities > 1``
+        with an RL case enabled routes through the vectorized fleet
+        trainer (dragg_tpu/rl/fleet) — each community's agent stream
+        announces its OWN reward price and sees its OWN per-community
+        aggregate (never a silently-merged fleet total); the rl/runner
+        entry points dispatch on ``n_communities``."""
         if self.config["simulation"].get("run_rbo_mpc", True):
             self.case = "baseline"
             self.get_homes()

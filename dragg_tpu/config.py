@@ -237,6 +237,34 @@ _DEFAULT: dict[str, Any] = {
             "batch_size": 32,
             "twin_q": True,
         },
+        # Fleet-scale vectorized RL training (dragg_tpu/rl/fleet —
+        # ROADMAP item 1, architecture.md §17; no reference analog: the
+        # reference trains one agent against one community).  Active only
+        # when fleet.communities > 1; C = 1 keeps the single-community
+        # RL paths byte-for-byte (test-pinned).
+        "fleet": {
+            "policy": "shared",     # "shared": ONE actor-critic trained
+                                    # IMPALA-style from C parallel rollout
+                                    # streams feeding a common replay +
+                                    # batched learner update per step;
+                                    # "per_community": C independent
+                                    # agents (vmapped reference cores)
+            "learner_batch": 0,     # learner minibatch for the shared
+                                    # policy's batched update (0 =
+                                    # rl.parameters.batch_size)
+            "gradient": "score",    # "score": stochastic policy gradient
+                                    # (reference semantics); "mpc": add a
+                                    # deterministic actor term through the
+                                    # branch-free relaxed MPC solve
+                                    # (jvp d agg_load/d rp — CA-AC-MPC,
+                                    # PAPERS.md; shared policy only)
+            "mpc_weight": 0.25,     # weight of the "mpc" actor term
+            "event_features": True,  # fold the scenario event timeline
+                                     # (round 13) into the shared policy's
+                                     # observation as per-community
+                                     # features (price shock / DR cap /
+                                     # outage / comfort relax intensity)
+        },
     },
     # Supervised device execution (dragg_tpu/resilience — no reference
     # analog; the reference has no accelerator to lose).

@@ -903,6 +903,25 @@ class Engine:
         with (``None`` for a single community)."""
         return self._fleet
 
+    def community_fold_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """``(comm_idx, mask)`` aligned with the MERGED per-home
+        StepOutputs columns (bucket-concatenation order), for on-device
+        per-community aggregate folds: ``segment_sum(vec * mask,
+        comm_idx, C)`` reproduces each community's ``agg_load``-style sum
+        exactly as the fleet-total scalar does (same check mask, pad
+        slots zeroed).  The fleet RL scans (dragg_tpu/rl/fleet) thread
+        these through their jitted chunk as arguments — host numpy here,
+        never traced closures (multi-host discipline)."""
+        if self._bucketed:
+            comm = np.concatenate(
+                [np.asarray(c.comm_idx) for c in self._buckets])
+            mask = np.concatenate(
+                [np.asarray(c.check_mask) for c in self._buckets])
+        else:
+            comm = np.asarray(self._fleet_rows["comm_idx"])
+            mask = np.asarray(self._check_mask)
+        return comm.astype(np.int32), mask.astype(np.float32)
+
     @property
     def n_communities(self) -> int:
         return 1 if self._fleet is None else self._fleet.n_communities
@@ -1025,7 +1044,13 @@ class Engine:
         """Assemble phase: environment windows, water draws, seasonal gate,
         and the batched QP for one timestep of ONE bucket (``ctx`` — the
         superset view when unbucketed).  ``t`` is the sim timestep
-        (traced), ``rp`` the reward-price vector (H,) for this step."""
+        (traced), ``rp`` the reward-price vector (H,) for this step — or
+        (C, H) PER-COMMUNITY reward prices (the fleet RL aggregator,
+        dragg_tpu/rl/fleet: each community's agent announces its own
+        price), routed per home through ``ctx.comm_idx`` exactly like the
+        scenario event windows.  The shape is a trace-time switch, so the
+        (H,) baseline/single-agent program is byte-identical to the
+        pre-fleet-RL engine."""
         p = self.params
         lay = ctx.lay
         b = ctx.batch
@@ -1060,19 +1085,21 @@ class Engine:
         # whenever every offset is zero (single communities, and fleets
         # running synchronized weather).
         start = p.start_index + t
+        rp_rows = (rp[ctx.comm_idx, :].astype(f32) if rp.ndim == 2
+                   else rp[None, :].astype(f32))
         if self._per_home_env:
             row0 = start + ctx.env_off[:, None]                  # (n, 1)
             oat_w = self._oat[row0 + jnp.arange(H + 1)[None, :]]  # (n, H+1)
             ghi_w = self._ghi[row0 + jnp.arange(H + 1)[None, :]]
             tou_w = self._tou[row0 + jnp.arange(H)[None, :]]      # (n, H)
-            price_total = rp[None, :].astype(f32) + tou_w
+            price_total = rp_rows + tou_w
             oat0, oat1 = oat_w[:, 0], oat_w[:, 1]
             oat_fore = oat_w[:, 1:]
         else:
             oat_w = lax.dynamic_slice(self._oat, (start,), (H + 1,))
             ghi_w = lax.dynamic_slice(self._ghi, (start,), (H + 1,))
             tou_w = lax.dynamic_slice(self._tou, (start,), (H,))
-            price_total = rp[None, :].astype(f32) + tou_w[None, :]
+            price_total = rp_rows + tou_w[None, :]
             oat0, oat1 = oat_w[0], oat_w[1]
             oat_fore = oat_w[None, 1:]
 

@@ -7,7 +7,10 @@ one jittable ``train_step`` whose replay buffer, ridge solve and policy update
 all live on device — so the whole RL loop composes with the community engine
 inside a single ``lax.scan``.  A Flax DDPG twin-Q core with the same step
 contract lives in :mod:`dragg_tpu.rl.neural` (``[rl.parameters] agent =
-"ddpg"``).
+"ddpg"``), and the fleet-scale vectorized trainer (C communities, shared
+IMPALA-style policy — ROADMAP item 1, architecture.md §17) in
+:mod:`dragg_tpu.rl.fleet` (imported lazily by the runner dispatch; not
+re-exported here so baseline runs never pay the Flax import).
 """
 
 from dragg_tpu.rl.agent import RLAgent, UtilityAgent

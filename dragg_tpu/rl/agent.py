@@ -40,6 +40,19 @@ RL_DATA_KEYS = (
 )
 
 
+def new_rl_data(beta: float, batch_size: int, sigma: float,
+                extra_params: dict) -> dict:
+    """Fresh rl_data telemetry dict (dragg/agent.py:247-256 schema) — the
+    ONE constructor shared by the single-community agents here and the
+    fleet agent (dragg_tpu/rl/fleet), so the JSON schema cannot fork."""
+    data: dict = {k: [] for k in RL_DATA_KEYS}
+    data["parameters"] = {
+        "beta": beta, "batch_size": batch_size, "sigma": sigma,
+        **extra_params,
+    }
+    return data
+
+
 class RLAgent:
     """Linear actor-critic price-signal agent (dragg/agent.py:42).
 
@@ -82,13 +95,9 @@ class RLAgent:
                 f"Unknown rl.parameters.agent {self.kind!r} (linear | ddpg)"
             )
         self._step = jax.jit(lambda c, o: self.step_core(c, o, self.params))
-        self.rl_data: dict = {k: [] for k in RL_DATA_KEYS}
-        self.rl_data["parameters"] = {
-            "beta": self.params.beta,
-            "batch_size": self.params.batch_size,
-            "sigma": self.params.sigma,
-            **extra_params,
-        }
+        self.rl_data: dict = new_rl_data(
+            self.params.beta, self.params.batch_size, self.params.sigma,
+            extra_params)
 
     def scan_step(self, carry, obs):
         """The jittable (carry, obs) → (carry, record) hook the fused device

@@ -128,6 +128,21 @@ def init_carry(params: AgentParams, seed: int) -> AgentCarry:
     )
 
 
+def obs_to_state(obs: RLObservation) -> jnp.ndarray:
+    """Stack the four observation scalars into the ``(..., 4)`` state
+    vector — the ONE definition of the state layout, shared by the
+    single-community cores here / in :mod:`dragg_tpu.rl.neural` and the
+    fleet cores (:mod:`dragg_tpu.rl.fleet`, where the leaves carry a
+    leading community axis), so the two cannot drift."""
+    f32 = jnp.float32
+    return jnp.stack([
+        obs.fcst_error.astype(f32),
+        obs.forecast_trend.astype(f32),
+        obs.time_of_day.astype(f32),
+        obs.delta_action.astype(f32),
+    ], axis=-1)
+
+
 def _phi_s(s):
     return state_basis(s[0], s[1], s[2])
 
@@ -186,12 +201,7 @@ def train_step(carry: AgentCarry, obs: RLObservation, params: AgentParams):
     to apply next timestep (the reward-price scalar before clipping).
     """
     f32 = jnp.float32
-    next_state = jnp.stack([
-        obs.fcst_error.astype(f32),
-        obs.forecast_trend.astype(f32),
-        obs.time_of_day.astype(f32),
-        obs.delta_action.astype(f32),
-    ])
+    next_state = obs_to_state(obs)
     # Timestep 0: state ← next_state, action stays 0 (dragg/agent.py:132-136).
     first = carry.t == 0
     state = jnp.where(first, next_state, carry.state)

@@ -76,6 +76,18 @@ def init_env_carry(n_homes: int, prev_n: int, max_poss_load: float) -> EnvCarry:
     )
 
 
+def init_fleet_env_carry(n_homes: int, prev_n: int, max_poss_load) -> EnvCarry:
+    """(C,)-batched :func:`init_env_carry` for the vectorized fleet RL
+    loop (dragg_tpu/rl/fleet): every EnvCarry leaf gains a leading
+    community axis.  ``n_homes`` is PER COMMUNITY; ``max_poss_load`` is
+    the (C,) per-community max-possible-load vector (communities are
+    distinct populations — fleet seeds — so their normalizers differ)."""
+    import jax
+
+    mpl = jnp.asarray(max_poss_load, jnp.float32)
+    return jax.vmap(lambda m: init_env_carry(n_homes, prev_n, m))(mpl)
+
+
 def observe(env: EnvCarry, t, dt: int, norm: float) -> RLObservation:
     """Build the agent observation + reward from community measurements
     (concretization of the abstract calc_state/reward — see

@@ -1,0 +1,1079 @@
+"""Fleet-scale vectorized RL training — the paper's headline workload at
+fleet scale (ROADMAP item 1, docs/architecture.md §17).
+
+The reference trains ONE ``RLAgent`` against ONE community through Redis
+round-trips (dragg/agent.py, dragg/aggregator.py:876-911).  Here the
+round-12 fleet engine already folds ``fleet.communities = C`` independent
+communities into one batched tensor program, so the JAX-native RL
+environment is a ready-made *vectorized fleet of parallel rollouts*: this
+module gives the per-community env carry a leading community axis, maps
+it onto the engine's per-community aggregate folds
+(``Engine.community_fold_arrays``), and trains the reward-price policy
+across all C rollout streams inside ONE fused jitted step — no
+per-community recompile, no host round-trips inside a chunk.
+
+Two policy layouts (``[rl.fleet] policy``):
+
+* ``"shared"`` (default) — IMPALA-style actor/learner split after the
+  Volt-VAR RLlib-IMPALA paper (PAPERS.md, arxiv 2402.15932): C parallel
+  actors (per-community RNG streams derived from the fleet seed stride,
+  so exploration is deterministic and composition-invariant) feed one
+  SHARED replay buffer, and a single batched learner update per step
+  trains one actor-critic.  Both cores are supported: the reference's
+  linear basis actor-critic (:mod:`dragg_tpu.rl.core`) and the Flax DDPG
+  twin-Q core (:mod:`dragg_tpu.rl.neural`).  The shared policy's
+  observation is EXTENDED with per-community scenario event-timeline
+  features (round 13: tariff shock / DR cap / outage / comfort-relax
+  intensity over the upcoming window), so one policy learns across
+  heterogeneous event schedules.
+* ``"per_community"`` — C independent agents: the unmodified reference
+  cores, ``vmap``-ped over the community axis (a control for shared-vs-
+  independent learning A/Bs; 4-scalar observations, no event features).
+
+Optionally (``[rl.fleet] gradient = "mpc"``) the actor update gains a
+DETERMINISTIC first-order term through the community response — the
+CA-AC-MPC angle (PAPERS.md, arxiv 2605.29155): d(agg_load)/d(rp) is
+computed by forward-mode ``jax.jvp`` through the engine's relaxed solve.
+The reluqp family's iteration is branch-free by construction (fixed
+dense-matmul sequence + clamp — ops/reluqp.py), and ``lax.while_loop``
+supports exactly the forward-mode differentiation this needs; one jvp
+with the per-community price-window tangent yields every community's own
+d(agg)/d(a) in a single pass (communities are decoupled through rp).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dragg_tpu.rl.agent import RLAgent, new_rl_data
+from dragg_tpu.rl.basis import (
+    STATE_ACTION_DIM,
+    STATE_DIM,
+    state_action_basis,
+    state_basis,
+)
+from dragg_tpu.rl.core import (
+    MEMORY_CAP,
+    AgentParams,
+    RLObservation,
+    StepRecord,
+    obs_to_state,
+    params_from_config,
+)
+from dragg_tpu.rl.env import (
+    EnvCarry,
+    init_fleet_env_carry,
+    init_tracker,
+    observe,
+    simplified_response,
+    tracker_step,
+)
+
+# Per-community scenario event features appended to the shared policy's
+# state vector (round-13 timeline families, in this order):
+# [price-shock intensity, DR-cap activity fraction, outage fraction,
+#  comfort-relax intensity].  Event-free runs see exact zeros.
+N_EVENT_FEATURES = 4
+FLEET_STATE_SCALARS = 4 + N_EVENT_FEATURES        # replay state width
+FLEET_STATE_DIM = STATE_DIM + N_EVENT_FEATURES    # φ(s) width
+FLEET_SA_DIM = STATE_ACTION_DIM + N_EVENT_FEATURES  # φ(s, a) width
+
+# PRNG stream constants: decouple the RL exploration / learner streams
+# from the population-synthesis and forecast-noise uses of the same
+# community seeds (engine._prepare keys on the raw PRNGKey(seed)).
+_NOISE_STREAM = 0x52F7
+_LEARNER_STREAM = 0x1EA5
+
+
+class FleetParams(NamedTuple):
+    """Static ``[rl.fleet]`` knobs (docs/config.md)."""
+
+    policy: str          # "shared" | "per_community"
+    learner_batch: int   # shared learner minibatch (resolved, > 0)
+    gradient: str        # "score" | "mpc"
+    mpc_weight: float
+    event_features: bool
+    n_communities: int
+
+
+def fleet_params_from_config(config: dict, n_communities: int) -> FleetParams:
+    """Resolve + validate the ``[rl.fleet]`` table."""
+    f = config.get("rl", {}).get("fleet", {}) or {}
+    policy = str(f.get("policy", "shared"))
+    if policy not in ("shared", "per_community"):
+        raise ValueError(
+            f"rl.fleet.policy must be 'shared' or 'per_community', "
+            f"got {policy!r}")
+    gradient = str(f.get("gradient", "score"))
+    if gradient not in ("score", "mpc"):
+        raise ValueError(
+            f"rl.fleet.gradient must be 'score' or 'mpc', got {gradient!r}")
+    if gradient == "mpc" and policy != "shared":
+        raise ValueError(
+            "rl.fleet.gradient = 'mpc' requires rl.fleet.policy = 'shared' "
+            "(the deterministic actor term updates the one shared policy)")
+    lb = int(f.get("learner_batch", 0) or 0)
+    if lb <= 0:
+        lb = int(config["rl"]["parameters"]["batch_size"])
+    return FleetParams(
+        policy=policy,
+        learner_batch=lb,
+        gradient=gradient,
+        mpc_weight=float(f.get("mpc_weight", 0.25)),
+        event_features=bool(f.get("event_features", True)),
+        n_communities=int(n_communities),
+    )
+
+
+class FleetObservation(NamedTuple):
+    """One fleet timestep's observation: the reference 4-scalar
+    observation batched over communities, the per-community event
+    features, and (mpc gradient mode) d(reward)/d(action) for the action
+    whose reward ``obs.reward`` reports."""
+
+    obs: RLObservation        # (C,) leaves
+    events: jnp.ndarray       # (C, N_EVENT_FEATURES)
+    drda: jnp.ndarray         # (C,)
+
+
+# --------------------------------------------------------------------------
+# Per-community PRNG streams (satellite: fleet seed stride determinism)
+# --------------------------------------------------------------------------
+
+def community_seeds(config: dict, n_communities: int) -> np.ndarray:
+    """Per-community seeds from the SAME derivation as the fleet
+    population (homes.fleet_config / FleetSpec.seeds:
+    ``random_seed + c * seed_stride``) — community c of a C-fleet and
+    community 0 of the corresponding standalone run share one seed by
+    construction (regression-pinned in tests/test_rl_fleet.py)."""
+    from dragg_tpu.homes import fleet_config
+
+    _c, stride, _off = fleet_config(config)
+    base = int(config["simulation"]["random_seed"])
+    return base + stride * np.arange(n_communities)
+
+
+def community_noise_keys(config: dict, n_communities: int) -> jnp.ndarray:
+    """(C, 2) uint32 per-community exploration-noise keys: the community
+    seed's PRNGKey folded with the RL noise stream constant (decoupled
+    from the engine's forecast-noise use of the same seed)."""
+    seeds = community_seeds(config, n_communities)
+    return jnp.stack([
+        jax.random.fold_in(jax.random.PRNGKey(int(s)), _NOISE_STREAM)
+        for s in seeds
+    ])
+
+
+def _learner_key(config: dict) -> jnp.ndarray:
+    base = int(config["simulation"]["random_seed"])
+    return jax.random.fold_in(jax.random.PRNGKey(base), _LEARNER_STREAM)
+
+
+# --------------------------------------------------------------------------
+# Extended feature maps (event features ride the basis tail)
+# --------------------------------------------------------------------------
+
+def _phi_s_fleet(sv):
+    """φ(s) for the (4 + F)-scalar fleet state: the reference 23-dim
+    basis over the 4 reference scalars, with the raw event features
+    appended as linear terms."""
+    return jnp.concatenate([state_basis(sv[0], sv[1], sv[2]), sv[4:]])
+
+
+def _phi_sa_fleet(sv, a):
+    return jnp.concatenate(
+        [state_action_basis(sv[0], sv[1], sv[2], sv[3], a), sv[4:]])
+
+
+def _fleet_state(fobs: FleetObservation) -> jnp.ndarray:
+    """(C, 4 + F) stacked state scalars + event features."""
+    return jnp.concatenate(
+        [obs_to_state(fobs.obs), fobs.events.astype(jnp.float32)], axis=-1)
+
+
+# --------------------------------------------------------------------------
+# Shared linear core (IMPALA-style: C actors, one learner, one policy)
+# --------------------------------------------------------------------------
+
+class FleetLinearCarry(NamedTuple):
+    """Shared-policy linear actor-critic state: ONE θ pair, C rollout
+    streams, one shared replay holding C transitions per fleet step."""
+
+    theta_mu: jnp.ndarray     # (FLEET_STATE_DIM,)
+    theta_q: jnp.ndarray      # (FLEET_SA_DIM, n_q)
+    z_theta_mu: jnp.ndarray   # (C, FLEET_STATE_DIM) per-community traces
+    state: jnp.ndarray        # (C, 4 + F)
+    next_action: jnp.ndarray  # (C,)
+    avg_reward: jnp.ndarray   # ()
+    cum_reward: jnp.ndarray   # ()
+    i: jnp.ndarray            # () int32 twin-Q index
+    t: jnp.ndarray            # () int32 fleet steps taken
+    mem_s: jnp.ndarray        # (CAP, 4 + F) shared replay
+    mem_a: jnp.ndarray        # (CAP,)
+    mem_r: jnp.ndarray        # (CAP,)
+    mem_s1: jnp.ndarray       # (CAP, 4 + F)
+    comm_keys: jnp.ndarray    # (C, 2) per-community noise streams
+    key: jnp.ndarray          # (2,) learner stream (minibatch sampling)
+
+
+def init_fleet_linear(params: AgentParams, fparams: FleetParams,
+                      config: dict) -> FleetLinearCarry:
+    C = fparams.n_communities
+    f32 = jnp.float32
+    key = _learner_key(config)
+    key, kq = jax.random.split(key)
+    return FleetLinearCarry(
+        theta_mu=jnp.zeros((FLEET_STATE_DIM,), f32),
+        theta_q=0.3 * jax.random.normal(kq, (FLEET_SA_DIM, params.n_q), f32),
+        z_theta_mu=jnp.zeros((C, FLEET_STATE_DIM), f32),
+        state=jnp.zeros((C, FLEET_STATE_SCALARS), f32),
+        next_action=jnp.zeros((C,), f32),
+        avg_reward=jnp.zeros((), f32),
+        cum_reward=jnp.zeros((), f32),
+        i=jnp.zeros((), jnp.int32),
+        t=jnp.zeros((), jnp.int32),
+        mem_s=jnp.zeros((MEMORY_CAP, FLEET_STATE_SCALARS), f32),
+        mem_a=jnp.zeros((MEMORY_CAP,), f32),
+        mem_r=jnp.zeros((MEMORY_CAP,), f32),
+        mem_s1=jnp.zeros((MEMORY_CAP, FLEET_STATE_SCALARS), f32),
+        comm_keys=community_noise_keys(config, C),
+        key=key,
+    )
+
+
+def fleet_linear_step(carry: FleetLinearCarry, fobs: FleetObservation,
+                      params: AgentParams, fparams: FleetParams):
+    """One fused fleet step of the shared linear core.
+
+    Actor side is the reference's per-community math (core.train_step)
+    vectorized over C; learner side is ONE batched ridge refit per step
+    from the SHARED replay (the IMPALA split: rollouts feed experience,
+    the learner consumes it batched); policy side averages the
+    per-community eligibility-trace gradients into the one shared θ_μ.
+    """
+    C = carry.state.shape[0]
+    f32 = jnp.float32
+    next_state = _fleet_state(fobs)                     # (C, D)
+    first = carry.t == 0
+    state = jnp.where(first, next_state, carry.state)
+    action = carry.next_action                          # (C,)
+    r = fobs.obs.reward.astype(f32)                     # (C,)
+
+    # Per-community exploration streams + the learner's own stream.
+    splits = jax.vmap(jax.random.split)(carry.comm_keys)  # (C, 2, 2)
+    comm_keys, k_next = splits[:, 0], splits[:, 1]
+    key, k_idx, k_act = jax.random.split(carry.key, 3)
+
+    # Memorize C transitions per fleet step (same slot discipline as the
+    # single-community core: the degenerate t=0 self-loops are dropped,
+    # so the valid prefix of the shared buffer stays dense — fleet step k
+    # owns slots (k-1)·C .. k·C-1 mod CAP).
+    base = jnp.maximum(carry.t - 1, 0) * C
+    slots = jnp.mod(base + jnp.arange(C), MEMORY_CAP)
+    keep = lambda old, new: jnp.where(first, old, new)
+    mem_s = carry.mem_s.at[slots].set(keep(carry.mem_s[slots], state))
+    mem_a = carry.mem_a.at[slots].set(keep(carry.mem_a[slots], action))
+    mem_r = carry.mem_r.at[slots].set(keep(carry.mem_r[slots], r))
+    mem_s1 = carry.mem_s1.at[slots].set(keep(carry.mem_s1[slots], next_state))
+    valid = jnp.minimum(carry.t * C, MEMORY_CAP)
+
+    # Twin-Q index flip BEFORE the TD pair (core.train_step parity).
+    i = jnp.mod(carry.i + 1, params.n_q)
+    phi_k = jax.vmap(_phi_sa_fleet)(state, action)       # (C, SA)
+    mu_next = jax.vmap(lambda sv: carry.theta_mu @ _phi_s_fleet(sv))(
+        next_state)                                      # (C,)
+    noise = jax.vmap(lambda k: jax.random.normal(k, (), f32))(k_next)
+    next_action = mu_next + params.sigma * noise
+    phi_k1 = jax.vmap(_phi_sa_fleet)(next_state, next_action)
+    q_pred = phi_k @ carry.theta_q[:, i]                 # (C,)
+    q_obs = r + params.beta * (phi_k1 @ carry.theta_q[:, i])
+
+    # ----- Batched learner update (shared replay → one ridge refit).
+    B = fparams.learner_batch
+    idx = jax.random.randint(k_idx, (B,), 0, jnp.maximum(valid, 1))
+    s_b, a_b = mem_s[idx], mem_a[idx]
+    r_b, s1_b = mem_r[idx], mem_s1[idx]
+    a1_keys = jax.random.split(k_act, B)
+    mu1 = jax.vmap(lambda sv: carry.theta_mu @ _phi_s_fleet(sv))(s1_b)
+    a1 = mu1 + params.sigma * jax.vmap(
+        lambda k: jax.random.normal(k, (), f32))(a1_keys)
+    phi1 = jax.vmap(_phi_sa_fleet)(s1_b, a1)             # (B, SA)
+    q1 = jnp.min(phi1 @ carry.theta_q, axis=1)
+    y = r_b + params.beta * q1
+    phi = jax.vmap(_phi_sa_fleet)(s_b, a_b)
+    phi_c = phi - jnp.mean(phi, axis=0)
+    y_c = y - jnp.mean(y)
+    gram = phi_c.T @ phi_c + params.ridge_alpha * jnp.eye(
+        FLEET_SA_DIM, dtype=phi.dtype)
+    theta_r = jnp.linalg.solve(gram, phi_c.T @ y_c)
+    blended = (params.alpha_q * theta_r
+               + (1.0 - params.alpha_q) * carry.theta_q[:, i])
+    do = valid > B
+    theta_q = carry.theta_q.at[:, i].set(
+        jnp.where(do, blended, carry.theta_q[:, i]))
+
+    # ----- Shared policy update: per-community traces, averaged gradient
+    # (the standardized-score discipline of core.train_step, batched).
+    x_k = jax.vmap(_phi_s_fleet)(state)                  # (C, SD)
+    delta = jnp.clip(q_obs - q_pred, -1.0, 1.0)          # (C,)
+    avg_reward = carry.avg_reward + params.alpha_r * jnp.mean(delta)
+    cum_reward = carry.cum_reward + jnp.mean(r)
+    mu = jnp.clip(x_k @ carry.theta_mu,
+                  params.action_low, params.action_high)  # (C,)
+    grad_pi_mu = (action - mu)[:, None] / params.sigma * x_k
+    z = params.lam_theta * carry.z_theta_mu + grad_pi_mu
+    g = jnp.mean(delta[:, None] * z, axis=0)
+    if fparams.gradient == "mpc":
+        # Deterministic actor term through the relaxed MPC response
+        # (CA-AC-MPC): dR/dθ ≈ E_c[ dr/da · φ(s) ], clipped like the TD
+        # error for the same stability reason.
+        drda = jnp.clip(fobs.drda.astype(f32), -1.0, 1.0)
+        g = g + fparams.mpc_weight * jnp.mean(drda[:, None] * x_k, axis=0)
+    theta_mu = carry.theta_mu + params.alpha_mu * g
+
+    new_carry = FleetLinearCarry(
+        theta_mu=theta_mu, theta_q=theta_q, z_theta_mu=z,
+        state=next_state, next_action=next_action,
+        avg_reward=avg_reward, cum_reward=cum_reward,
+        i=i, t=carry.t + 1,
+        mem_s=mem_s, mem_a=mem_a, mem_r=mem_r, mem_s1=mem_s1,
+        comm_keys=comm_keys, key=key,
+    )
+    record = StepRecord(
+        theta_q=theta_q[:, i], theta_mu=theta_mu,
+        q_obs=q_obs, q_pred=q_pred, action=action,
+        average_reward=avg_reward, cumulative_reward=cum_reward,
+        reward=r, mu=mu,
+    )
+    return new_carry, record
+
+
+# --------------------------------------------------------------------------
+# Shared DDPG core (Flax twin-Q, one policy, C rollout streams)
+# --------------------------------------------------------------------------
+
+class FleetDDPGCarry(NamedTuple):
+    """Shared-policy DDPG state — :class:`dragg_tpu.rl.neural.DDPGCarry`
+    with the rollout-side leaves batched over C and one shared replay.
+    The networks take the (4 + F)-scalar fleet state (Flax Dense infers
+    input width at init, so the neural module's MLPs are reused as-is)."""
+
+    actor: dict
+    critic1: dict
+    critic2: dict
+    t_actor: dict
+    t_critic1: dict
+    t_critic2: dict
+    opt_actor: "object"
+    opt_critic1: "object"
+    opt_critic2: "object"
+    state: jnp.ndarray        # (C, 4 + F)
+    next_action: jnp.ndarray  # (C,)
+    avg_reward: jnp.ndarray
+    cum_reward: jnp.ndarray
+    t: jnp.ndarray
+    mem_s: jnp.ndarray        # (CAP, 4 + F)
+    mem_a: jnp.ndarray
+    mem_r: jnp.ndarray
+    mem_s1: jnp.ndarray
+    comm_keys: jnp.ndarray    # (C, 2)
+    key: jnp.ndarray          # (2,)
+
+
+def init_fleet_ddpg(params, fparams: FleetParams,
+                    config: dict) -> FleetDDPGCarry:
+    from dragg_tpu.rl import neural
+
+    C = fparams.n_communities
+    D = FLEET_STATE_SCALARS
+    f32 = jnp.float32
+    key = _learner_key(config)
+    key, ka, k1, k2 = jax.random.split(key, 4)
+    a_net, c_net = neural._nets(params.hidden)
+    actor = a_net.init(ka, jnp.zeros((D,), f32))
+    critic1 = c_net.init(k1, jnp.zeros((D + neural.ACTION_DIM,), f32))
+    critic2 = c_net.init(k2, jnp.zeros((D + neural.ACTION_DIM,), f32))
+    return FleetDDPGCarry(
+        actor=actor, critic1=critic1, critic2=critic2,
+        t_actor=jax.tree.map(jnp.array, actor),
+        t_critic1=jax.tree.map(jnp.array, critic1),
+        t_critic2=jax.tree.map(jnp.array, critic2),
+        opt_actor=neural._adam_init(actor),
+        opt_critic1=neural._adam_init(critic1),
+        opt_critic2=neural._adam_init(critic2),
+        state=jnp.zeros((C, D), f32),
+        next_action=jnp.zeros((C,), f32),
+        avg_reward=jnp.zeros((), f32),
+        cum_reward=jnp.zeros((), f32),
+        t=jnp.zeros((), jnp.int32),
+        mem_s=jnp.zeros((MEMORY_CAP, D), f32),
+        mem_a=jnp.zeros((MEMORY_CAP,), f32),
+        mem_r=jnp.zeros((MEMORY_CAP,), f32),
+        mem_s1=jnp.zeros((MEMORY_CAP, D), f32),
+        comm_keys=community_noise_keys(config, C),
+        key=key,
+    )
+
+
+def fleet_ddpg_step(carry: FleetDDPGCarry, fobs: FleetObservation,
+                    params, fparams: FleetParams):
+    """Shared-policy DDPG fleet step: C rollouts feed the shared replay;
+    critic/actor/target updates follow neural.train_step exactly, gated
+    and delayed on the FLEET step counter."""
+    from dragg_tpu.rl import neural
+
+    C = carry.state.shape[0]
+    f32 = jnp.float32
+    next_state = _fleet_state(fobs)
+    first = carry.t == 0
+    state = jnp.where(first, next_state, carry.state)
+    action = carry.next_action
+    r = fobs.obs.reward.astype(f32)
+
+    splits = jax.vmap(jax.random.split)(carry.comm_keys)
+    comm_keys, k_next = splits[:, 0], splits[:, 1]
+    key, k_idx = jax.random.split(carry.key)
+
+    base = jnp.maximum(carry.t - 1, 0) * C
+    slots = jnp.mod(base + jnp.arange(C), MEMORY_CAP)
+    keep = lambda old, new: jnp.where(first, old, new)
+    mem_s = carry.mem_s.at[slots].set(keep(carry.mem_s[slots], state))
+    mem_a = carry.mem_a.at[slots].set(keep(carry.mem_a[slots], action))
+    mem_r = carry.mem_r.at[slots].set(keep(carry.mem_r[slots], r))
+    mem_s1 = carry.mem_s1.at[slots].set(keep(carry.mem_s1[slots], next_state))
+    valid = jnp.minimum(carry.t * C, MEMORY_CAP)
+
+    B = fparams.learner_batch
+    idx = jax.random.randint(k_idx, (B,), 0, jnp.maximum(valid, 1))
+    bs, ba, br, bs1 = mem_s[idx], mem_a[idx], mem_r[idx], mem_s1[idx]
+
+    a1 = neural._mu(carry.t_actor, bs1, params)
+    q1t = neural._q(carry.t_critic1, bs1, a1, params)
+    q2t = neural._q(carry.t_critic2, bs1, a1, params)
+    y = br + params.beta * jnp.minimum(q1t, q2t)
+
+    def critic_loss(cp):
+        return jnp.mean((neural._q(cp, bs, ba, params) - y) ** 2)
+
+    gated = neural.gated_adam
+    do_update = (valid >= B).astype(f32)
+    g1 = jax.grad(critic_loss)(carry.critic1)
+    g2 = jax.grad(critic_loss)(carry.critic2)
+    critic1, opt_c1 = gated(
+        do_update,
+        neural._adam_update(g1, carry.opt_critic1, carry.critic1,
+                            params.critic_lr),
+        carry.critic1, carry.opt_critic1)
+    critic2, opt_c2 = gated(
+        do_update,
+        neural._adam_update(g2, carry.opt_critic2, carry.critic2,
+                            params.critic_lr),
+        carry.critic2, carry.opt_critic2)
+
+    drda = lax.stop_gradient(jnp.clip(fobs.drda.astype(f32), -1.0, 1.0))
+
+    def actor_loss(ap):
+        loss = -jnp.mean(neural._q(critic1, bs, neural._mu(ap, bs, params),
+                                   params))
+        if fparams.gradient == "mpc":
+            # Deterministic env-gradient term on the CURRENT rollout
+            # states (CA-AC-MPC): ascend dr/da · μ(s).
+            loss = loss - fparams.mpc_weight * jnp.mean(
+                drda * neural._mu(ap, state, params))
+        return loss
+
+    delay = max(1, params.policy_delay)
+    do_actor = do_update * (jnp.mod(carry.t, delay) == 0).astype(f32)
+    ga = jax.grad(actor_loss)(carry.actor)
+    actor, opt_a = gated(
+        do_actor,
+        neural._adam_update(ga, carry.opt_actor, carry.actor,
+                            params.actor_lr),
+        carry.actor, carry.opt_actor)
+
+    tau = params.tau * do_actor
+    t_actor = neural._polyak(carry.t_actor, actor, tau)
+    t_critic1 = neural._polyak(carry.t_critic1, critic1, tau)
+    t_critic2 = neural._polyak(carry.t_critic2, critic2, tau)
+
+    mu_next = neural._mu(actor, next_state, params)      # (C,)
+    noise = params.sigma * jax.vmap(
+        lambda k: jax.random.normal(k, (), f32))(k_next)
+    next_action = jnp.clip(mu_next + noise,
+                           params.action_low, params.action_high)
+
+    q_pred = neural._q(carry.critic1, state, action, params)  # (C,)
+    q_obs = r + params.beta * q_pred
+    cum_reward = carry.cum_reward + jnp.mean(r)
+    avg_reward = carry.avg_reward + (jnp.mean(r) - carry.avg_reward) / (
+        carry.t.astype(f32) + 1.0)
+
+    new_carry = FleetDDPGCarry(
+        actor=actor, critic1=critic1, critic2=critic2,
+        t_actor=t_actor, t_critic1=t_critic1, t_critic2=t_critic2,
+        opt_actor=opt_a, opt_critic1=opt_c1, opt_critic2=opt_c2,
+        state=next_state, next_action=next_action,
+        avg_reward=avg_reward, cum_reward=cum_reward,
+        t=carry.t + 1,
+        mem_s=mem_s, mem_a=mem_a, mem_r=mem_r, mem_s1=mem_s1,
+        comm_keys=comm_keys, key=key,
+    )
+    pnorm = lambda p: jnp.sqrt(sum(
+        jnp.sum(x * x) for x in jax.tree.leaves(p)))
+    record = StepRecord(
+        theta_q=pnorm(critic1), theta_mu=pnorm(actor),
+        q_obs=q_obs, q_pred=q_pred, action=action,
+        average_reward=avg_reward, cumulative_reward=cum_reward,
+        reward=r, mu=mu_next,
+    )
+    return new_carry, record
+
+
+# --------------------------------------------------------------------------
+# Per-community mode: the reference cores, vmapped over C
+# --------------------------------------------------------------------------
+
+def init_fleet_per_community(kind: str, params, config: dict,
+                             n_communities: int):
+    """C independent agent carries stacked along a leading community
+    axis, each seeded by ITS community's fleet seed (the same derivation
+    as the population — community_seeds)."""
+    from dragg_tpu.rl import core, neural
+
+    init = core.init_carry if kind == "linear" else neural.init_carry
+    carries = [init(params, int(s))
+               for s in community_seeds(config, n_communities)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *carries)
+
+
+# --------------------------------------------------------------------------
+# Scenario event features
+# --------------------------------------------------------------------------
+
+def traced_event_features(evt: dict, start, C: int, window: int,
+                          max_rp: float) -> jnp.ndarray:
+    """(C, N_EVENT_FEATURES) per-community event intensities over the
+    upcoming ``window`` steps, computed from the engine's device-resident
+    timeline series (engine._evt — only ACTIVE families are present;
+    absent families contribute exact zeros).  ``start`` is the
+    environment-series index of the current step (engine._prepare's
+    anchor: ``start_index + t``)."""
+    f32 = jnp.float32
+    z = jnp.zeros((C,), f32)
+
+    def win(name):
+        s = evt[name]                                   # (C, T)
+        return lax.dynamic_slice(s, (0, start), (s.shape[0], window))
+
+    if "price" in evt:
+        price = jnp.clip(jnp.mean(win("price"), axis=1)
+                         / jnp.float32(max(max_rp, 1e-6)), -3.0, 3.0)
+    else:
+        price = z
+    if "cap" in evt:
+        cw = win("cap")
+        cap_active = jnp.mean(
+            (jnp.isfinite(cw) & (cw > 0)).astype(f32), axis=1)
+        outage = jnp.mean((cw == 0).astype(f32), axis=1)
+    else:
+        cap_active, outage = z, z
+    relax = (jnp.clip(jnp.mean(win("relax"), axis=1) / 2.0, 0.0, 3.0)
+             if "relax" in evt else z)
+    return jnp.stack([price, cap_active, outage, relax], axis=1)
+
+
+def event_feature_table(timeline, start_index: int, num_timesteps: int,
+                        window: int, max_rp: float) -> np.ndarray:
+    """Host-precomputed (T, C, F) feature table for the engine-less
+    simplified fleet case — same feature definitions as
+    :func:`traced_event_features`, windowed per step."""
+    C = timeline.n_communities
+    feats = np.zeros((num_timesteps, C, N_EVENT_FEATURES), np.float32)
+    price = np.asarray(timeline.price)
+    cap = np.asarray(timeline.cap)
+    relax = np.asarray(timeline.relax)
+    T_env = price.shape[1]
+    for t in range(num_timesteps):
+        a = min(start_index + t, T_env - 1)
+        b = min(a + window, T_env)
+        pw, cw, rw = price[:, a:b], cap[:, a:b], relax[:, a:b]
+        feats[t, :, 0] = np.clip(pw.mean(axis=1) / max(max_rp, 1e-6), -3, 3)
+        feats[t, :, 1] = (np.isfinite(cw) & (cw > 0)).mean(axis=1)
+        feats[t, :, 2] = (cw == 0).mean(axis=1)
+        feats[t, :, 3] = np.clip(rw.mean(axis=1) / 2.0, 0, 3)
+    return feats
+
+
+# --------------------------------------------------------------------------
+# Host-facing fleet agent
+# --------------------------------------------------------------------------
+
+class FleetAgent(RLAgent):
+    """Host bookkeeping for the vectorized fleet policy.
+
+    Reuses :class:`RLAgent`'s telemetry writer / schema; the numeric
+    state is one of the four (core × policy-layout) carries above.  The
+    rl_data scalar series hold the FLEET MEAN per step (comparable
+    across C); per-community actions ride the extra
+    ``action_by_community`` key.
+    """
+
+    name = "utility"
+
+    def __init__(self, config: dict, n_communities: int):
+        self.config = config
+        self.kind = str(config["rl"]["parameters"].get("agent", "linear"))
+        self.fparams = fleet_params_from_config(config, n_communities)
+        if self.kind == "ddpg":
+            from dragg_tpu.rl import neural
+
+            self.params = neural.params_from_config(config)
+        elif self.kind == "linear":
+            self.params = params_from_config(config)
+        else:
+            raise ValueError(
+                f"Unknown rl.parameters.agent {self.kind!r} (linear | ddpg)")
+        if self.fparams.policy == "shared":
+            if self.kind == "linear":
+                self.carry = init_fleet_linear(self.params, self.fparams,
+                                               config)
+                self._core = fleet_linear_step
+            else:
+                self.carry = init_fleet_ddpg(self.params, self.fparams,
+                                             config)
+                self._core = fleet_ddpg_step
+        else:
+            from dragg_tpu.rl import core, neural
+
+            self.carry = init_fleet_per_community(
+                self.kind, self.params, config, n_communities)
+            base = core.train_step if self.kind == "linear" \
+                else neural.train_step
+            params = self.params
+
+            def per_comm(carry, fobs, _p, _f, _step=base, _params=params):
+                return jax.vmap(lambda c, o: _step(c, o, _params))(
+                    carry, fobs.obs)
+
+            self._core = per_comm
+        self.rl_data = new_rl_data(
+            self.params.beta, self.params.batch_size, self.params.sigma,
+            {"agent": self.kind,
+             "fleet": {"communities": n_communities,
+                       "policy": self.fparams.policy,
+                       "learner_batch": self.fparams.learner_batch,
+                       "gradient": self.fparams.gradient,
+                       "event_features": self.fparams.event_features}})
+        self.rl_data["action_by_community"] = []
+
+    def scan_step(self, carry, fobs: FleetObservation):
+        return self._core(carry, fobs, self.params, self.fparams)
+
+    # ------------------------------------------------------------ telemetry
+    def record_chunk(self, recs: StepRecord) -> None:
+        """Fold a stacked chunk of fleet StepRecords into the rl_data
+        schema: scalar keys take the fleet mean per step; θ rows are the
+        shared vectors (shared policy) or the community mean
+        (per-community mode); per-community actions are kept whole."""
+        actions = np.asarray(recs.action)
+        T = actions.shape[0]
+        acts = actions.reshape(T, -1)
+        self.rl_data["action_by_community"].extend(
+            [[float(v) for v in row] for row in acts])
+
+        shared = self.fparams.policy == "shared"
+
+        def theta_rows(a):
+            a = np.asarray(a)
+            if not shared:
+                a = a.mean(axis=1)     # fold the community axis
+            if a.ndim == 1:            # DDPG parameter norms
+                return [[float(v)] for v in a]
+            return [list(map(float, row)) for row in a]
+
+        self.rl_data["theta_q"].extend(theta_rows(recs.theta_q))
+        self.rl_data["theta_mu"].extend(theta_rows(recs.theta_mu))
+        for name, field in (
+            ("q_obs", recs.q_obs), ("q_pred", recs.q_pred),
+            ("action", recs.action),
+            ("average_reward", recs.average_reward),
+            ("cumulative_reward", recs.cumulative_reward),
+            ("reward", recs.reward), ("mu", recs.mu),
+        ):
+            a = np.asarray(field).reshape(T, -1).mean(axis=1)
+            self.rl_data[name].extend(float(v) for v in a)
+
+
+# --------------------------------------------------------------------------
+# Fleet env carry + fused rl_agg step
+# --------------------------------------------------------------------------
+
+class FleetEnvCarry(NamedTuple):
+    """(C,)-batched environment carry plus the mpc-gradient channel."""
+
+    env: EnvCarry             # every leaf (C, ...)
+    drda: jnp.ndarray         # (C,) d r_{t}/d a_{t-1} (zeros in score mode)
+
+
+def _rp_matrix(rp_c, H: int, rp_len: int, dt: int):
+    """(C, H) per-community price windows + the jvp tangent d rp/d a
+    (the window indicator) — the fleet generalization of the runner's
+    scalar announcement (rl/runner._fused_step window semantics)."""
+    C = rp_c.shape[0]
+    if rp_len <= dt or rp_len >= H:
+        rp_mat = jnp.broadcast_to(rp_c[:, None], (C, H)).astype(jnp.float32)
+        tangent = jnp.ones((C, H), jnp.float32)
+    else:
+        win = (jnp.arange(H) < rp_len).astype(jnp.float32)[None, :]
+        rp_mat = (rp_c[:, None] * win).astype(jnp.float32)
+        tangent = jnp.broadcast_to(win, (C, H)).astype(jnp.float32)
+    return rp_mat, tangent
+
+
+def _fleet_fused_step(engine, agent: FleetAgent, dt, norms, max_rp, rp_len,
+                      fold, carry, t, t0):
+    """One fused fleet RL + community-MPC timestep: C agents observe →
+    the shared (or per-community) policy acts → the ENGINE solves every
+    community under one compiled pattern set with per-community reward
+    prices → per-community aggregates fold back into the batched env
+    carry.  Ordering parity with rl/runner._fused_step throughout."""
+    comm, mask = fold
+    C = agent.fparams.n_communities
+    (cstate, acarry, fenv), factor = carry
+    env = fenv.env
+    obs = jax.vmap(observe, in_axes=(0, None, None, 0))(env, t, dt, norms)
+    H = engine.params.horizon
+    if agent.fparams.event_features and engine._evt:
+        ev = traced_event_features(
+            engine._evt, engine.params.start_index + t, C, H, max_rp)
+    else:
+        ev = jnp.zeros((C, N_EVENT_FEATURES), jnp.float32)
+    fobs = FleetObservation(obs=obs, events=ev, drda=fenv.drda)
+    acarry, rec = agent.scan_step(acarry, fobs)
+    aparams = agent.params
+    action = jnp.clip(acarry.next_action,
+                      aparams.action_low, aparams.action_high)   # (C,)
+    rp_c = jnp.clip(action, -max_rp, max_rp)
+    rp_mat, tangent = _rp_matrix(rp_c, H, rp_len, dt)
+
+    K = max(1, engine.params.admm_refactor_every)
+    refresh = (t == t0) | ((t % K) == 0)
+
+    def env_step(rp):
+        cs, fc, outs = engine._step(cstate, t, rp, refresh, factor)
+        # The differentiated head is the RELAXED response: the plan's
+        # continuous step-1 grid power.  The applied step-0 aggregate is
+        # integerized under the default semantics (tpu.integer_first_
+        # action pins ROUNDED duty counts — engine._integerize_first_
+        # action), whose tangent is zero almost everywhere; the relaxed
+        # plan is exactly what the branch-free solve differentiates
+        # (CA-AC-MPC's relaxed-solve gradient).
+        fore = jax.ops.segment_sum(outs.forecast_p_grid * mask, comm,
+                                   num_segments=C)
+        return fore, (cs, fc, outs)
+
+    if agent.fparams.gradient == "mpc":
+        # ONE forward-mode pass through the branch-free relaxed solve
+        # yields every community's d(relaxed load)/d(action): communities
+        # couple only through their own rp rows, so the full-window
+        # tangent's cross terms are structurally zero.
+        fore_c, dagg, (cstate, factor, outs) = jax.jvp(
+            env_step, (rp_mat,), (tangent,), has_aux=True)
+    else:
+        fore_c, (cstate, factor, outs) = env_step(rp_mat)
+        dagg = jnp.zeros((C,), jnp.float32)
+
+    agg_c = jax.ops.segment_sum(outs.p_grid * mask, comm, num_segments=C)
+    tracker, sp = jax.vmap(tracker_step, in_axes=(0, 0, None))(
+        env.tracker, agg_c, t + 1)
+    new_env = EnvCarry(
+        agg_load=agg_c,
+        forecast_load=fore_c,
+        prev_forecast_load=env.forecast_load,
+        setpoint=sp,
+        prev_action=env.action,
+        action=rp_c,
+        tracker=tracker,
+    )
+    # dr_{t+1}/da_t for the NEXT step's actor term: r = -((agg-sp)/norm)²
+    # with the setpoint's own (1/prev_n) dependence on agg dropped — a
+    # first-order surrogate, clipped at use.
+    if agent.fparams.gradient == "mpc":
+        err = (agg_c - sp) / norms
+        drda = -2.0 * err * dagg / norms
+    else:
+        drda = jnp.zeros((C,), jnp.float32)
+    return (((cstate, acarry, FleetEnvCarry(new_env, drda)), factor),
+            (outs, rec, rp_c, env.setpoint))
+
+
+# --------------------------------------------------------------------------
+# Run modes
+# --------------------------------------------------------------------------
+
+def _replicate_on_mesh(engine, *trees):
+    """Replicate small host carries on the engine's mesh (the same
+    discipline as rl/runner.run_rl_agg: a sharded community state cannot
+    mix with uncommitted single-device leaves in one jitted carry)."""
+    mesh = getattr(engine, "mesh", None)
+    if mesh is None:
+        return trees if len(trees) > 1 else trees[0]
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    put = lambda a: jax.device_put(jnp.asarray(a), rep)
+    out = tuple(jax.tree_util.tree_map(put, tr) for tr in trees)
+    return out if len(out) > 1 else out[0]
+
+
+def run_rl_agg_fleet(agg) -> None:
+    """RL price-signal aggregator over a C-community MPC fleet: the
+    fleet analog of rl/runner.run_rl_agg (same chunk/checkpoint loop,
+    batched carries, per-community reward prices)."""
+    config = agg.config
+    agg.case = "rl_agg"
+    C = agg.n_communities
+    if agg.all_homes is None:
+        agg.get_homes()
+    if agg.engine is None:
+        agg._build_engine()
+    agg.reset_collected_data()
+    agg.all_rps = np.zeros(agg.num_timesteps)
+    agg.all_sps = np.zeros(agg.num_timesteps)
+    agg.fleet_rps = np.zeros((agg.num_timesteps, C))
+    agg.fleet_sps = np.zeros((agg.num_timesteps, C))
+
+    from dragg_tpu.rl.runner import _rl_settings
+
+    settings = _rl_settings(config)
+    norms_np = agg._max_possible_load_per_community()
+    agent = FleetAgent(config, C)
+    B = len(agg.all_homes) // C
+    env0 = FleetEnvCarry(
+        env=init_fleet_env_carry(B, settings["prev_n"], norms_np),
+        drda=jnp.zeros((C,), jnp.float32),
+    )
+    cstate = agg.engine.init_state()
+    fold = agg.engine.community_fold_arrays()
+    acarry, env0, norms, fold = _replicate_on_mesh(
+        agg.engine, agent.carry, env0, jnp.asarray(norms_np, jnp.float32),
+        (jnp.asarray(fold[0]), jnp.asarray(fold[1])))
+
+    step = partial(
+        _fleet_fused_step, agg.engine, agent, agg.engine.params.dt, norms,
+        settings["max_rp"],
+        settings["action_horizon"] * agg.engine.params.dt, fold)
+
+    @jax.jit
+    def chunk(consts, carry, ts):
+        with agg.engine._bound(consts):
+            (carry, _), stacked = lax.scan(
+                lambda c, t: step(c, t, ts[0]),
+                (carry, agg.engine.init_factor()), ts)
+        return carry, stacked
+
+    agg.checkpoint_interval = agg._checkpoint_steps()
+    if agg.run_dir is None:
+        agg.set_run_dir()
+    agg.log.logger.info(
+        f"Performing FLEET RL AGG run: {C} communities × {B} homes, "
+        f"policy={agent.fparams.policy}/{agent.kind}, "
+        f"gradient={agent.fparams.gradient}")
+    agg.start_time = time.time()
+    case_dir = os.path.join(agg.run_dir, agg.case)
+    carry, t = agg.try_resume((cstate, acarry, env0))
+    if agg.resumed_from is not None:
+        rl_file = os.path.join(agg.resumed_from, "rl_data.json")
+        if os.path.isfile(rl_file):
+            with open(rl_file) as f:
+                agent.rl_data = json.load(f)
+        fleet_file = os.path.join(agg.resumed_from, "fleet_rl.json")
+        if os.path.isfile(fleet_file):
+            with open(fleet_file) as f:
+                fr = json.load(f)
+            agg.fleet_rps = np.asarray(fr["rps"], dtype=np.float64)
+            agg.fleet_sps = np.asarray(fr["sps"], dtype=np.float64)
+    chunks = 0
+    while t < agg.num_timesteps:
+        n_steps = min(agg.checkpoint_interval, agg.num_timesteps - t)
+        carry, (outs, recs, rps, sps) = chunk(agg.engine._consts(), carry,
+                                              jnp.arange(t, t + n_steps))
+        agg._collect_chunk(outs, track_setpoints=False)
+        agent.record_chunk(recs)
+        rps = np.asarray(rps)                      # (n_steps, C)
+        sps = np.asarray(sps)
+        agg.fleet_rps[t:t + n_steps] = rps
+        agg.fleet_sps[t:t + n_steps] = sps
+        agg.all_rps[t:t + n_steps] = rps.mean(axis=1)
+        agg.all_sps[t:t + n_steps] = sps.mean(axis=1)
+        t += n_steps
+        chunks += 1
+        if t < agg.num_timesteps:
+            _set_fleet_summary(agg, agent)
+            agg.write_outputs()
+            agg.save_checkpoint(carry, extra_json={
+                "rl_data.json": agent.rl_data,
+                "fleet_rl.json": {"rps": agg.fleet_rps.tolist(),
+                                  "sps": agg.fleet_sps.tolist()}})
+            if agg.stop_after_chunks is not None \
+                    and chunks >= agg.stop_after_chunks:
+                agg.log.logger.info(f"Stopping early after {chunks} chunks.")
+                agg._state, agent.carry, _ = carry
+                agg.agent = agent
+                return
+    agg._state, agent.carry, _ = carry
+    agg.check_baseline_vals()
+    _set_fleet_summary(agg, agent)
+    agg.write_outputs()
+    agent.write_rl_data(case_dir)
+    agg.clear_checkpoint()
+    agg.agent = agent
+
+
+def _set_fleet_summary(agg, agent: FleetAgent) -> None:
+    """Per-community RL extras for the Summary block.  The full (T, C)
+    reward-price matrix is included up to a size cap (beyond it, only
+    the per-community mean |rp| — summary JSON is not a bulk store)."""
+    block = {
+        "communities": agent.fparams.n_communities,
+        "policy": agent.fparams.policy,
+        "agent": agent.kind,
+        "learner_batch": agent.fparams.learner_batch,
+        "gradient": agent.fparams.gradient,
+        "event_features": agent.fparams.event_features,
+        "mean_abs_rp_by_community":
+            [round(float(v), 6)
+             for v in np.abs(agg.fleet_rps).mean(axis=0)],
+    }
+    if agg.fleet_rps.size <= 200_000:
+        block["RP_by_community"] = agg.fleet_rps.T.tolist()
+        block["setpoint_by_community"] = agg.fleet_sps.T.tolist()
+    agg.extra_summary["fleet_rl"] = block
+
+
+def run_rl_simplified_fleet(agg) -> None:
+    """RL agents vs C simplified linear communities — the whole fleet
+    loop (C rollouts + shared learner + linear response) is ONE device
+    scan.  Scenario event timelines (if configured) ride the observation
+    as a host-precomputed feature table; in mpc-gradient mode the
+    response derivative is EXACT (the model is linear)."""
+    config = agg.config
+    agg.case = "simplified"
+    C = agg.n_communities
+    from dragg_tpu.rl.runner import _rl_settings
+
+    settings = _rl_settings(config)
+    simp = config["agg"].get("simplified", {})
+    c_rate = float(simp.get("response_rate", 0.3))
+    n_homes = int(config["community"]["total_number_homes"])
+    house_p_avg = float(config["community"].get("house_p_avg", 1.2))
+    norm = max(1.0, house_p_avg * n_homes * 2.5)
+    dt = agg.dt
+    max_rp = settings["max_rp"]
+
+    agent = FleetAgent(config, C)
+    aparams = agent.params
+
+    tr = init_tracker(settings["prev_n"], house_p_avg * n_homes * 2.5)
+    sp0 = float(np.mean(np.asarray(tr.tracked)))
+    f32 = jnp.float32
+    rep = lambda v: jnp.full((C,), v, f32)
+    env0 = FleetEnvCarry(
+        env=EnvCarry(
+            agg_load=rep(1.1 * sp0), forecast_load=rep(1.1 * sp0),
+            prev_forecast_load=rep(1.1 * sp0), setpoint=rep(sp0),
+            prev_action=jnp.zeros((C,), f32), action=jnp.zeros((C,), f32),
+            tracker=jax.tree.map(
+                lambda a: jnp.broadcast_to(a, (C,) + a.shape), tr),
+        ),
+        drda=jnp.zeros((C,), f32),
+    )
+
+    # Event features: resolved timeline → host (T, C, F) table (window =
+    # one hour, the announcement granularity of the simplified case).
+    feats = jnp.zeros((agg.num_timesteps, C, N_EVENT_FEATURES), f32)
+    if agent.fparams.event_features:
+        from dragg_tpu.scenarios import timeline_for
+
+        tl = timeline_for(config, C, agg.start_index + agg.num_timesteps
+                          + dt, dt, agg.start_index)
+        if tl is not None:
+            feats = jnp.asarray(event_feature_table(
+                tl, agg.start_index, agg.num_timesteps, dt, max_rp))
+
+    use_mpc = agent.fparams.gradient == "mpc"
+
+    def step(carry, t):
+        acarry, fenv = carry
+        env = fenv.env
+        obs = jax.vmap(observe, in_axes=(0, None, None, None))(
+            env, t, dt, norm)
+        fobs = FleetObservation(obs=obs, events=feats[t], drda=fenv.drda)
+        acarry, rec = agent.scan_step(acarry, fobs)
+        action = jnp.clip(acarry.next_action,
+                          aparams.action_low, aparams.action_high)
+        rp = jnp.clip(action, -max_rp, max_rp)          # (C,)
+        load, cost = simplified_response(env.agg_load, rp, env.setpoint,
+                                         c_rate)
+        tracker, sp = jax.vmap(tracker_step, in_axes=(0, 0, None))(
+            env.tracker, load, t + 1)
+        new_env = EnvCarry(
+            agg_load=load, forecast_load=load,
+            prev_forecast_load=env.agg_load,
+            setpoint=sp, prev_action=env.action, action=rp, tracker=tracker)
+        if use_mpc:
+            # Exact response derivative: d load/d rp = -c·(sp − load).
+            dload = -c_rate * (env.setpoint - env.agg_load)
+            err = (load - sp) / norm
+            drda = -2.0 * err * dload / norm
+        else:
+            drda = jnp.zeros_like(load)
+        return ((acarry, FleetEnvCarry(new_env, drda)),
+                (rec, load, cost, rp, env.setpoint))
+
+    @jax.jit
+    def run(carry, ts):
+        return lax.scan(step, carry, ts)
+
+    agg.log.logger.info(
+        f"Performing FLEET RL simplified run: {C} communities, "
+        f"policy={agent.fparams.policy}/{agent.kind}")
+    agg.start_time = time.time()
+    (acarry, _env), (recs, loads, costs, rps, sps) = run(
+        (agent.carry, env0), jnp.arange(agg.num_timesteps))
+    agent.carry = acarry
+    agent.record_chunk(recs)
+
+    loads = np.asarray(loads)                      # (T, C)
+    costs = np.asarray(costs)
+    rps = np.asarray(rps)
+    sps = np.asarray(sps)
+    agg._solve_iters = []
+    # Fleet aggregate = sum over communities (the baseline fleet
+    # engine's agg_load convention); per-community series ride the
+    # fleet_rl Summary block.
+    agg.baseline_agg_load_list = loads.sum(axis=1).tolist()
+    agg.all_rps = rps.mean(axis=1).astype(np.float64)
+    agg.all_sps = sps.mean(axis=1).astype(np.float64)
+    agg.fleet_rps = rps.astype(np.float64)
+    agg.fleet_sps = sps.astype(np.float64)
+    agg.extra_summary = {"agg_cost": costs.sum(axis=1).tolist()}
+    _set_fleet_summary(agg, agent)
+    agg.summary_only_case = True
+    if agg.run_dir is None:
+        agg.set_run_dir()
+    agg.write_outputs()
+    agg.extra_summary = {}
+    agg.summary_only_case = False
+    case_dir = os.path.join(agg.run_dir, agg.case)
+    agent.write_rl_data(case_dir)
+    agg.agent = agent

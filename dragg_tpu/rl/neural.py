@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from dragg_tpu.rl.core import RLObservation, StepRecord
+from dragg_tpu.rl.core import RLObservation, StepRecord, obs_to_state
 
 MEMORY_CAP = 2048  # replay capacity — matches the linear core's circular buffer
 
@@ -179,6 +179,22 @@ def _polyak(target, online, tau):
     return jax.tree.map(lambda t, o: (1 - tau) * t + tau * o, target, online)
 
 
+def gated_adam(gate, new_pair, old_params, old_opt):
+    """Select (params, opt) updated-vs-unchanged.  Zeroing gradients is
+    NOT enough to freeze Adam — momentum keeps moving the parameters and
+    count skews bias correction — so the whole update is switched.  The
+    ONE implementation shared by :func:`train_step` and the fleet DDPG
+    core (dragg_tpu/rl/fleet), so the freeze semantics cannot drift."""
+    new_params, new_opt = new_pair
+    pick = lambda a, b: jax.tree.map(
+        lambda x, y: jnp.where(gate > 0, x, y), a, b)
+    return pick(new_params, old_params), AdamState(
+        mu=pick(new_opt.mu, old_opt.mu),
+        nu=pick(new_opt.nu, old_opt.nu),
+        count=jnp.where(gate > 0, new_opt.count, old_opt.count),
+    )
+
+
 def train_step(carry: DDPGCarry, obs: RLObservation, params: DDPGParams):
     """One DDPG step with the same contract as the linear core's
     ``train_step``: observe → memorize → (critic, actor, target) updates →
@@ -186,12 +202,7 @@ def train_step(carry: DDPGCarry, obs: RLObservation, params: DDPGParams):
     record's ``theta_q``/``theta_mu`` slots carry network parameter norms
     (scalars) so the telemetry schema stays write-compatible."""
     f32 = jnp.float32
-    next_state = jnp.stack([
-        obs.fcst_error.astype(f32),
-        obs.forecast_trend.astype(f32),
-        obs.time_of_day.astype(f32),
-        obs.delta_action.astype(f32),
-    ])
+    next_state = obs_to_state(obs)
     first = carry.t == 0
     state = jnp.where(first, next_state, carry.state)
     action = carry.next_action
@@ -223,19 +234,7 @@ def train_step(carry: DDPGCarry, obs: RLObservation, params: DDPGParams):
     def critic_loss(cp):
         return jnp.mean((_q(cp, bs, ba, params) - y) ** 2)
 
-    def gated(gate, new_pair, old_params, old_opt):
-        """Select (params, opt) updated-vs-unchanged.  Zeroing gradients is
-        NOT enough to freeze Adam — momentum keeps moving the parameters and
-        count skews bias correction — so the whole update is switched."""
-        new_params, new_opt = new_pair
-        pick = lambda a, b: jax.tree.map(
-            lambda x, y: jnp.where(gate > 0, x, y), a, b)
-        return pick(new_params, old_params), AdamState(
-            mu=pick(new_opt.mu, old_opt.mu),
-            nu=pick(new_opt.nu, old_opt.nu),
-            count=jnp.where(gate > 0, new_opt.count, old_opt.count),
-        )
-
+    gated = gated_adam
     do_update = (carry.t >= B).astype(f32)  # len(memory) > batch gate
     g1 = jax.grad(critic_loss)(carry.critic1)
     g2 = jax.grad(critic_loss)(carry.critic2)

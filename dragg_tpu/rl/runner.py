@@ -97,7 +97,17 @@ def _fused_step(engine, agent, dt, norm, max_rp, rp_len, carry, t, t0):
 
 
 def run_rl_agg(agg) -> None:
-    """RL price-signal aggregator over the full MPC community."""
+    """RL price-signal aggregator over the full MPC community.
+
+    Fleet dispatch (ROADMAP item 1): ``fleet.communities > 1`` routes to
+    the vectorized fleet trainer (dragg_tpu/rl/fleet) — C parallel
+    rollouts under one compiled pattern set.  C = 1 keeps THIS
+    single-community path byte-for-byte (the fleet-RL C=1 equivalence
+    pin in tests/test_rl_fleet.py depends on it)."""
+    if getattr(agg, "n_communities", 1) > 1:
+        from dragg_tpu.rl.fleet import run_rl_agg_fleet
+
+        return run_rl_agg_fleet(agg)
     config = agg.config
     agg.case = "rl_agg"
     if agg.all_homes is None:
@@ -193,7 +203,13 @@ def run_rl_agg(agg) -> None:
 
 def run_rl_simplified(agg) -> None:
     """RL agent against ``test_response``'s linear model — the whole loop
-    (agent + environment) is one device scan; no MPC fleet is built."""
+    (agent + environment) is one device scan; no MPC fleet is built.
+    ``fleet.communities > 1`` routes to the vectorized fleet trainer
+    (dragg_tpu/rl/fleet), same dispatch contract as :func:`run_rl_agg`."""
+    if getattr(agg, "n_communities", 1) > 1:
+        from dragg_tpu.rl.fleet import run_rl_simplified_fleet
+
+        return run_rl_simplified_fleet(agg)
     config = agg.config
     agg.case = "simplified"
     settings = _rl_settings(config)

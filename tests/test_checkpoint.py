@@ -252,6 +252,64 @@ def test_rl_agg_resume_bit_exact(tmp_path):
     np.testing.assert_allclose(exp_rl["reward"], got_rl["reward"], rtol=1e-6)
 
 
+@pytest.mark.slow  # 3 fleet RL runs; light sibling:
+                   # tests/test_rl_fleet.py test_fleet_agent_carry_checkpoint_roundtrip
+def test_fleet_rl_agg_resume_bit_exact(tmp_path):
+    """Satellite (ROADMAP item 1): the BATCHED fleet agent carry — here
+    the shared Flax DDPG twin-Q core's nested param/Adam pytrees plus
+    the (C,)-batched env carry — checkpoints mid-training and resumes
+    bit-exact: prices, per-community prices, per-home series, and the
+    agent telemetry all match the uninterrupted run."""
+    from dragg_tpu.aggregator import Aggregator
+
+    def cfg_(resume=False):
+        cfg = _cfg(run_rbo_mpc=False, run_rl_agg=True, resume=resume)
+        cfg["fleet"]["communities"] = 2
+        cfg["rl"]["parameters"]["agent"] = "ddpg"
+        cfg["telemetry"]["enabled"] = False
+        return cfg
+
+    full = Aggregator(cfg_(), data_dir="",
+                      outputs_dir=str(tmp_path / "full"))
+    full.run()
+    exp = json.load(open(os.path.join(full.run_dir, "rl_agg",
+                                      "results.json")))
+
+    out2 = str(tmp_path / "resumed")
+    part = Aggregator(cfg_(resume=True), data_dir="", outputs_dir=out2)
+    part.stop_after_chunks = 1
+    part.run()
+    assert part.timestep == 24
+    res = Aggregator(cfg_(resume=True), data_dir="", outputs_dir=out2)
+    res.run()
+    assert res.resumed_from is not None
+    got = json.load(open(os.path.join(res.run_dir, "rl_agg",
+                                      "results.json")))
+    np.testing.assert_array_equal(
+        np.asarray(exp["Summary"]["p_grid_aggregate"]),
+        np.asarray(got["Summary"]["p_grid_aggregate"]))
+    np.testing.assert_array_equal(np.asarray(exp["Summary"]["RP"]),
+                                  np.asarray(got["Summary"]["RP"]))
+    np.testing.assert_array_equal(
+        np.asarray(exp["Summary"]["fleet_rl"]["RP_by_community"]),
+        np.asarray(got["Summary"]["fleet_rl"]["RP_by_community"]))
+    for h in (k for k in exp if k != "Summary"):
+        for series, vals in exp[h].items():
+            if isinstance(vals, list):
+                assert vals == got[h][series], (h, series)
+    exp_rl = json.load(open(os.path.join(
+        full.run_dir, "rl_agg", "utility_agent-results.json")))
+    got_rl = json.load(open(os.path.join(
+        res.run_dir, "rl_agg", "utility_agent-results.json")))
+    assert len(exp_rl["reward"]) == len(got_rl["reward"]) \
+        == full.num_timesteps
+    np.testing.assert_allclose(exp_rl["reward"], got_rl["reward"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(exp_rl["action_by_community"]),
+        np.asarray(got_rl["action_by_community"]), rtol=1e-6)
+
+
 def test_resume_across_sharding_change_starts_fresh(tiny_config, tmp_path):
     """A checkpoint written by the sharded engine (8 padded slots) must be
     rejected gracefully — not crash in load_pytree — when the run is retried

@@ -419,9 +419,13 @@ def test_fleet_run_shape_invalidates_on_communities(tmp_path):
     assert a1._run_shape()["communities"] == 1
     assert a2._run_shape() != a1._run_shape()
 
-    # RL cases refuse a fleet loudly (ROADMAP item 5 owns that).
+    # RL cases no longer refuse a fleet: fleet.communities > 1 routes to
+    # the vectorized fleet trainer (ROADMAP item 1, shipped —
+    # tests/test_rl_fleet.py owns that surface).  Baseline-only configs
+    # keep the rl_fleet shape key inert so RL config edits cannot
+    # invalidate MPC checkpoints.
     cfg = _agg_cfg()
     cfg["simulation"]["run_rl_agg"] = True
     a = Aggregator(cfg, data_dir="", outputs_dir=str(tmp_path))
-    with pytest.raises(ValueError, match="ROADMAP item 5"):
-        a.run()
+    assert a._run_shape()["rl_fleet"] is not None
+    assert a2._run_shape()["rl_fleet"] is None

@@ -1,0 +1,485 @@
+"""Fleet-scale vectorized RL training (ROADMAP item 1 — dragg_tpu/rl/fleet,
+docs/architecture.md §17).
+
+Contracts pinned here:
+
+* C = 1 equivalence: ``run_rl_agg`` with ``fleet.communities = 1`` is
+  NUMERICALLY IDENTICAL to the pre-fleet single-community RL run (the
+  same pattern as the event-free byte-identity pin in
+  tests/test_scenarios.py);
+* per-community exploration streams derive from the fleet seed stride
+  (``random_seed + c * seed_stride`` — the population's own derivation),
+  so a C=2 run's community 0 shares community 0's C=1 seed;
+* C >= 8 trains both RL cases on the conftest 8-device CPU mesh under
+  ONE compiled pattern set (no per-community recompile);
+* scenario event timelines reach the shared policy's observation and
+  heterogeneous schedules produce heterogeneous actions;
+* the optional "mpc" gradient mode (jvp through the branch-free relaxed
+  solve) engages and stays finite.
+
+Heavy legs are slow-marked with light siblings (round-11 budget
+convention); the ddpg fleet-core unit tests live in tests/test_rl_neural.py
+and the bit-exact fleet resume in tests/test_checkpoint.py.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dragg_tpu.config import default_config
+from dragg_tpu.rl.core import RLObservation, params_from_config
+from dragg_tpu.rl.fleet import (
+    FLEET_SA_DIM,
+    FLEET_STATE_DIM,
+    FLEET_STATE_SCALARS,
+    N_EVENT_FEATURES,
+    FleetObservation,
+    community_noise_keys,
+    community_seeds,
+    event_feature_table,
+    fleet_linear_step,
+    fleet_params_from_config,
+    init_fleet_linear,
+)
+
+
+def _cfg(communities=2, stride=5, **sim_over):
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = 4
+    cfg["community"]["homes_pv"] = 1
+    cfg["simulation"]["start_datetime"] = "2015-01-01 00"
+    cfg["simulation"]["end_datetime"] = "2015-01-01 04"
+    cfg["home"]["hems"]["prediction_horizon"] = 2
+    cfg["simulation"]["run_rbo_mpc"] = False
+    cfg["fleet"]["communities"] = communities
+    cfg["fleet"]["seed_stride"] = stride
+    cfg["telemetry"]["enabled"] = False
+    cfg["simulation"].update(sim_over)
+    return cfg
+
+
+def _run(cfg, tmp_path, tag, case):
+    from dragg_tpu.aggregator import Aggregator
+
+    agg = Aggregator(cfg, data_dir="", outputs_dir=str(tmp_path / tag))
+    agg.run()
+    with open(os.path.join(agg.run_dir, case, "results.json")) as f:
+        return agg, json.load(f)
+
+
+# ------------------------------------------------------------------ config
+def test_fleet_params_validation():
+    cfg = default_config()
+    fp = fleet_params_from_config(cfg, 4)
+    assert fp.policy == "shared" and fp.n_communities == 4
+    # learner_batch = 0 resolves to rl.parameters.batch_size.
+    assert fp.learner_batch == int(cfg["rl"]["parameters"]["batch_size"])
+    cfg["rl"]["fleet"]["learner_batch"] = 64
+    assert fleet_params_from_config(cfg, 4).learner_batch == 64
+    cfg["rl"]["fleet"]["policy"] = "bogus"
+    with pytest.raises(ValueError, match="policy"):
+        fleet_params_from_config(cfg, 4)
+    cfg["rl"]["fleet"]["policy"] = "per_community"
+    cfg["rl"]["fleet"]["gradient"] = "mpc"
+    with pytest.raises(ValueError, match="shared"):
+        fleet_params_from_config(cfg, 4)
+
+
+def test_run_shape_carries_rl_fleet_key(tmp_path):
+    """The fleet-RL agent-carry layout is a checkpoint-shape dimension:
+    a policy-layout flip must invalidate a resume, not crash
+    load_pytree's leaf-count check."""
+    from dragg_tpu.aggregator import Aggregator
+
+    cfg = _cfg(run_rl_agg=True)
+    a = Aggregator(cfg, data_dir="", outputs_dir=str(tmp_path))
+    shape = a._run_shape()
+    assert shape["rl_fleet"] is not None
+    cfg2 = _cfg(run_rl_agg=True)
+    cfg2["rl"]["fleet"]["policy"] = "per_community"
+    b = Aggregator(cfg2, data_dir="", outputs_dir=str(tmp_path))
+    assert b._run_shape()["rl_fleet"] != shape["rl_fleet"]
+    # Shape-determining hyperparameters are part of the key too: a DDPG
+    # width edit or a tracker-window edit re-sizes carry leaves and must
+    # invalidate, not crash load_pytree (review finding, round 15).
+    cfg3 = _cfg(run_rl_agg=True)
+    cfg3["rl"]["parameters"]["agent"] = "ddpg"
+    cfg4 = _cfg(run_rl_agg=True)
+    cfg4["rl"]["parameters"]["agent"] = "ddpg"
+    cfg4["tpu"]["ddpg_hidden"] = 32
+    k3 = Aggregator(cfg3, data_dir="",
+                    outputs_dir=str(tmp_path))._run_shape()["rl_fleet"]
+    k4 = Aggregator(cfg4, data_dir="",
+                    outputs_dir=str(tmp_path))._run_shape()["rl_fleet"]
+    assert k3 != k4
+    cfg5 = _cfg(run_rl_agg=True)
+    cfg5["agg"]["rl"] = {"prev_timesteps": 6}
+    k5 = Aggregator(cfg5, data_dir="",
+                    outputs_dir=str(tmp_path))._run_shape()["rl_fleet"]
+    assert k5 != shape["rl_fleet"]
+    # No fleet RL case → the key is inert (None), so baseline fleet
+    # checkpoints are untouched by RL config edits.
+    c = Aggregator(_cfg(), data_dir="", outputs_dir=str(tmp_path))
+    assert c._run_shape()["rl_fleet"] is None
+
+
+# ------------------------------------------------- seed-stride determinism
+def test_community_noise_keys_follow_fleet_seed_stride():
+    """Satellite regression: exploration keys derive from the SAME
+    ``random_seed + c * seed_stride`` ladder as the population, so a C=2
+    run's community 0 matches the corresponding C=1 stream and community
+    1 matches a standalone run seeded at base + stride."""
+    cfg = _cfg(communities=2, stride=7)
+    base = int(cfg["simulation"]["random_seed"])
+    np.testing.assert_array_equal(community_seeds(cfg, 2),
+                                  [base, base + 7])
+    k2 = np.asarray(community_noise_keys(cfg, 2))
+    k1 = np.asarray(community_noise_keys(cfg, 1))
+    np.testing.assert_array_equal(k2[0], k1[0])
+    # Community 1's stream is the standalone stream of seed base+stride.
+    cfg_b = _cfg(communities=1)
+    cfg_b["simulation"]["random_seed"] = base + 7
+    np.testing.assert_array_equal(
+        k2[1], np.asarray(community_noise_keys(cfg_b, 1))[0])
+    # A different stride yields different non-zero communities.
+    cfg_s = _cfg(communities=2, stride=11)
+    assert not np.array_equal(
+        np.asarray(community_noise_keys(cfg_s, 2))[1], k2[1])
+
+
+# ------------------------------------------------------- shared linear core
+def _fobs(C, fe=0.1, r=-0.5, events=None):
+    f = jnp.float32
+    rep = lambda v: jnp.full((C,), v, f)
+    obs = RLObservation(rep(fe), rep(0.0), rep(0.25), rep(0.0), rep(r))
+    ev = (jnp.zeros((C, N_EVENT_FEATURES), f) if events is None
+          else jnp.asarray(events, f))
+    return FleetObservation(obs=obs, events=ev, drda=jnp.zeros((C,), f))
+
+
+def test_fleet_linear_step_shapes_and_determinism():
+    C = 3
+    cfg = _cfg(communities=C)
+    params = params_from_config(cfg)
+    fparams = fleet_params_from_config(cfg, C)
+    c1 = init_fleet_linear(params, fparams, cfg)
+    c2 = init_fleet_linear(params, fparams, cfg)
+    step = jax.jit(lambda c, o: fleet_linear_step(c, o, params, fparams))
+    for k in range(5):
+        c1, r1 = step(c1, _fobs(C, fe=0.1 * k))
+        c2, r2 = step(c2, _fobs(C, fe=0.1 * k))
+    np.testing.assert_array_equal(np.asarray(c1.theta_mu),
+                                  np.asarray(c2.theta_mu))
+    assert np.asarray(c1.theta_mu).shape == (FLEET_STATE_DIM,)
+    assert np.asarray(c1.theta_q).shape == (FLEET_SA_DIM, params.n_q)
+    assert np.asarray(c1.state).shape == (C, FLEET_STATE_SCALARS)
+    assert np.asarray(r1.action).shape == (C,)
+    assert int(c1.t) == 5
+    # The shared replay holds C transitions per step, degenerate t=0
+    # dropped: after 5 steps, 4*C valid entries, slot-dense.
+    assert np.all(np.isfinite(np.asarray(c1.mem_s[:4 * C])))
+    for f in r1:
+        assert np.all(np.isfinite(np.asarray(f)))
+    # Per-community exploration streams DIVERGE (distinct keys): with
+    # identical observations the sampled actions still differ.
+    acts = np.asarray(c1.next_action)
+    assert len(set(np.round(acts, 8).tolist())) == C
+
+
+def test_event_features_reach_the_policy():
+    """Two steps identical except for one community's event features must
+    produce different actions for that community only (the features ride
+    the basis tail into μ)."""
+    C = 2
+    cfg = _cfg(communities=C)
+    params = params_from_config(cfg)
+    fparams = fleet_params_from_config(cfg, C)
+    carry = init_fleet_linear(params, fparams, cfg)
+    # Give the policy a nonzero weight on the event tail.
+    theta = np.zeros(FLEET_STATE_DIM, np.float32)
+    theta[-N_EVENT_FEATURES] = 0.01  # price-shock feature weight
+    carry = carry._replace(theta_mu=jnp.asarray(theta),
+                           t=jnp.asarray(1, jnp.int32))
+    step = jax.jit(lambda c, o: fleet_linear_step(c, o, params, fparams))
+    ev = np.zeros((C, N_EVENT_FEATURES), np.float32)
+    c_a, _ = step(carry, _fobs(C, events=ev))
+    ev2 = ev.copy()
+    ev2[1, 0] = 2.0  # tariff shock on community 1 only
+    c_b, _ = step(carry, _fobs(C, events=ev2))
+    a_a, a_b = np.asarray(c_a.next_action), np.asarray(c_b.next_action)
+    assert a_a[0] == pytest.approx(a_b[0])   # community 0 unchanged
+    assert a_a[1] != pytest.approx(a_b[1])   # community 1 shifted
+
+
+def test_event_feature_table_matches_timeline():
+    from dragg_tpu.scenarios.timeline import empty_timeline
+
+    tl = empty_timeline(2, 12)
+    tl.price[1, 4:8] = 0.04
+    tl.cap[0, 2:6] = 3.0          # DR cap on community 0
+    tl.cap[1, 8:10] = 0.0         # outage on community 1
+    tl.relax[0, 2:6] = 1.0
+    feats = event_feature_table(tl, start_index=0, num_timesteps=10,
+                                window=2, max_rp=0.02)
+    assert feats.shape == (10, 2, N_EVENT_FEATURES)
+    # t=4, community 1: both window steps shocked → 0.04/0.02 = 2.
+    assert feats[4, 1, 0] == pytest.approx(2.0)
+    assert feats[4, 0, 0] == pytest.approx(0.0)
+    # t=2, community 0: cap active, relax 1.0/2.
+    assert feats[2, 0, 1] == pytest.approx(1.0)
+    assert feats[2, 0, 3] == pytest.approx(0.5)
+    # t=8, community 1: outage (cap == 0) — outage fraction, cap-active 0.
+    assert feats[8, 1, 2] == pytest.approx(1.0)
+    assert feats[8, 1, 1] == pytest.approx(0.0)
+    # Event-free cells are exact zeros.
+    assert np.all(feats[0, :, :] == 0.0)
+
+
+# ----------------------------------------------------------- C=1 equivalence
+@pytest.mark.slow  # two full rl_agg runs; the dispatch keeping C=1 on the
+                   # single-community path is structural (run_rl_agg) and
+                   # unit-covered by test_run_shape_carries_rl_fleet_key
+def test_c1_fleet_rl_agg_matches_single_community(tmp_path):
+    """Satellite pin: ``run_rl_agg`` with ``fleet.communities = 1`` is
+    numerically identical to the config without a fleet block (the
+    dispatch keeps C=1 on the unchanged single-community path)."""
+    cfg_fleet = _cfg(communities=1, stride=7, run_rl_agg=True)
+    cfg_plain = _cfg(communities=1, run_rl_agg=True)
+    del cfg_plain["fleet"]
+    _a, res_f = _run(cfg_fleet, tmp_path, "fleet1", "rl_agg")
+    _b, res_p = _run(cfg_plain, tmp_path, "plain", "rl_agg")
+    np.testing.assert_array_equal(res_f["Summary"]["RP"],
+                                  res_p["Summary"]["RP"])
+    np.testing.assert_array_equal(res_f["Summary"]["p_grid_aggregate"],
+                                  res_p["Summary"]["p_grid_aggregate"])
+    for h in (k for k in res_p if k != "Summary"):
+        for series, vals in res_p[h].items():
+            if isinstance(vals, list):
+                assert vals == res_f[h][series], (h, series)
+    assert "fleet_rl" not in res_f["Summary"]
+
+
+# --------------------------------------------------------------- end-to-end
+@pytest.mark.slow  # full C=8 MPC fleet training run; light siblings:
+                   # test_fleet_rl_simplified_c8_and_learning_signal (e2e)
+                   # + test_c1_fleet_rl_agg_matches_single_community (rl_agg)
+def test_fleet_rl_agg_c8_one_pattern_set(tmp_path):
+    """Acceptance: C=8 trains on the 8-device CPU mesh under ONE compiled
+    pattern set — bucket patterns scale with TYPES, never with C — and
+    the run emits per-community reward prices + telemetry."""
+    assert len(jax.devices()) == 8, "conftest pins the 8-device CPU mesh"
+    cfg = _cfg(communities=8, run_rl_agg=True)
+    agg, res = _run(cfg, tmp_path, "c8", "rl_agg")
+    # The 32-home fleet buckets by TYPE (tpu.bucketed auto threshold):
+    # one compiled pattern per home type present (base + pv), never per
+    # community.
+    assert agg.engine.bucketed
+    assert len(agg.engine.bucket_info()) == 2
+    assert agg.engine.n_communities == 8
+    s = res["Summary"]
+    assert s["num_homes"] == 32
+    assert len(s["RP"]) == agg.num_timesteps
+    assert np.all(np.isfinite(s["RP"]))
+    fl = s["fleet_rl"]
+    assert fl["communities"] == 8 and fl["policy"] == "shared"
+    rp_c = np.asarray(fl["RP_by_community"])
+    assert rp_c.shape == (8, agg.num_timesteps)
+    # Exploration streams are per community: the announced prices are
+    # not fleet-identical.
+    assert not np.allclose(rp_c[0], rp_c[1])
+    # Agent telemetry: fleet-mean series, schema-compatible + the
+    # per-community action matrix.
+    with open(os.path.join(agg.run_dir, "rl_agg",
+                           "utility_agent-results.json")) as f:
+        rl = json.load(f)
+    assert len(rl["reward"]) == agg.num_timesteps
+    assert len(rl["action_by_community"][0]) == 8
+    assert rl["parameters"]["fleet"]["communities"] == 8
+
+
+def test_fleet_rl_simplified_c8_and_learning_signal(tmp_path):
+    """C=8 simplified fleet: whole loop on device, per-community
+    trajectories diverge (per-community noise), shared θ updates."""
+    cfg = _cfg(communities=8, run_rl_simplified=True)
+    agg, res = _run(cfg, tmp_path, "simp8", "simplified")
+    s = res["Summary"]
+    assert len(s["p_grid_aggregate"]) == agg.num_timesteps
+    assert np.all(np.isfinite(s["p_grid_aggregate"]))
+    rp_c = np.asarray(s["fleet_rl"]["RP_by_community"])
+    assert rp_c.shape == (8, agg.num_timesteps)
+    assert not np.allclose(rp_c[0], rp_c[1])
+    # The shared policy moved off init (the learner engaged).
+    theta = np.asarray(agg.agent.carry.theta_mu)
+    assert theta.shape == (FLEET_STATE_DIM,)
+    assert np.all(np.isfinite(theta))
+
+
+def test_fleet_rl_per_community_mode():
+    """per_community policy: C independent reference cores vmapped —
+    distinct per-community θ, seeded by the fleet seed ladder (unit leg;
+    the aggregator dispatch is covered by the shared-mode e2e tests)."""
+    from dragg_tpu.rl.basis import STATE_DIM as SD
+    from dragg_tpu.rl.fleet import FleetAgent
+
+    cfg = _cfg(communities=2)
+    cfg["rl"]["fleet"]["policy"] = "per_community"
+    agent = FleetAgent(cfg, 2)
+    assert agent.fparams.policy == "per_community"
+    carry = agent.carry
+    assert np.asarray(carry.theta_mu).shape == (2, SD)
+    # Distinct seeds → distinct critic inits.
+    assert not np.allclose(np.asarray(carry.theta_q)[0],
+                           np.asarray(carry.theta_q)[1])
+    step = jax.jit(agent.scan_step)
+    for k in range(3):
+        carry, rec = step(carry, _fobs(2, fe=0.1 * k))
+    assert np.asarray(rec.action).shape == (2,)
+    assert int(np.asarray(carry.t)[0]) == 3
+    # Independent exploration diverges the community policies.
+    assert not np.allclose(np.asarray(carry.next_action)[0],
+                           np.asarray(carry.next_action)[1])
+    for f in rec:
+        assert np.all(np.isfinite(np.asarray(f)))
+
+
+def test_mpc_gradient_term_changes_policy():
+    """Unit pin of the deterministic actor term: a nonzero drda channel
+    must move the shared θ_μ under gradient="mpc" and be a no-op under
+    "score" — the mechanism itself, without an env in the loop."""
+    C = 2
+    cfg = _cfg(communities=C)
+    params = params_from_config(cfg)
+    fobs0 = _fobs(C)
+    fobs_g = fobs0._replace(drda=jnp.full((C,), 0.5, jnp.float32))
+    outs = {}
+    for grad in ("score", "mpc"):
+        cfg["rl"]["fleet"]["gradient"] = grad
+        fparams = fleet_params_from_config(cfg, C)
+        carry = init_fleet_linear(params, fparams, cfg)
+        # Step past t=0 so the policy update is live, then one step with
+        # the gradient channel populated.
+        carry, _ = fleet_linear_step(carry, fobs0, params, fparams)
+        c_a, _ = fleet_linear_step(carry, fobs_g, params, fparams)
+        c_b, _ = fleet_linear_step(carry, fobs0, params, fparams)
+        outs[grad] = (np.asarray(c_a.theta_mu), np.asarray(c_b.theta_mu))
+    a, b = outs["mpc"]
+    assert not np.allclose(a, b)      # mpc: drda moves the policy
+    a, b = outs["score"]
+    np.testing.assert_array_equal(a, b)  # score: drda is inert
+
+
+@pytest.mark.slow  # two simplified fleet training runs; light sibling:
+                   # test_mpc_gradient_term_changes_policy (the mechanism)
+def test_mpc_gradient_mode_engages(tmp_path):
+    """gradient="mpc" (exact response derivative in the simplified case)
+    must CHANGE the learned policy vs "score" at identical seeds/config,
+    and stay finite — the deterministic actor term is live, not a
+    silent no-op."""
+    outs = {}
+    for grad in ("score", "mpc"):
+        cfg = _cfg(communities=2, run_rl_simplified=True)
+        cfg["rl"]["fleet"]["gradient"] = grad
+        agg, _res = _run(cfg, tmp_path, f"grad_{grad}", "simplified")
+        outs[grad] = np.asarray(agg.agent.carry.theta_mu)
+        assert np.all(np.isfinite(outs[grad]))
+    assert not np.allclose(outs["score"], outs["mpc"])
+
+
+@pytest.mark.slow  # jvp through the full relaxed MPC solve; light sibling:
+                   # test_mpc_gradient_mode_engages (exact linear response)
+def test_mpc_gradient_through_relaxed_solve(tmp_path):
+    """The rl_agg mpc path: one forward-mode jvp through the reluqp
+    family's branch-free relaxed solve per step — runs end-to-end and
+    produces finite prices + a policy distinct from score mode."""
+    outs = {}
+    for grad in ("score", "mpc"):
+        cfg = _cfg(communities=2, run_rl_agg=True)
+        cfg["home"]["hems"]["solver"] = "reluqp"
+        cfg["rl"]["fleet"]["gradient"] = grad
+        agg, res = _run(cfg, tmp_path, f"agg_grad_{grad}", "rl_agg")
+        assert np.all(np.isfinite(res["Summary"]["RP"]))
+        outs[grad] = np.asarray(agg.agent.carry.theta_mu)
+    assert not np.allclose(outs["score"], outs["mpc"])
+
+
+@pytest.mark.slow  # separate engine compile; light siblings:
+                   # test_event_features_reach_the_policy + the table unit
+def test_fleet_rl_agg_event_timeline_heterogeneous(tmp_path):
+    """A tariff shock scheduled on ONE community reaches the shared
+    policy's observation (round-13 timeline → event features) and the
+    engine's per-community prices — heterogeneous schedules under one
+    compiled pattern set."""
+    cfg = _cfg(communities=2, run_rl_agg=True)
+    cfg["tpu"]["fix_tou_peak"] = True
+    cfg["scenarios"]["events"] = [dict(
+        kind="tariff_shock", start_hour=1, duration_hours=3,
+        price_delta=0.05, communities=[1])]
+    agg, res = _run(cfg, tmp_path, "evt", "rl_agg")
+    s = res["Summary"]
+    assert np.all(np.isfinite(s["RP"]))
+    rp_c = np.asarray(s["fleet_rl"]["RP_by_community"])
+    assert not np.allclose(rp_c[0], rp_c[1])
+
+
+def test_fleet_agent_carry_checkpoint_roundtrip(tmp_path):
+    """The batched agent carries (shared linear θ/replay/keys and the
+    DDPG nested Flax/Adam pytrees) survive the structure-agnostic pytree
+    checkpoint — the light sibling of the aggregator-level resume legs
+    below / in tests/test_checkpoint.py."""
+    from dragg_tpu.checkpoint import load_pytree, save_pytree
+    from dragg_tpu.rl import neural
+    from dragg_tpu.rl.fleet import init_fleet_ddpg
+
+    C = 2
+    cfg = _cfg(communities=C)
+    params = params_from_config(cfg)
+    fparams = fleet_params_from_config(cfg, C)
+    lin = init_fleet_linear(params, fparams, cfg)
+    cfg_d = _cfg(communities=C)
+    cfg_d["rl"]["parameters"]["agent"] = "ddpg"
+    ddpg = init_fleet_ddpg(neural.params_from_config(cfg_d),
+                           fleet_params_from_config(cfg_d, C), cfg_d)
+    for name, carry in (("linear", lin), ("ddpg", ddpg)):
+        path = os.path.join(str(tmp_path), f"{name}.npz")
+        save_pytree(path, carry)
+        # The template only supplies structure/shapes — the carry itself
+        # serves (load_pytree validates leaf count + shapes against it).
+        restored = load_pytree(path, carry)
+        for a, b in zip(jax.tree.leaves(carry), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow  # two aggregator runs; light siblings:
+                   # test_fleet_agent_carry_checkpoint_roundtrip (carry) +
+                   # tests/test_checkpoint.py bit-exact fleet resume (full)
+def test_fleet_rl_checkpoint_stop_resume_light(tmp_path):
+    """A fleet RL run stopped at its first checkpoint resumes from it
+    and completes (the bit-exact 3-run leg lives in
+    tests/test_checkpoint.py ``test_fleet_rl_agg_resume_bit_exact``)."""
+    from dragg_tpu.aggregator import Aggregator
+
+    cfg = _cfg(communities=2, run_rl_agg=True,
+               end_datetime="2015-01-01 03", resume=True,
+               checkpoint_interval="hourly")
+    out = str(tmp_path / "resumed")
+    part = Aggregator(cfg, data_dir="", outputs_dir=out)
+    part.stop_after_chunks = 1
+    part.run()
+    assert part.timestep == 1 and part.timestep < part.num_timesteps
+    res = Aggregator(_cfg(communities=2, run_rl_agg=True,
+                          end_datetime="2015-01-01 03", resume=True,
+                          checkpoint_interval="hourly"),
+                     data_dir="", outputs_dir=out)
+    res.run()
+    assert res.resumed_from is not None
+    assert res.timestep == res.num_timesteps
+    with open(os.path.join(res.run_dir, "rl_agg", "results.json")) as f:
+        s = json.load(f)["Summary"]
+    assert len(s["RP"]) == res.num_timesteps
+    assert np.asarray(s["fleet_rl"]["RP_by_community"]).shape == \
+        (2, res.num_timesteps)

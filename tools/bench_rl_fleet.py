@@ -1,0 +1,176 @@
+"""Fleet RL training throughput — the ROADMAP item 1 measurement tool.
+
+Runs one vectorized fleet RL case (dragg_tpu/rl/fleet) end-to-end and
+prints ONE JSON line: home-steps/s and learner-steps/s at the configured
+(C communities × B homes) scale, plus the ``rl`` series key bench_trend
+gates on (RL rows never compare against MPC-baseline history — the same
+hard-key convention as solver/semantics/communities/mix).
+
+Two timed passes: the first pays the trace+compile (reported as
+``cold_s``), the second rides the persistent XLA compile cache and
+reports the warm training rate (the headline).
+
+Supervised (round 6): the measurement runs in a CHILD process under the
+resilience supervisor — hard deadline, optional heartbeat stall — so a
+hung device chunk kills the child instead of wedging this process.
+
+Usage: python tools/bench_rl_fleet.py [--homes 64] [--communities 8]
+                                      [--hours 24] [--case rl_agg]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--homes", type=int, default=64,
+                    help="homes PER COMMUNITY (fleet total = homes × "
+                         "--communities)")
+    ap.add_argument("--communities", type=int, default=8,
+                    help="fleet size C — parallel RL rollout streams "
+                         "under one compiled pattern set")
+    ap.add_argument("--hours", type=int, default=24,
+                    help="simulated hours (= learner steps at dt=1)")
+    ap.add_argument("--horizon-hours", type=int, default=6)
+    ap.add_argument("--case", choices=["rl_agg", "simplified"],
+                    default="rl_agg")
+    ap.add_argument("--agent", choices=["linear", "ddpg"], default="linear")
+    ap.add_argument("--policy", choices=["shared", "per_community"],
+                    default="shared")
+    ap.add_argument("--gradient", choices=["score", "mpc"], default="score")
+    ap.add_argument("--solver", choices=["admm", "ipm", "reluqp"],
+                    default="ipm")
+    ap.add_argument("--deadline", type=float, default=1800.0,
+                    help="hard wall-clock limit for the supervised "
+                         "measurement child")
+    ap.add_argument("--stall", type=float, default=0.0,
+                    help="heartbeat-stall kill (0 = disabled; set ~900 "
+                         "on-chip where a stall means a wedge-risk hang)")
+    ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if not args._child:
+        # Supervised parent: jax-free, un-wedgeable (validate_scale.py
+        # pattern).  The child is this same script; its one JSON line is
+        # forwarded verbatim.
+        from dragg_tpu.resilience.supervisor import (assert_parent_has_no_jax,
+                                                     run_supervised)
+
+        assert_parent_has_no_jax()
+        res = run_supervised(
+            [sys.executable, os.path.abspath(__file__), "--_child",
+             *sys.argv[1:]],
+            args.deadline, label="bench_rl_fleet",
+            stall_s=args.stall or None,
+            log=lambda m: print(f"[supervise] {m}", file=sys.stderr,
+                                flush=True))
+        sys.stderr.write(res.stderr_tail)
+        if res.json is not None:
+            print(json.dumps(res.json))
+        elif not res.ok:
+            print(json.dumps({"ok": False, "failure": res.failure,
+                              "rc": res.rc,
+                              "elapsed_s": round(res.elapsed_s, 1)}))
+        sys.exit(res.rc if res.rc is not None and res.rc >= 0 else 1)
+
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from dragg_tpu.aggregator import Aggregator
+    from dragg_tpu.config import default_config
+    from dragg_tpu.resilience.heartbeat import beat
+
+    def build_cfg():
+        cfg = default_config()
+        n = args.homes
+        cfg["community"]["total_number_homes"] = n
+        cfg["community"]["homes_pv"] = int(0.4 * n)
+        cfg["community"]["homes_battery"] = int(0.1 * n)
+        cfg["community"]["homes_pv_battery"] = int(0.1 * n)
+        cfg["fleet"]["communities"] = args.communities
+        cfg["home"]["hems"]["prediction_horizon"] = args.horizon_hours
+        cfg["home"]["hems"]["solver"] = args.solver
+        cfg["simulation"]["start_datetime"] = "2015-01-01 00"
+        end_day = 1 + args.hours // 24
+        end_h = args.hours % 24
+        cfg["simulation"]["end_datetime"] = \
+            f"2015-01-{end_day:02d} {end_h:02d}"
+        cfg["simulation"]["run_rbo_mpc"] = False
+        cfg["simulation"][f"run_{args.case}" if args.case == "rl_agg"
+                          else "run_rl_simplified"] = True
+        cfg["rl"]["parameters"]["agent"] = args.agent
+        cfg["rl"]["fleet"]["policy"] = args.policy
+        cfg["rl"]["fleet"]["gradient"] = args.gradient
+        cfg["telemetry"]["enabled"] = False
+        return cfg
+
+    case_dir = "rl_agg" if args.case == "rl_agg" else "simplified"
+    times = []
+    agg = None
+    for attempt in range(2):
+        beat({"stage": f"pass{attempt}", "case": args.case})
+        with tempfile.TemporaryDirectory() as td:
+            agg = Aggregator(build_cfg(), data_dir="", outputs_dir=td)
+            t0 = time.perf_counter()
+            agg.run()
+            times.append(time.perf_counter() - t0)
+        beat({"stage": f"pass{attempt}_done",
+              "elapsed_s": round(times[-1], 1)})
+
+    T = agg.num_timesteps
+    C = args.communities
+    n_total = args.homes * C
+    warm_s = times[-1]
+    rl_label = f"{args.policy}_{args.agent}" + (
+        "" if args.gradient == "score" else f"_{args.gradient}")
+    result = {
+        # ``rl`` is a HARD bench_trend series key (tools/bench_trend.py):
+        # RL training rows form their own comparison series and never
+        # gate against the MPC-baseline ("none") history.
+        "rl": rl_label,
+        "case": case_dir,
+        "homes": args.homes,
+        "communities": C,
+        "homes_total": n_total,
+        "steps": T,
+        "agent": args.agent,
+        "policy": args.policy,
+        "gradient": args.gradient,
+        "solver": args.solver if args.case == "rl_agg" else "none",
+        "semantics": "integer" if args.case == "rl_agg" else "n/a",
+        "mix": "legacy",
+        "precision": "f32",
+        "platform": jax.devices()[0].platform,  # device-call-ok: supervised child
+        "n_devices": len(jax.devices()),  # device-call-ok: supervised child
+        "cold_s": round(times[0], 2),
+        "warm_s": round(warm_s, 2),
+        # Home-steps/s: fleet total homes × sim steps per warm second —
+        # comparable with the MPC engine's scale metric.
+        "home_steps_per_s": round(n_total * T / warm_s, 1),
+        # Learner-steps/s: fused policy updates per warm second (shared
+        # mode runs ONE batched learner update per fleet step).
+        "learner_steps_per_s": round(T / warm_s, 2),
+        # Agent-env interactions per second across the fleet (C rollout
+        # streams advance per learner step).
+        "agent_steps_per_s": round(C * T / warm_s, 1),
+        # rl_agg advances agg.timestep chunk by chunk; the simplified
+        # case is summary-only (timestep stays 0) — its completion
+        # signal is the full aggregate series.
+        "ok": bool(np.isfinite(warm_s)
+                   and (agg.timestep == T if args.case == "rl_agg"
+                        else len(agg.baseline_agg_load_list) == T)),
+    }
+    print(json.dumps(result))
+    sys.exit(0 if result["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,7 +51,7 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 HARD_KEY = ("metric", "platform", "solver", "semantics", "data",
-            "communities", "mix", "precision")
+            "communities", "mix", "precision", "rl")
 
 
 def _round_ordinal(path: str, fallback: int) -> int:
@@ -119,7 +119,7 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         return dict(source=source, ordinal=ordinal,
                     metric="metrics_snapshot", platform="?", solver="?",
                     semantics="?", data="?", communities=1, mix="?",
-                    precision="?",
+                    precision="?", rl="none",
                     bucketed=False,
                     fallback=False, degraded=None,
                     value=float(gauges.get("bench.rate_ts_per_s", 0.0)),
@@ -154,6 +154,13 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         # against f32 artifacts.  Era default: every pre-field artifact
         # ran full f32.
         precision=str(rec.get("precision", "f32")),
+        # RL training rows are a HARD key (ROADMAP item 1): an RL fleet
+        # training rate (tools/bench_rl_fleet.py — fused agent update +
+        # MPC solve per step) is a different workload than the MPC
+        # baseline at the same shape, so "rl" rows form their own series
+        # and never gate against MPC-baseline history.  Era default:
+        # every pre-field artifact measured the baseline ("none").
+        rl=str(rec.get("rl", "none")),
         bucketed=bool(rec.get("bucketed", False)),
         fallback=bool(rec.get("fallback", False)),
         degraded=rec.get("degraded"),
@@ -278,8 +285,9 @@ def print_table(trend: dict, out=sys.stderr) -> None:
         mix = (f"/{k['mix']}" if k.get("mix", "legacy") != "legacy" else "")
         prec = (f"/{k['precision']}"
                 if k.get("precision", "f32") != "f32" else "")
+        rl = (f"/rl:{k['rl']}" if k.get("rl", "none") != "none" else "")
         print(f"  {k['metric']} [{k['platform']}/{k['solver']}/"
-              f"{k['semantics']}/{k['data']}{fleet}{mix}{prec}] "
+              f"{k['semantics']}/{k['data']}{fleet}{mix}{prec}{rl}] "
               f"{r['from_source']} → {r['to_source']}", file=out)
         print(f"    rate  {r['rate'][0]:.3f} → {r['rate'][1]:.3f} "
               f"({_fmt_pct(r['rate_delta'])}) {r['rate_verdict']}",

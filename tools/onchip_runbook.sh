@@ -8,6 +8,11 @@
 # and a jax-free parent that cannot be wedged.  This wrapper only
 # preserves the historical entry point.
 #
+# Round 15 adds the fleet-RL training smoke (rl_fleet_smoke_8x64 —
+# tools/bench_rl_fleet.py): the first on-chip home-steps/s +
+# learner-steps/s for the vectorized RL workload, probe-gated like every
+# other stage.
+#
 #   bash tools/onchip_runbook.sh [outdir]
 set -u
 cd "$(dirname "$0")/.."

@@ -179,6 +179,17 @@ def stages(out: str) -> list[dict]:
              argv=[PY, "tools/validate_scale.py", "--homes", "10000",
                    "--horizon-hours", "48", "--days", "2",
                    "--solver", "ipm"]),
+        # 8. Fleet RL training smoke (ROADMAP item 1): C=8 communities
+        #    of 64 homes, shared IMPALA-style policy, one fused jitted
+        #    step — first on-chip home-steps/s + learner-steps/s for the
+        #    RL workload (its own bench_trend series: rl is a hard key).
+        #    bench_rl_fleet supervises its own measurement child
+        #    (deadline + stall beat), probe-gated here like every stage.
+        dict(name="rl_fleet_smoke_8x64", timeout=1200,
+             argv=[PY, "tools/bench_rl_fleet.py", "--homes", "64",
+                   "--communities", "8", "--hours", "24",
+                   "--horizon-hours", "6", "--deadline", "900",
+                   "--stall", "300"]),
     ]
 
 
