@@ -292,9 +292,40 @@ _DEFAULT: dict[str, Any] = {
                                # warm compiled engine child)
         "queue_max": 256,      # pending+assigned cap; beyond it POST
                                # /solve answers 429 + Retry-After
-        "batch_max": 0,        # requests per dispatched batch (0 = the
+        "batch_max": 0,        # requests per coalesced group (0 = the
                                # serving community size — the compiled
-                               # engine's batch shape)
+                               # engine's per-slot batch shape)
+        "fleet_slots": 1,      # community slots C per worker engine: the
+                               # worker compiles a C-community fleet of
+                               # IDENTICAL copies of the serving community
+                               # (seed_stride 0), so one warm solve
+                               # coalesces up to C request groups (round
+                               # 12: compile flat in C).  1 = the round-11
+                               # single-shape engine, byte-identical
+        "batch_window_ms": 25.0,  # latency-aware coalescing window: a
+                                  # dispatchable group waits up to this
+                                  # long for more same-timestep groups to
+                                  # arrive before the batch goes out;
+                                  # dispatch fires early the moment all C
+                                  # slots fill (granularity = poll_s)
+        "max_streams": 32,     # concurrent /result?stream=1 consumers;
+                               # each stream pins an HTTP thread + an
+                               # events-tail follower for up to its
+                               # whole budget, so past the cap streams
+                               # answer 429 + Retry-After (poll /result
+                               # instead)
+        "max_steps": 96,       # cap on a request's multi-chunk `steps`
+                               # (each step re-runs the warm compiled
+                               # one-step program; incremental results
+                               # stream over /result?stream=1)
+        "patterns": [],        # extra pattern lanes warmed at boot — each
+                               # entry {name, horizon_hours?, homes?,
+                               # fleet_slots?, workers?} compiles its own
+                               # bucket-pattern signature (serve/patterns)
+        "spill_patterns": 1,   # bounded compile-on-demand lanes for
+                               # requests carrying an inline pattern spec
+                               # no existing lane serves; beyond it such
+                               # requests answer 429 (pattern_capacity)
         "request_deadline_s": 120.0,  # default per-request deadline;
                                       # expired-unserved requests fail
                                       # (a request's own deadline_s wins)

@@ -1,14 +1,18 @@
-"""Fault-tolerant MPC serving daemon (ISSUE 7 / ROADMAP open item 2).
+"""Fault-tolerant, fleet-backed MPC serving (ISSUE 7 + ISSUE 13 /
+ROADMAP items 2-3).
 
 ``python -m dragg_tpu serve`` — a long-lived service whose jax-free
-parent owns a crash-safe fsync'd request journal, a supervised worker
-pool holding the compiled engine warm, probe-gated admission with
-checkpointed TPU→CPU degradation, and an HTTP surface
-(/solve /result /healthz /readyz /metrics.json).  See
-:mod:`dragg_tpu.serve.daemon` for the architecture and
-``docs/serving.md`` for operator documentation.
+parent owns a crash-safe fsync'd request journal, pattern-routed
+supervised worker lanes holding warm compiled FLEET engines (C community
+slots per worker — one warm solve coalesces up to C request groups),
+probe-gated admission with checkpointed TPU→CPU degradation, streaming
+multi-chunk results, and an HTTP surface (/solve /result /healthz
+/readyz /metrics.json).  See :mod:`dragg_tpu.serve.daemon` for the
+architecture and ``docs/serving.md`` for operator documentation +
+capacity planning.
 """
 
-from dragg_tpu.serve.daemon import ServeDaemon, run_serve, serve_config
+from dragg_tpu.serve.daemon import (PatternLane, ServeDaemon, run_serve,
+                                    serve_config)
 
-__all__ = ["ServeDaemon", "run_serve", "serve_config"]
+__all__ = ["PatternLane", "ServeDaemon", "run_serve", "serve_config"]

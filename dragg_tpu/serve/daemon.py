@@ -1,6 +1,6 @@
 """Fault-tolerant MPC serving daemon — the jax-free parent process.
 
-``python -m dragg_tpu serve`` keeps a compiled MPC engine warm behind an
+``python -m dragg_tpu serve`` keeps warm compiled MPC engines behind an
 HTTP surface and survives every failure kind in the resilience taxonomy
 without losing a request.  The reference's lifetime model — one
 pathos+Redis aggregator whose queue dies with the process
@@ -12,10 +12,30 @@ pathos+Redis aggregator whose queue dies with the process
   duplicates without re-solving — zero lost, zero double-answered, by
   construction;
 * **supervised worker pool** (serve/pool.py + serve/worker.py): workers
-  hold the compiled engine warm (persistent compile cache + staged
-  compile telemetry), are stall-killed on hung compiles (round-4 wedge
+  hold compiled engines warm (persistent compile cache + staged compile
+  telemetry), are stall-killed on hung compiles (round-4 wedge
   prevention) and batch deadlines, and every death is classified with
   the taxonomy and retried with probe-gated backoff;
+* **fleet-backed coalescing** (ISSUE 13): with ``serve.fleet_slots = C``
+  each worker's engine is a C-slot FLEET of identical copies of the
+  serving community (round 12: compile flat in C), and the dispatch
+  loop coalesces queued requests into fleet batches under a
+  latency-aware window (``serve.batch_window_ms`` — dispatch fires
+  early the moment all C community slots fill).  One warm solve serves
+  up to C request groups, each with its own reward price through the
+  engine's per-community rp path; results map back per request via
+  ``engine.real_home_cols``;
+* **multi-pattern admission** (serve/patterns.py): requests route to
+  worker lanes by bucket-pattern signature (home-type mix × horizon ×
+  fleet slots).  ``serve.patterns`` lanes warm at boot; an inline
+  request spec for an unseen signature spills to a bounded
+  compile-on-demand lane (``serve.spill_patterns``), its generation
+  provenance journaled so a restart can rebuild it;
+* **streaming** — a multi-chunk request (``steps = N``) streams
+  incremental per-chunk results over the existing events.jsonl tail:
+  ``GET /result?id=…&stream=1`` answers newline-delimited JSON, one
+  line per solved chunk plus the terminal record, so first-chunk
+  latency decouples from run length;
 * **probe-gated admission + degradation**: a dead/wedged tunnel flips
   the service to degraded-CPU serving (transition journaled, provenance
   attached to every response answered while degraded) instead of
@@ -24,14 +44,15 @@ pathos+Redis aggregator whose queue dies with the process
   probe goes green;
 * **bounded everything**: per-request deadlines, bounded retry
   (``serve.request_retries``), queue backpressure (429 + Retry-After),
-  graceful SIGTERM drain (in-flight work finishes; the journal carries
-  whatever didn't).
+  bounded spill-lane compiles, graceful SIGTERM drain (in-flight work
+  finishes; the journal carries whatever didn't).
 
 HTTP endpoints (the dashboard's stdlib ``http.server`` idiom — its
 ``/live`` + ``/metrics.json`` surface, extended with serving state):
 
     POST /solve          accept one request (or a JSON list) -> 202/200/429/503
     GET  /result?id=...  poll one request's outcome
+    GET  /result?id=...&stream=1   NDJSON chunk stream + terminal record
     GET  /healthz        process liveness (always 200 while serving)
     GET  /readyz         200 only when a warm worker can take traffic
     GET  /metrics.json   telemetry snapshot + serving counters
@@ -41,13 +62,17 @@ Request schema (POST /solve body)::
 
     {"id": "r1", "t": 0, "home": 3, "rp": 0.0,
      "state": {"temp_in": 20.5, "temp_wh": 46.0, "e_batt": 2.0},
-     "deadline_s": 60}
+     "deadline_s": 60, "steps": 1, "pattern": "default"}
 
 ``id`` is the idempotency key (generated when absent); ``home`` indexes
-the serving community; ``state`` scalars override that home's carried
-initial conditions.  The response carries the home's first MPC action
-(duty fractions, p_grid, cost, solve verdict) plus provenance
-(platform, retries, degradation record when the service degraded).
+the serving community (whichever fleet slot the request lands in);
+``state`` scalars override that home's carried initial conditions;
+``steps`` > 1 makes the request multi-chunk (streamable); ``pattern``
+names a lane, or carries an inline spec (serve/patterns.py).  The
+response carries the home's MPC action at the final step (duty
+fractions, p_grid, cost, solve verdict), the community slot it was
+coalesced into, plus provenance (platform, retries, degradation record
+when the service degraded).
 """
 
 from __future__ import annotations
@@ -64,6 +89,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from dragg_tpu import telemetry
 from dragg_tpu.resilience import liveness
 from dragg_tpu.serve import journal as journal_mod
+from dragg_tpu.serve import patterns as patterns_mod
 from dragg_tpu.serve import spool
 from dragg_tpu.serve.pool import WorkerSlot
 
@@ -82,10 +108,35 @@ def serve_config(config: dict | None) -> dict:
     return merged
 
 
-class ServeDaemon:
-    """One serving deployment: journal + worker pool + HTTP surface.
+class PatternLane:
+    """One bucket-pattern signature's worker lane: the derived engine
+    config its workers build, the admission geometry (community size,
+    fleet slots, per-group cap), and the worker slots serving it."""
 
-    Programmatic use (tests, the soak)::
+    def __init__(self, name: str, signature: str, spec: dict, source: str,
+                 cfg_path: str | None, n_homes: int, fleet_slots: int,
+                 batch_max: int):
+        self.name = name
+        self.signature = signature
+        self.spec = spec
+        self.source = source  # "config" | "spill" | "replay"
+        self.cfg_path = cfg_path
+        self.n_homes = n_homes
+        self.fleet_slots = max(1, fleet_slots)
+        self.batch_max = max(1, batch_max)
+        self.slots: list[WorkerSlot] = []
+
+    def describe(self) -> dict:
+        return {"signature": self.signature, "source": self.source,
+                "workers": [s.slot for s in self.slots],
+                "n_homes": self.n_homes, "fleet_slots": self.fleet_slots}
+
+
+class ServeDaemon:
+    """One serving deployment: journal + pattern lanes + worker pool +
+    HTTP surface.
+
+    Programmatic use (tests, the soak, the load harness)::
 
         d = ServeDaemon(config, serve_dir, platform="cpu")
         d.start()              # HTTP + dispatch threads; d.port bound
@@ -137,37 +188,80 @@ class ServeDaemon:
         self.results: dict[str, dict] = dict(
             list(rep.terminal.items())[-self._results_cap:])
         self.transition: dict | None = rep.transition
-        now = time.monotonic()
-        for rid, rec in rep.pending.items():
-            req = rec.get("req") or {}
-            self.pending[rid] = self._entry(rid, req, now, replayed=True)
-        if rep.pending or rep.dropped_lines:
-            telemetry.emit("serve.replay", requeued=len(rep.pending),
-                           terminal=len(rep.terminal),
-                           dropped_lines=rep.dropped_lines)
-            self.log(f"journal replay: {len(rep.pending)} requeued, "
-                     f"{len(rep.terminal)} terminal, "
-                     f"{rep.dropped_lines} torn/dropped lines")
-
-        # ----- worker pool
-        self._cfg_path = None
-        if not stub:
-            fd, self._cfg_path = tempfile.mkstemp(prefix="dragg_serve_",
-                                                  suffix=".json",
-                                                  dir=serve_dir)
-            with os.fdopen(fd, "w") as f:
-                json.dump(self.config, f)
-        # Claim the spool: orphan workers of a predecessor daemon exit
-        # when the EPOCH token stops matching theirs (worker fencing).
-        self.epoch = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
-        spool.write_epoch(self.spool_dir, self.epoch)
-        self.slots = [WorkerSlot(self.spool_dir, i, cfg_path=self._cfg_path,
-                                 stub=stub, poll_s=float(self.scfg["poll_s"]),
-                                 epoch=self.epoch, log=self.log)
-                      for i in range(max(1, int(self.scfg["workers"])))]
         self.in_flight: dict[int, dict] = {}  # slot -> batch record
         self._kill_ctx: dict[int, dict] = {}  # slot -> how the daemon killed it
         self.batch_seq = 0
+        self.draining = False
+        self._active_streams = 0  # /result?stream=1 consumers (bounded
+                                  # by serve.max_streams — each holds an
+                                  # HTTP thread + events-tail follower)
+
+        # ----- worker pool: pattern lanes (serve/patterns.py)
+        # Claim the spool BEFORE slot construction: orphan workers of a
+        # predecessor daemon exit when the EPOCH token stops matching
+        # theirs (worker fencing).
+        self.epoch = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        spool.write_epoch(self.spool_dir, self.epoch)
+        self.slots: list[WorkerSlot] = []
+        self.lanes: dict[str, PatternLane] = {}
+        self._sig_to_lane: dict[str, str] = {}
+        self._digest_to_lane: dict[str, str] = {}
+        default_spec = patterns_mod.normalize_spec({}, self.scfg)
+        self._add_lane("default", default_spec, "config",
+                       workers=max(1, int(self.scfg["workers"])),
+                       journal=False)
+        for entry in self.scfg["patterns"]:
+            # A malformed configured pattern is a boot error, loudly —
+            # never a 400 some future request trips over.
+            spec = patterns_mod.normalize_spec(entry, self.scfg)
+            name = spec.get("name")
+            if not name or name in self.lanes:
+                raise ValueError(
+                    f"serve.patterns entries need unique names "
+                    f"(got {name!r})")
+            self._add_lane(name, spec, "config",
+                           workers=spec.get("workers", 1), journal=False)
+        self.n_homes = self.lanes["default"].n_homes
+        self.batch_max = self.lanes["default"].batch_max
+
+        # ----- requeue replayed pending requests (lanes must exist first;
+        # spill lanes rebuild from their journaled provenance records)
+        self._replay_patterns = rep.patterns
+        now = time.monotonic()
+        for rid, rec in rep.pending.items():
+            req = rec.get("req") or {}
+            entry = self._entry(rid, req, now, replayed=True)
+            lane = self._replay_lane(req)
+            if lane is None:
+                self._fail(entry, "pattern lane unknown at replay (no "
+                                  "journaled provenance)")
+                continue
+            entry["lane"] = lane
+            # Replay-side range check mirrors accept(): a journal from a
+            # shrunk community (or a hand-edited record) must fail
+            # terminally here, never reach a worker — an out-of-range
+            # home KeyErrors the engine child and takes every coalesced
+            # batch-mate's attempt down with it.
+            try:
+                home_ok = 0 <= int(req.get("home", 0)) \
+                    < self.lanes[lane].n_homes
+            except (TypeError, ValueError):
+                home_ok = False
+            if not home_ok:
+                self._fail(entry,
+                           f"replayed home {req.get('home')!r} outside "
+                           f"lane {lane!r} community "
+                           f"[0, {self.lanes[lane].n_homes})")
+                continue
+            self.pending[rid] = entry
+        if rep.pending or rep.dropped_lines:
+            telemetry.emit("serve.replay", requeued=len(self.pending),
+                           terminal=len(rep.terminal),
+                           dropped_lines=rep.dropped_lines)
+            self.log(f"journal replay: {len(self.pending)} requeued, "
+                     f"{len(rep.terminal)} terminal, "
+                     f"{rep.dropped_lines} torn/dropped lines")
+
         # Resolved serving platform.  None = a probe verdict is owed —
         # launches park until the dispatch loop's UNLOCKED probe phase
         # applies one (the probe can block up to probe_timeout_s; it must
@@ -177,15 +271,149 @@ class ServeDaemon:
         self.backoff_until = 0.0
         self.consec_failures = 0
         self.started_at = time.monotonic()
-        self.draining = False
         self.stop_event = threading.Event()
         self._threads: list[threading.Thread] = []
         self._httpd = None
         self.host = host or str(self.scfg["host"])
         self.port = port if port is not None else int(self.scfg["port"])
-        n = int(self.config["community"]["total_number_homes"])
-        self.n_homes = n if not stub else max(n, 1)
-        self.batch_max = int(self.scfg["batch_max"]) or self.n_homes
+
+    # --------------------------------------------------------------- lanes
+    def _add_lane(self, name: str, spec: dict, source: str, *,
+                  workers: int | None = None, journal: bool = True,
+                  cfg: dict | None = None,
+                  signature: str | None = None) -> PatternLane:
+        """Create one pattern lane + its worker slots (caller holds the
+        lock, or is the constructor).  ``journal=True`` records the
+        generation provenance (spill lanes — a restart must be able to
+        rebuild the lane its replayed requests name)."""
+        if cfg is None:
+            cfg = patterns_mod.lane_config(self.config, spec)
+        if signature is None:
+            signature = patterns_mod.signature(cfg)
+        n_homes = patterns_mod.community_size(cfg)
+        cfg_path = None
+        if not self.stub:
+            fd, cfg_path = tempfile.mkstemp(prefix=f"dragg_serve_{name}_",
+                                            suffix=".json",
+                                            dir=self.serve_dir)
+            with os.fdopen(fd, "w") as f:
+                json.dump(cfg, f)
+        lane = PatternLane(
+            name, signature, spec, source, cfg_path, n_homes,
+            int(spec.get("fleet_slots", 1)),
+            int(self.scfg["batch_max"]) or n_homes)
+        for _ in range(max(1, int(workers or spec.get("workers", 1)))):
+            slot = WorkerSlot(self.spool_dir, len(self.slots),
+                              cfg_path=cfg_path, stub=self.stub,
+                              poll_s=float(self.scfg["poll_s"]),
+                              epoch=self.epoch, log=self.log, pattern=name)
+            self.slots.append(slot)
+            lane.slots.append(slot)
+        self.lanes[name] = lane
+        self._sig_to_lane[signature] = name
+        if journal:
+            self.journal.pattern(name, signature, spec, source)
+        telemetry.emit("serve.pattern", name=name, signature=signature,
+                       source=source, workers=len(lane.slots),
+                       fleet_slots=lane.fleet_slots)
+        telemetry.set_gauge("serve.patterns_active", len(self.lanes))
+        if source == "spill":
+            telemetry.inc("serve.spill_lanes", 1)
+        self.log(f"pattern lane {name!r} [{signature}] source={source} "
+                 f"workers={len(lane.slots)} C={lane.fleet_slots}")
+        return lane
+
+    def _replay_lane(self, req: dict) -> str | None:
+        """Resolve a replayed request's lane, rebuilding a journaled
+        spill lane when needed; None = unroutable (fails terminally)."""
+        name = req.get("pattern") or "default"
+        if not isinstance(name, str):
+            return None
+        if name in self.lanes:
+            return name
+        rec = self._replay_patterns.get(name)
+        if rec is None:
+            return None
+        try:
+            spec = patterns_mod.normalize_spec(rec.get("spec") or {},
+                                               self.scfg)
+            self._add_lane(name, spec, "replay", journal=False)
+            return name
+        except (patterns_mod.PatternError, ValueError, OSError):
+            return None
+
+    def _resolve_lane(self, req: dict):
+        """Admission-time routing: (lane_name, None) or
+        (None, (status, body)).  Inline specs for an unseen signature
+        spill to a bounded compile-on-demand lane, provenance journaled
+        BEFORE the request that caused it can be accepted."""
+        pat = req.get("pattern")
+        if pat is None or pat == "default":
+            return "default", None
+        if isinstance(pat, str):
+            if pat in self.lanes:
+                return pat, None
+            return None, (400, {"error": f"unknown pattern lane {pat!r} "
+                                         f"(lanes: {sorted(self.lanes)}); "
+                                         f"pass an inline spec to spill"})
+        try:
+            spec = patterns_mod.normalize_spec(pat, self.scfg, inline=True)
+        except patterns_mod.PatternError as e:
+            return None, (400, {"error": str(e)})
+        # Fast path for the steady state (a client stream that routinely
+        # repeats the same inline spec): a known spec digest routes
+        # without re-deriving lane config / signature — those cost a
+        # full-config deepcopy + scenario expansion under the daemon
+        # lock.
+        digest = patterns_mod.spec_digest(spec)
+        name = self._digest_to_lane.get(digest)
+        if name is not None and name in self.lanes:
+            return name, None
+        cfg = patterns_mod.lane_config(self.config, spec)
+        exp = patterns_mod.expanded(cfg)
+        sig = patterns_mod.signature(exp, pre_expanded=True)
+        name = self._sig_to_lane.get(sig)
+        if name is not None:
+            self._cache_digest(digest, name)
+            return name, None
+        # Reject an unroutable request BEFORE spending the bounded spill
+        # budget on its lane (community_size is pure config math — a
+        # 400-doomed request must never trigger a compile).
+        n_homes = patterns_mod.community_size(exp, pre_expanded=True)
+        if not 0 <= int(req.get("home", 0)) < n_homes:
+            return None, (400, {"error": f"home {req.get('home')} outside "
+                                         f"the serving community "
+                                         f"[0, {n_homes}) of the inline "
+                                         f"pattern spec"})
+        n_spill = sum(1 for ln in self.lanes.values()
+                      if ln.source in ("spill", "replay"))
+        if n_spill >= int(self.scfg["spill_patterns"]):
+            retry = float(self.scfg["retry_after_s"])
+            telemetry.inc("serve.requests_rejected", 1)
+            telemetry.emit("serve.reject", id=req.get("id"),
+                           reason="pattern_capacity", retry_after_s=retry)
+            return None, (429, {"error": "compile-on-demand pattern "
+                                         "capacity exhausted "
+                                         "(serve.spill_patterns)",
+                                "retry_after_s": retry})
+        name = spec.get("name") or f"spill{n_spill + 1}"
+        if name in self.lanes:  # name collision with a different signature
+            base, k = name, len(self.lanes)
+            while f"{base}-{k}" in self.lanes:  # the suffix itself may
+                k += 1                          # collide with a client-
+            name = f"{base}-{k}"                # chosen lane name
+        self._add_lane(name, spec, "spill", cfg=cfg, signature=sig)
+        self._cache_digest(digest, name)
+        return name, None
+
+    def _cache_digest(self, digest: str, lane: str) -> None:
+        """Remember a resolved inline-spec digest, bounded: the digest
+        carries the client-chosen ``name`` field, so an adversarial
+        stream could otherwise grow the map without bound (many digests
+        may legitimately map to one lane)."""
+        if len(self._digest_to_lane) >= 1024:
+            self._digest_to_lane.pop(next(iter(self._digest_to_lane)))
+        self._digest_to_lane[digest] = lane
 
     # ------------------------------------------------------------ admission
     def _normalize_request(self, req: dict) -> tuple[dict | None, str | None]:
@@ -200,9 +428,8 @@ class ServeDaemon:
             out["home"] = int(req.get("home", 0))
         except (TypeError, ValueError):
             return None, f"home must be an integer, got {req.get('home')!r}"
-        if not 0 <= out["home"] < self.n_homes:
-            return None, (f"home {out['home']} outside the serving "
-                          f"community [0, {self.n_homes})")
+        if out["home"] < 0:
+            return None, f"home must be >= 0, got {out['home']}"
         for field, cast, default in (("t", int, 0), ("rp", float, 0.0)):
             raw = req.get(field)
             try:
@@ -215,6 +442,20 @@ class ServeDaemon:
             except (TypeError, ValueError):
                 return None, (f"deadline_s must be a number, got "
                               f"{req.get('deadline_s')!r}")
+        if req.get("steps") is not None:
+            try:
+                steps = int(req["steps"])
+            except (TypeError, ValueError):
+                return None, f"steps must be an integer, got {req['steps']!r}"
+            cap = max(1, int(self.scfg["max_steps"]))
+            if not 1 <= steps <= cap:
+                return None, (f"steps must be in [1, {cap}] "
+                              f"(serve.max_steps), got {steps}")
+            out["steps"] = steps
+        if req.get("pattern") is not None \
+                and not isinstance(req["pattern"], (str, dict)):
+            return None, (f"pattern must be a lane name or an inline spec "
+                          f"object, got {req['pattern']!r}")
         state = req.get("state")
         if state is not None:
             if not isinstance(state, dict):
@@ -235,14 +476,20 @@ class ServeDaemon:
             # Replayed record from an older/hand-edited journal: serve it
             # under the default deadline rather than refuse to start.
             deadline_s = float(self.scfg["request_deadline_s"])
+        try:
+            steps = max(1, int(req.get("steps") or 1))
+        except (TypeError, ValueError):
+            steps = 1
         return {"id": rid, "req": req, "accepted_mono": now,
-                "deadline_mono": now + deadline_s, "retries": 0,
-                "replayed": replayed, "last_failure": None}
+                "deadline_mono": now + deadline_s, "deadline_s": deadline_s,
+                "retries": 0, "replayed": replayed, "last_failure": None,
+                "lane": "default", "steps": steps}
 
     def accept(self, req: dict) -> tuple[int, dict]:
         """Admission control for one request.  Returns (http_status, body);
         202 = journaled (durable), 200 = idempotent replay of a known id,
-        429 = backpressure (queue full / probe says no), 503 = draining."""
+        429 = backpressure (queue full / probe says no / spill capacity),
+        503 = draining."""
         with self.lock:
             if self.draining:
                 return 503, {"error": "draining", "retry_after_s": None}
@@ -274,18 +521,30 @@ class ServeDaemon:
                              "retry_after_s": round(retry, 1)}
             depth = len(self.pending) + len(self.assigned)
             if depth >= int(self.scfg["queue_max"]):
+                # Backpressure BEFORE spill-lane resolution: a request the
+                # queue refuses must never trigger a compile.
                 retry = float(self.scfg["retry_after_s"])
                 telemetry.inc("serve.requests_rejected", 1)
                 telemetry.emit("serve.reject", id=rid, reason="queue_full",
                                retry_after_s=retry)
                 return 429, {"error": "queue full",
                              "retry_after_s": retry}
-            home = req["home"]  # normalized + range-checked above
-            req = dict(req, id=rid)
+            lane_name, err = self._resolve_lane(dict(req, id=rid))
+            if err is not None:
+                return err
+            lane = self.lanes[lane_name]
+            if not 0 <= req["home"] < lane.n_homes:
+                return 400, {"error": f"home {req['home']} outside the "
+                                      f"serving community "
+                                      f"[0, {lane.n_homes}) of pattern "
+                                      f"lane {lane_name!r}"}
+            req = dict(req, id=rid, pattern=lane_name)
             self.journal.accepted(rid, req)       # durability point (fsync)
-            self.pending[rid] = self._entry(rid, req, time.monotonic())
+            entry = self._entry(rid, req, time.monotonic())
+            entry["lane"] = lane_name
+            self.pending[rid] = entry
             telemetry.emit("serve.request", id=rid,
-                           timestep=req.get("t", 0), home=home)
+                           timestep=req.get("t", 0), home=req["home"])
             telemetry.set_gauge("serve.queue_depth", depth + 1)
             return 202, {"id": rid, "status": "accepted"}
 
@@ -314,6 +573,54 @@ class ServeDaemon:
             if self.journal.is_terminal(rid):
                 return 200, self._evicted_body(rid)
             return 404, {"error": f"unknown request id {rid!r}"}
+
+    # ----------------------------------------------------------- streaming
+    def chunk_follower(self):
+        """Incremental reader over the events.jsonl stream — the
+        transport for ``/result?stream=1`` chunk lines.  The first poll
+        reads a bounded 4 MB backlog (a chunk that scrolled past that
+        window is delivered by the terminal record instead); every later
+        poll costs O(new bytes), so a long stream on a busy daemon never
+        re-parses the whole tail."""
+        path = telemetry.events_path()
+        if not path:
+            return None
+        return telemetry.EventFollower(path, tail_bytes=1 << 22)
+
+    def stream_begin(self) -> bool:
+        """Admit one ``/result?stream=1`` consumer under
+        ``serve.max_streams``.  Every stream holds an HTTP server thread
+        and its own events-tail follower for up to its whole budget, so
+        streams are bounded like every other daemon resource (queue_max
+        bounds requests, spill_patterns bounds lanes)."""
+        with self.lock:
+            if self._active_streams >= int(self.scfg["max_streams"]):
+                return False
+            self._active_streams += 1
+            return True
+
+    def stream_end(self) -> None:
+        with self.lock:
+            self._active_streams = max(0, self._active_streams - 1)
+
+    def stream_budget_s(self, rid: str) -> float:
+        """How long a streaming consumer may hold the connection: the
+        request's own remaining deadline plus one batch service window
+        (a completed answer is delivered even past the request
+        deadline)."""
+        with self.lock:
+            entry = self.pending.get(rid) or self.assigned.get(rid)
+            steps = entry["steps"] if entry else 1
+            extra = float(self.scfg["batch_deadline_s"]) * max(1, steps)
+            if entry is not None:
+                return max(1.0, entry["deadline_mono"]
+                           - time.monotonic()) + extra
+            return extra
+
+    def accepted_mono(self, rid: str) -> float | None:
+        with self.lock:
+            entry = self.pending.get(rid) or self.assigned.get(rid)
+            return entry["accepted_mono"] if entry else None
 
     # ------------------------------------------------- platform / degrade
     def _apply_probe(self, report) -> None:
@@ -482,6 +789,18 @@ class ServeDaemon:
                     self._fail(entry,
                                f"retries exhausted (last failure: {kind})")
                 else:
+                    # Re-arm the queueing deadline: a steps=N batch
+                    # legitimately runs batch_deadline_s·N past the
+                    # request deadline (which governs QUEUED time only),
+                    # so a worker death mid-service must not let
+                    # _expire_pending kill the retry on the next tick —
+                    # request_retries would be unreachable for exactly
+                    # the long requests where a retry matters.
+                    entry["deadline_mono"] = max(
+                        entry["deadline_mono"],
+                        time.monotonic()
+                        + float(entry.get("deadline_s")
+                                or self.scfg["request_deadline_s"]))
                     self.pending[entry["id"]] = entry
         slot.proc = None
         self.consec_failures += 1
@@ -510,6 +829,7 @@ class ServeDaemon:
                          or self.pending.pop(rid, None))
                 record = {"platform": platform, "batch": seq,
                           "slot": slot.slot, "gen": payload.get("gen"),
+                          "pattern": slot.pattern,
                           "retries": entry["retries"] if entry else None,
                           **resp}
                 degraded = self._provenance()
@@ -558,45 +878,89 @@ class ServeDaemon:
         return (_num(req.get("t"), int, 0), _num(req.get("rp"), float, 0.0),
                 _num(req.get("home"), int, 0))
 
-    def _dispatch(self, slot: WorkerSlot, now: float) -> None:
-        if not self.pending:
-            return
-        # One batch = one (t, rp) group at the engine's fixed shape, at
-        # most one request per home slot (conflicting overrides for the
-        # same home wait for the next batch).
-        first = next(iter(self.pending.values()))
-        t, rp, _ = self._req_key(first["req"])
-        picked: dict[int, dict] = {}
-        for entry in list(self.pending.values()):
-            req = entry["req"]
-            rt, rrp, home = self._req_key(req)
-            if rt != t or rrp != rp:
-                continue
-            if home in picked:
-                continue
-            picked[home] = entry
-            if len(picked) >= self.batch_max:
+    def _coalesce(self, lane: PatternLane, now: float):
+        """Fold this lane's queue into up to C request groups for one
+        fleet batch.  One group = one (rp) at one community slot, at most
+        one request per home and ``batch_max`` per group; every group in
+        a batch shares (t, steps) — the compiled step takes one scalar
+        timestep.  The batch waits inside ``serve.batch_window_ms`` for
+        more groups (latency-aware coalescing) and dispatches EARLY the
+        moment all C slots fill, on window expiry, or while draining.
+
+        Returns (groups, t, steps, window_wait_s) or None (keep
+        waiting / nothing dispatchable)."""
+        anchor = None
+        for e in self.pending.values():
+            if e["lane"] == lane.name:
+                anchor = e
                 break
-        if not picked:
+        if anchor is None:
+            return None
+        t, _rp, _home = self._req_key(anchor["req"])
+        steps = anchor["steps"]
+        C = lane.fleet_slots
+        groups: dict[float, dict[int, dict]] = {}
+        for e in self.pending.values():
+            if e["lane"] != lane.name or e["steps"] != steps:
+                continue
+            rt, rrp, home = self._req_key(e["req"])
+            if rt != t:
+                continue
+            g = groups.get(rrp)
+            if g is None:
+                if len(groups) >= C:
+                    continue
+                g = groups[rrp] = {}
+            if home in g or len(g) >= lane.batch_max:
+                continue
+            g[home] = e
+        if not groups:
+            return None
+        window_wait = now - anchor["accepted_mono"]
+        window_s = float(self.scfg["batch_window_ms"]) / 1000.0
+        if (len(groups) < C and window_wait < window_s
+                and not self.draining):
+            return None  # hold for more coalescible groups
+        return list(groups.items()), t, steps, window_wait
+
+    def _dispatch(self, slot: WorkerSlot, now: float) -> None:
+        lane = self.lanes.get(slot.pattern or "default")
+        if lane is None or not self.pending:
             return
+        picked = self._coalesce(lane, now)
+        if picked is None:
+            return
+        groups, t, steps, window_wait = picked
         self.batch_seq += 1
         seq = self.batch_seq
-        ids = []
-        for entry in picked.values():
-            rid = entry["id"]
-            ids.append(rid)
-            self.assigned[rid] = self.pending.pop(rid)
-        batch = {"batch": seq, "t": t,
-                 "requests": [e["req"] for e in picked.values()]}
+        ids: list[str] = []
+        gpayload = []
+        for cslot, (rp, by_home) in enumerate(groups):
+            reqs = []
+            for entry in by_home.values():
+                rid = entry["id"]
+                ids.append(rid)
+                self.assigned[rid] = self.pending.pop(rid)
+                reqs.append(entry["req"])
+            gpayload.append({"cslot": cslot, "rp": rp, "requests": reqs})
+        batch = {"batch": seq, "t": t, "steps": steps, "groups": gpayload}
         spool.atomic_write_json(
             os.path.join(slot.inbox(), spool.batch_name(seq)), batch)
         self.journal.assigned(ids, seq, slot.slot, slot.gen,
                               slot.platform or "?")
         self.in_flight[slot.slot] = {
             "batch": seq, "ids": ids, "t": t,
-            "deadline_mono": now + float(self.scfg["batch_deadline_s"])}
+            "deadline_mono": now + float(self.scfg["batch_deadline_s"])
+            * max(1, steps)}
+        occupancy = len(gpayload) / max(1, lane.fleet_slots)
         telemetry.emit("serve.assign", batch=seq, slot=slot.slot,
-                       gen=slot.gen, n=len(ids), timestep=t)
+                       gen=slot.gen, n=len(ids), groups=len(gpayload),
+                       occupancy=round(occupancy, 4), timestep=t,
+                       steps=steps, pattern=lane.name,
+                       window_wait_s=round(window_wait, 4))
+        telemetry.observe("serve.batch_occupancy", occupancy)
+        telemetry.observe("serve.coalesced_requests", float(len(ids)))
+        telemetry.observe("serve.batch_window_wait_s", max(0.0, window_wait))
 
     # ------------------------------------------------------------- surface
     def stats(self) -> dict:
@@ -611,6 +975,8 @@ class ServeDaemon:
                 "results": len(self.results),
                 "workers_ready": ready,
                 "worker_gens": {s.slot: s.gen for s in self.slots},
+                "patterns": {n: ln.describe()
+                             for n, ln in self.lanes.items()},
                 "degraded": self._provenance(),
                 "batch_seq": self.batch_seq,
             }
@@ -697,11 +1063,12 @@ class ServeDaemon:
         for t in self._threads:
             t.join(timeout=5.0)
         self.journal.close()
-        if self._cfg_path:
-            try:
-                os.remove(self._cfg_path)
-            except OSError:
-                pass
+        for lane in self.lanes.values():
+            if lane.cfg_path:
+                try:
+                    os.remove(lane.cfg_path)
+                except OSError:
+                    pass
         telemetry.write_snapshot()
         if self._owns_bus:
             # Sequential in-process daemons (the soak's scenarios) each
@@ -753,11 +1120,120 @@ def _make_handler(daemon: ServeDaemon):
             self._send(code, body, retry_after=body.get("retry_after_s")
                        if code in (429, 503) else None)
 
+        def _stream_result(self, rid: str) -> None:
+            """NDJSON streaming: one line per serve.chunk event the
+            workers emitted for this request (the events.jsonl tail is
+            the transport), then the terminal record; connection close
+            delimits the stream (no Content-Length).  Admission is
+            bounded by ``serve.max_streams`` — each stream pins an HTTP
+            thread + follower for up to its whole budget, and an
+            unbounded fan-in would starve the request path's threads."""
+            code, first = daemon.result(rid)
+            if code == 404:
+                self._send(404, first)
+                return
+            if not daemon.stream_begin():
+                retry = float(daemon.scfg["retry_after_s"])
+                telemetry.inc("serve.streams_rejected", 1)
+                telemetry.emit("serve.reject", id=rid,
+                               reason="stream_capacity",
+                               retry_after_s=retry)
+                self._send(429, {"error": "concurrent stream capacity "
+                                          "exhausted (serve.max_streams)",
+                                 "retry_after_s": retry},
+                           retry_after=retry)
+                return
+            try:
+                self._stream_body(rid)
+            finally:
+                daemon.stream_end()
+
+        def _stream_body(self, rid: str) -> None:
+            t0 = time.monotonic()
+            accepted = daemon.accepted_mono(rid)
+            deadline = t0 + daemon.stream_budget_s(rid)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Cache-Control", "no-store")
+            self.end_headers()
+            sent = {"chunks": 0, "last_step": -1, "pid": None}
+            follower = daemon.chunk_follower()
+            telemetry.inc("serve.streams", 1)
+
+            def write_line(obj: dict) -> None:
+                self.wfile.write(
+                    (json.dumps(obj, default=str) + "\n").encode())
+                self.wfile.flush()
+
+            def poll_chunks() -> list[dict]:
+                if follower is None:
+                    return []
+                # contains= pre-filters raw lines before JSON parsing:
+                # the events stream carries EVERY telemetry event on a
+                # busy daemon, and each stream has its own follower.
+                # File order IS emission order (each attempt emits steps
+                # ascending) — sorting by step would interleave a dead
+                # attempt's chunks with its retry's.
+                return [r for r in follower.poll(contains=b'"serve.chunk"')
+                        if r.get("event") == "serve.chunk"
+                        and r.get("id") == rid]
+
+            def push_chunks() -> None:
+                for ev in poll_chunks():
+                    step = int(ev.get("step") or 0)
+                    if ev.get("pid") != sent["pid"]:
+                        # A new emitting process = a retry after a worker
+                        # death (possibly on a degraded platform).  The
+                        # chunk sequence RESTARTS so the stream stays
+                        # single-provenance with the terminal answer of
+                        # record — consumers keep the LAST occurrence of
+                        # each step.
+                        sent["pid"] = ev.get("pid")
+                        sent["last_step"] = -1
+                    if step <= sent["last_step"]:
+                        continue
+                    line = {k: v for k, v in ev.items()
+                            if k not in ("event", "mono", "pid", "seq")}
+                    line["kind"] = "chunk"
+                    write_line(line)
+                    if sent["chunks"] == 0 and accepted is not None:
+                        telemetry.observe("serve.first_chunk_latency_s",
+                                          time.monotonic() - accepted)
+                    sent["chunks"] += 1
+                    sent["last_step"] = step
+
+            terminal = None
+            try:
+                while True:
+                    push_chunks()
+                    code, body = daemon.result(rid)
+                    if body.get("status") in ("done", "failed"):
+                        push_chunks()  # late chunks beat the final line
+                        terminal = dict(body, kind="result")
+                        write_line(terminal)
+                        break
+                    if time.monotonic() > deadline:
+                        write_line({"id": rid, "kind": "result",
+                                    "status": "timeout",
+                                    "note": "stream budget exhausted; "
+                                            "poll /result"})
+                        break
+                    time.sleep(max(0.02, float(daemon.scfg["poll_s"])))
+            except OSError:
+                pass  # consumer went away mid-stream; nothing to unwind
+            telemetry.emit("serve.stream", id=rid, chunks=sent["chunks"],
+                           terminal=(terminal or {}).get("status"),
+                           elapsed_s=round(time.monotonic() - t0, 3))
+
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
             parsed = urllib.parse.urlparse(self.path)
             q = urllib.parse.parse_qs(parsed.query)
             if parsed.path == "/result":
                 rid = (q.get("id") or [""])[0]
+                stream = (q.get("stream") or ["0"])[0]
+                if stream not in ("", "0", "false", "no"):
+                    self._stream_result(rid)
+                    return
                 code, body = daemon.result(rid)
                 self._send(code, body)
             elif parsed.path == "/healthz":

@@ -18,6 +18,8 @@ Record grammar (one JSON object per line, ``state`` discriminates):
     {"state": "failed",     "id": ..., "reason": ...}       terminal
     {"state": "transition", "from": ..., "to": ...,
                             "failure": ..., "batch": n}     degradation mark
+    {"state": "pattern",    "name": ..., "signature": ...,
+                            "spec": {...}, "source": ...}   lane provenance
 
 Crash consistency is by construction, not recovery code:
 
@@ -47,6 +49,7 @@ ASSIGNED = "assigned"
 DONE = "done"
 FAILED = "failed"
 TRANSITION = "transition"
+PATTERN = "pattern"
 
 TERMINAL = (DONE, FAILED)
 
@@ -62,13 +65,18 @@ class ReplayState(NamedTuple):
                    restarted daemon keeps reporting degradation
                    provenance for requests accepted before the restart);
     ``dropped_lines`` — unparseable lines skipped (a torn tail is 0 or 1;
-                   more means outside interference — surfaced, not fatal).
+                   more means outside interference — surfaced, not fatal);
+    ``patterns`` — lane name -> newest pattern-provenance record (a
+                   restarted daemon rebuilds the compile-on-demand lanes
+                   its replayed pending requests were routed to —
+                   serve/patterns.py).
     """
 
     pending: dict
     terminal: dict
     transition: dict | None
     dropped_lines: int
+    patterns: dict
 
 
 class Journal:
@@ -157,6 +165,14 @@ class Journal:
         self._append({"state": TRANSITION, "from": from_platform,
                       "to": to_platform, "failure": failure, "batch": batch})
 
+    def pattern(self, name: str, signature: str, spec: dict,
+                source: str) -> None:
+        """Journal one pattern lane's generation provenance BEFORE any
+        request is accepted into it — replay must be able to rebuild the
+        lane a replayed pending request names (serve/patterns.py)."""
+        self._append({"state": PATTERN, "name": name, "signature": signature,
+                      "spec": spec, "source": source})
+
 
 def replay(path: str) -> ReplayState:
     """Fold a journal file into :class:`ReplayState` (module docstring).
@@ -165,12 +181,13 @@ def replay(path: str) -> ReplayState:
     pending: dict = {}
     terminal: dict = {}
     transition: dict | None = None
+    patterns: dict = {}
     dropped = 0
     try:
         with open(path, encoding="utf-8", errors="replace") as f:
             lines = f.read().split("\n")
     except OSError:
-        return ReplayState({}, {}, None, 0)
+        return ReplayState({}, {}, None, 0, {})
     for line in lines:
         line = line.strip()
         if not line:
@@ -197,6 +214,8 @@ def replay(path: str) -> ReplayState:
             terminal.setdefault(rid, rec)
         elif state == TRANSITION:
             transition = rec
+        elif state == PATTERN and "name" in rec:
+            patterns[rec["name"]] = rec  # newest record wins
         elif state == ASSIGNED:
             pass  # assignment is not a durability state: accepted covers it
-    return ReplayState(pending, terminal, transition, dropped)
+    return ReplayState(pending, terminal, transition, dropped, patterns)

@@ -1,0 +1,148 @@
+"""Shared load-generation kit for the serving benchmarks — stdlib only,
+jax-free (both consumers are daemon-parent processes).
+
+One request builder + one result-envelope schema, shared by
+``tools/serve_load.py`` (the SLO-gated load harness) and
+``tools/serve_soak.py`` (the chaos soak) so the two tools replay the
+same deterministic request distributions and emit the same JSON-line
+shape (ISSUE 13 satellite; tests/test_serve_load.py pins the schema
+both ways).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import urllib.error
+import urllib.request
+
+# The load harness watches ``serve.done`` for daemon-side completion
+# times instead of hammering ``/result`` with poll traffic; the
+# incremental reader itself is neutral telemetry infrastructure (the
+# daemon's streaming transport uses it too), so it lives in
+# telemetry/bus.py — re-exported here for the serving tools.
+from dragg_tpu.telemetry import EventFollower  # noqa: F401
+
+# The shared JSON-line schema version both serving tools stamp; bump it
+# when the envelope's required keys change.
+SCHEMA = "serve_bench_v1"
+
+# Keys every serving-tool result line must carry (the schema test
+# asserts both tools conform).
+REQUIRED_KEYS = ("tool", "schema", "ok", "homes", "requests", "metrics",
+                 "violations")
+
+
+def make_log(tool: str):
+    """One stderr log format for the serving tools (stdout is reserved
+    for the single JSON result line)."""
+    def _log(msg: str) -> None:
+        print(f"[{tool}] {msg}", file=sys.stderr, flush=True)
+    return _log
+
+
+def http_call(method: str, url: str, body=None, timeout: float = 30.0):
+    """One JSON HTTP round-trip against the daemon — shared by both
+    serving tools (the daemon always answers JSON, including on HTTP
+    errors, so error bodies parse too)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def build_requests(n: int, homes: int, *, prefix: str = "r",
+                   t_window: int = 3, rp_values=(0.0,), steps: int = 1,
+                   pattern: str | None = None, state_every: int = 4,
+                   seed: int | None = None) -> list[dict]:
+    """The deterministic request stream both tools replay: ids
+    ``<prefix>000…``, timesteps cycling a small window, homes cycling
+    the community, a few state overrides, and (load harness) reward
+    prices cycling ``rp_values`` — distinct rp values form distinct
+    coalescing groups, which is exactly what the fleet-backed pool
+    batches across community slots.
+
+    Defaults reproduce the soak's historical trace byte-for-byte.
+    ``seed`` perturbs the home/timestep draws reproducibly (the load
+    harness's request-size/pattern distributions are seeded, never
+    sampled from wall-clock state)."""
+    rng = random.Random(seed) if seed is not None else None
+    reqs = []
+    for i in range(n):
+        home = i % homes if rng is None else rng.randrange(homes)
+        t = i % t_window if rng is None else rng.randrange(t_window)
+        req: dict = {"id": f"{prefix}{i:03d}", "t": t, "home": home}
+        rp = rp_values[i % len(rp_values)]
+        if rp:
+            req["rp"] = rp
+        if steps > 1:
+            req["steps"] = steps
+        if pattern:
+            req["pattern"] = pattern
+        if state_every and i % state_every == 0:
+            req["state"] = {"temp_in": 18.0 + (i % 5)}
+        reqs.append(req)
+    return reqs
+
+
+def result_envelope(tool: str, *, ok: bool, homes: int, requests: int,
+                    metrics: dict, violations: list, **extra) -> dict:
+    """One serving-tool JSON line in the shared schema (repo bench
+    convention: exactly one machine-readable line on stdout)."""
+    out = {"tool": tool, "schema": SCHEMA, "ok": bool(ok),
+           "homes": int(homes), "requests": int(requests),
+           "metrics": metrics, "violations": list(violations)}
+    out.update(extra)
+    return out
+
+
+def journal_anomalies(journal_path: str, ids) -> list[str]:
+    """The load-harness journal QA: every submitted id that was ACCEPTED
+    reaches exactly one terminal state, and no id is answered twice (the
+    soak's richer invariant checker builds on the same records)."""
+    from dragg_tpu.serve import journal as journal_mod
+
+    ids = set(ids)
+    accepted: set = set()
+    done: dict = {}
+    failed: dict = {}
+    try:
+        with open(journal_path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return [f"journal unreadable: {journal_path}"]
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        rid = rec.get("id")
+        if rid not in ids:
+            continue
+        state = rec.get("state")
+        if state == journal_mod.ACCEPTED:
+            accepted.add(rid)
+        elif state == journal_mod.DONE:
+            done[rid] = done.get(rid, 0) + 1
+        elif state == journal_mod.FAILED:
+            failed[rid] = failed.get(rid, 0) + 1
+    problems = []
+    for rid in sorted(accepted):
+        n = done.get(rid, 0) + failed.get(rid, 0)
+        if n == 0:
+            problems.append(f"{rid}: LOST — accepted but no terminal record")
+        elif n > 1:
+            problems.append(f"{rid}: {n} terminal records")
+    for rid, k in sorted(done.items()):
+        if k > 1:
+            problems.append(f"{rid}: answered {k} times")
+    return problems
+
+

@@ -1,0 +1,210 @@
+"""Pattern lanes for multi-pattern serving admission — stdlib+numpy only
+(the daemon parent is jax-free by contract; everything here is pure
+config math).
+
+A *bucket-pattern signature* names the set of compiled executables a
+worker engine holds: the home-type mix (which type buckets exist and at
+what per-community size), the MPC horizon, and the fleet slot count C
+(type buckets hold ``C·B_type`` homes — round 12, architecture.md §14).
+Two requests with the same signature can share a warm worker; two
+requests with different signatures cannot (different compiled shapes).
+
+The serving daemon routes every request to a :class:`LaneSpec` at
+admission:
+
+* the **default lane** is the daemon's own config (``serve.fleet_slots``
+  community slots per worker);
+* **configured lanes** come from ``serve.patterns`` — each entry warms
+  its own worker(s) at boot;
+* **spill lanes** are created on demand for requests carrying an inline
+  pattern spec whose signature no existing lane serves, bounded by
+  ``serve.spill_patterns`` (a compile-on-demand lane is a cold compile —
+  the bound keeps an adversarial request stream from turning the pool
+  into a compile farm).  Every lane creation is journaled
+  (``pattern`` record — serve/journal.py) so a restarted daemon can
+  rebuild the lane a replayed request needs.
+
+Lane spec fields (all optional except ``name`` for configured lanes):
+
+``horizon_hours``   MPC prediction horizon override
+``homes``           community-mix overrides: ``{"total": n, "pv": k,
+                    "battery": k, "pv_battery": k, "ev": k,
+                    "heat_pump": k}`` (absent keys keep the daemon
+                    config's counts)
+``fleet_slots``     community slots C per worker (default
+                    ``serve.fleet_slots``)
+``workers``         worker slots for this lane (default 1; the default
+                    lane uses ``serve.workers``)
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+
+# homes override key -> community config key
+_HOMES_KEYS = {
+    "total": "total_number_homes",
+    "pv": "homes_pv",
+    "battery": "homes_battery",
+    "pv_battery": "homes_pv_battery",
+    "ev": "homes_ev",
+    "heat_pump": "homes_heat_pump",
+}
+_SPEC_KEYS = ("name", "horizon_hours", "homes", "fleet_slots", "workers")
+
+# Admission ceilings for INLINE specs (network-supplied): the spill
+# bound caps how MANY cold compiles a request stream can trigger; these
+# cap how BIG one can be (a single admitted 1M-home/16-worker spec
+# would defeat the bound).  Operator config and journal replay are
+# trusted and uncapped.
+_INLINE_MAX = {"horizon_hours": 168, "fleet_slots": 256, "workers": 8}
+_INLINE_HOMES_MAX = 4096
+
+
+class PatternError(ValueError):
+    """A malformed pattern spec — answered 400 at admission, never
+    journaled."""
+
+
+def normalize_spec(spec: dict, scfg: dict, *, inline: bool = False) -> dict:
+    """Validate one pattern spec (a ``serve.patterns`` entry or an inline
+    request spec) into its canonical dict form.  Raises
+    :class:`PatternError` with a client-presentable message.
+    ``inline=True`` (request-supplied specs) additionally enforces the
+    ``_INLINE_MAX`` / ``_INLINE_HOMES_MAX`` size ceilings."""
+    if not isinstance(spec, dict):
+        raise PatternError("pattern spec must be an object")
+    unknown = set(spec) - set(_SPEC_KEYS)
+    if unknown:
+        raise PatternError(f"unknown pattern spec keys {sorted(unknown)} "
+                           f"(allowed: {list(_SPEC_KEYS)})")
+    out: dict = {}
+    if spec.get("name") is not None:
+        name = str(spec["name"])
+        if not name or "/" in name or len(name) > 64:
+            raise PatternError(f"bad pattern name {name!r}")
+        out["name"] = name
+    for key, lo in (("horizon_hours", 1), ("fleet_slots", 1),
+                    ("workers", 1)):
+        if spec.get(key) is None:
+            continue
+        try:
+            v = int(spec[key])
+        except (TypeError, ValueError):
+            raise PatternError(f"pattern {key} must be an integer, "
+                               f"got {spec[key]!r}")
+        if v < lo:
+            raise PatternError(f"pattern {key} must be >= {lo}, got {v}")
+        if inline and v > _INLINE_MAX[key]:
+            raise PatternError(f"pattern {key} must be <= "
+                               f"{_INLINE_MAX[key]} for inline specs, "
+                               f"got {v}")
+        out[key] = v
+    homes = spec.get("homes")
+    if homes is not None:
+        if not isinstance(homes, dict):
+            raise PatternError("pattern homes must be an object of counts")
+        bad = set(homes) - set(_HOMES_KEYS)
+        if bad:
+            raise PatternError(f"unknown pattern homes keys {sorted(bad)} "
+                               f"(allowed: {sorted(_HOMES_KEYS)})")
+        counts = {}
+        for k, v in homes.items():
+            try:
+                counts[k] = int(v)
+            except (TypeError, ValueError):
+                raise PatternError(f"pattern homes.{k} must be an integer, "
+                                   f"got {v!r}")
+            if counts[k] < 0:
+                raise PatternError(f"pattern homes.{k} must be >= 0")
+            if inline and counts[k] > _INLINE_HOMES_MAX:
+                raise PatternError(f"pattern homes.{k} must be <= "
+                                   f"{_INLINE_HOMES_MAX} for inline "
+                                   f"specs, got {counts[k]}")
+        out["homes"] = counts
+    out.setdefault("fleet_slots", max(1, int(scfg.get("fleet_slots", 1))))
+    return out
+
+
+def lane_config(base_config: dict, spec: dict) -> dict:
+    """The engine config a lane's workers build: the daemon config with
+    the spec's horizon/mix overrides applied and the fleet axis turned
+    into C IDENTICAL community slots (``seed_stride = 0``,
+    ``weather_offset_hours = 0`` — every slot is a copy of the serving
+    community, so any request can land in any slot).  The ``[fleet]``
+    table is ALWAYS pinned to the lane's geometry — a base config
+    reused from fleet training (``fleet.communities = 8``, seed-strided
+    DISTINCT communities) must not leak into a serving engine whose
+    lane believes C = ``fleet_slots``; ``communities = 1`` with zero
+    stride/offset is the engine's single-community default path, so the
+    C = 1 program stays byte-identical to the round-11 engine
+    (round-12 pin, tests/test_serve_fleet.py)."""
+    cfg = copy.deepcopy(base_config)
+    if spec.get("horizon_hours"):
+        cfg["home"]["hems"]["prediction_horizon"] = int(spec["horizon_hours"])
+    for k, v in (spec.get("homes") or {}).items():
+        cfg["community"][_HOMES_KEYS[k]] = int(v)
+    slots = int(spec.get("fleet_slots", 1))
+    cfg["fleet"] = dict(cfg.get("fleet") or {})
+    cfg["fleet"]["communities"] = slots
+    cfg["fleet"]["seed_stride"] = 0
+    cfg["fleet"]["weather_offset_hours"] = 0
+    return cfg
+
+
+def expanded(config: dict) -> dict:
+    """The scenario-expanded copy of one lane config (packs rewrite the
+    mix counts — the engine build applies the same expansion,
+    dragg_tpu/scenarios).  :func:`signature` and :func:`community_size`
+    accept the result via ``pre_expanded=True`` so admission pays ONE
+    deepcopy + expansion per inline spec, not one per derived value
+    (both run under the daemon lock)."""
+    from dragg_tpu.scenarios import apply_scenarios
+
+    return apply_scenarios(copy.deepcopy(config))
+
+
+def signature(config: dict, *, pre_expanded: bool = False) -> str:
+    """The bucket-pattern signature of one lane config: home-type mix ×
+    horizon × fleet slots.  Scenario packs are expanded FIRST (see
+    :func:`expanded`), so the signature names what actually compiles.
+
+    Deterministic and pure — admission computes it without touching jax
+    or synthesizing homes."""
+    cfg = config if pre_expanded else expanded(config)
+    comm = cfg["community"]
+    n = int(comm["total_number_homes"])
+    counts = {
+        "pv_battery": int(comm.get("homes_pv_battery", 0)),
+        "pv_only": int(comm.get("homes_pv", 0)),
+        "battery_only": int(comm.get("homes_battery", 0)),
+        "ev": int(comm.get("homes_ev", 0)),
+        "heat_pump": int(comm.get("homes_heat_pump", 0)),
+    }
+    counts["base"] = n - sum(counts.values())
+    horizon = int(cfg["home"]["hems"]["prediction_horizon"])
+    slots = int(cfg.get("fleet", {}).get("communities", 1))
+    mix = ",".join(f"{t}:{c}" for t, c in sorted(counts.items()) if c > 0)
+    return f"h{horizon}[{mix}]xC{slots}"
+
+
+def community_size(config: dict, *, pre_expanded: bool = False) -> int:
+    """The per-slot serving community size of one lane config (scenario
+    packs expanded — a pack's mix rewrites counts but never the total)."""
+    cfg = config if pre_expanded else expanded(config)
+    return int(cfg["community"]["total_number_homes"])
+
+
+def spec_digest(spec: dict) -> str:
+    """Canonical JSON of a normalized spec — the admission fast-path
+    cache key: a repeat inline spec resolves to its lane without
+    re-deriving lane config / signature (daemon ``_resolve_lane``).
+
+    The client-chosen ``name`` is EXCLUDED: it never affects routing
+    (identical geometries share a lane through the signature lookup
+    regardless of name), and keying on it would let a name-cycling
+    client miss the cache into a full-config deepcopy + scenario
+    expansion under the daemon lock on every POST."""
+    return json.dumps({k: v for k, v in spec.items() if k != "name"},
+                      sort_keys=True, separators=(",", ":"))

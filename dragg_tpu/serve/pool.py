@@ -32,7 +32,8 @@ class WorkerSlot:
 
     def __init__(self, spool_dir: str, slot: int, *,
                  cfg_path: str | None = None, stub: bool = False,
-                 poll_s: float = 0.05, epoch: str = "", log=None):
+                 poll_s: float = 0.05, epoch: str = "", log=None,
+                 pattern: str = "default"):
         self.spool_dir = spool_dir
         self.slot = slot
         self.cfg_path = cfg_path
@@ -40,6 +41,7 @@ class WorkerSlot:
         self.poll_s = poll_s
         self.epoch = epoch
         self.log = log
+        self.pattern = pattern  # the pattern lane this slot serves
         self.gen = 0
         self.proc: subprocess.Popen | None = None
         self.platform: str | None = None   # requested platform of this gen
@@ -109,7 +111,7 @@ class WorkerSlot:
         self.ready_report = None
         telemetry.emit("serve.worker.launch", slot=self.slot, gen=self.gen,
                        pid=self.proc.pid, platform=platform,
-                       stub=self.stub)
+                       stub=self.stub, pattern=self.pattern)
         telemetry.inc("serve.worker_restarts", 1 if self.gen > 1 else 0)
         if self.log:
             self.log(f"worker w{self.slot} gen={self.gen} pid={self.proc.pid} "

@@ -3,14 +3,14 @@
 The ONLY process in a serving deployment that initializes a jax backend
 (daemon-parent contract: resilience.supervisor).  Lifecycle:
 
-1. enable the persistent compile cache, build the serving community's
+1. enable the persistent compile cache, build the serving pattern's
    engine from the staged JSON config, and compile its one-step chunk
    program through :func:`telemetry.compile_obs.staged_compile` — so a
    hang names its stage on the heartbeat, and the cache hit/miss verdict
    lands in the ready report (the soak's warm-restart invariant reads
    exactly this);
 2. write the ready file (``spool.ready_path``) carrying the compile
-   report and the actual backend platform;
+   report, the actual backend platform, and the fleet-slot geometry;
 3. loop: claim inbox batches, solve them against the warm compiled
    runner, write outbox responses atomically (response BEFORE inbox
    unlink — spool module ordering contract), beating the heartbeat at
@@ -18,6 +18,22 @@ The ONLY process in a serving deployment that initializes a jax backend
    a genuine hang;
 4. exit 0 when the spool's STOP file appears (graceful drain — the
    in-flight batch finishes first).
+
+**Fleet-backed batches** (ISSUE 13): with ``serve.fleet_slots = C > 1``
+the worker's engine is a C-community FLEET of identical copies of the
+serving community (serve/patterns.lane_config: ``seed_stride = 0``), so
+ONE warm compiled solve serves up to C coalesced request *groups* — each
+group owns a community slot and its own reward price through the
+engine's per-community ``(C, H)`` rp path.  Per-request outputs
+de-interleave from the merged batch via ``engine.real_home_cols``
+(community-major global index ``cslot·B + home`` → merged column).
+``C = 1`` keeps the round-11 single-community program byte-identical.
+
+**Multi-chunk requests** stream: a group's ``steps = N > 1`` re-runs the
+warm one-step program N times, carrying state, and emits one
+``serve.chunk`` telemetry event per request per step — the daemon's
+``/result?stream=1`` tail serves them incrementally, so first-chunk
+latency decouples from run length.
 
 ``--stub`` runs the same protocol with a deterministic arithmetic
 responder and NO jax import at all — the fast-tier daemon tests drive
@@ -35,55 +51,93 @@ import json
 import os
 import time
 
+from dragg_tpu import telemetry
 from dragg_tpu.resilience.faults import fault_hook
 from dragg_tpu.resilience.heartbeat import beat
 from dragg_tpu.serve import spool
+
+# The per-home StepOutputs fields a response carries (first MPC action +
+# provenance scalars) — shared by both runners so parent-side consumers
+# cannot tell them apart structurally.
+RESPONSE_FIELDS = ("p_grid", "temp_in", "temp_wh", "e_batt",
+                   "hvac_cool_on", "hvac_heat_on", "wh_heat_on",
+                   "cost", "correct_solve")
+
+
+def _as_groups(payload: dict) -> list[dict]:
+    """The batch's request groups.  Modern batches carry ``groups``
+    (coalesced fleet dispatch); a legacy/hand-crafted ``requests`` list
+    degrades to one group at community slot 0."""
+    groups = payload.get("groups")
+    if groups is None:
+        reqs = payload.get("requests", [])
+        rp = float(reqs[0].get("rp", 0.0)) if reqs else 0.0
+        groups = [{"cslot": 0, "rp": rp, "requests": reqs}]
+    return groups
 
 
 class StubRunner:
     """Deterministic jax-free responder: the protocol without the MPC.
     Response fields mirror the engine runner's so parent-side consumers
-    cannot tell them apart structurally."""
+    cannot tell them apart structurally; multi-step groups emit the same
+    ``serve.chunk`` stream the engine runner does, so streaming is
+    testable in milliseconds."""
 
     platform = "stub"
     n_homes = 1 << 20  # accept any home index the daemon admits
+    fleet_slots = 1 << 10  # and any community slot
 
-    def solve(self, t: int, requests: list[dict]) -> dict:
+    def _fields(self, t: int, req: dict) -> dict:
+        home = int(req.get("home", 0))
+        st = req.get("state") or {}
+        return {
+            "p_grid": round(1.0 + 0.25 * home + 0.01 * t, 6),
+            "temp_in": float(st.get("temp_in", 20.0)),
+            "temp_wh": float(st.get("temp_wh", 46.0)),
+            "e_batt": float(st.get("e_batt", 0.0)),
+            "hvac_cool_on": 0.0, "hvac_heat_on": 0.5, "wh_heat_on": 0.5,
+            "cost": round(0.07 * (1.0 + 0.25 * home), 6),
+            "correct_solve": 1.0,
+        }
+
+    def solve(self, t: int, groups: list[dict], steps: int = 1) -> dict:
         out = {}
-        for req in requests:
-            home = int(req.get("home", 0))
-            st = req.get("state") or {}
-            out[req["id"]] = {
-                "p_grid": round(1.0 + 0.25 * home + 0.01 * t, 6),
-                "temp_in": float(st.get("temp_in", 20.0)),
-                "temp_wh": float(st.get("temp_wh", 46.0)),
-                "e_batt": float(st.get("e_batt", 0.0)),
-                "hvac_cool_on": 0.0, "hvac_heat_on": 0.5, "wh_heat_on": 0.5,
-                "cost": round(0.07 * (1.0 + 0.25 * home), 6),
-                "correct_solve": 1.0,
-            }
+        for g in groups:
+            cslot = int(g.get("cslot", 0))
+            for req in g["requests"]:
+                for k in range(steps):
+                    fields = self._fields(t + k, req)
+                    if steps > 1:
+                        telemetry.emit("serve.chunk", id=req["id"], step=k,
+                                       steps=steps, timestep=t + k, **fields)
+                out[req["id"]] = {**fields, "cslot": cslot, "steps": steps}
         return out
 
 
 class EngineRunner:
     """The real thing: a warm compiled one-step engine at the serving
-    community's shape, with per-request scalar-state overrides.
+    pattern's shape, with per-request scalar-state overrides.
 
     Requests are "batched into the existing bucket-pattern shapes"
-    literally: the engine solves its whole fixed community batch every
-    step (that IS the compiled shape), requested homes get their carried
-    scalars (temp_in / temp_wh / e_batt) overridden to the caller's
-    values, and only the requested homes' outputs are returned.  Engine
-    state ordering is community order for both the superset and the
-    bucketed path (bucket ranges are contiguous — engine.state_slice
-    precedent)."""
+    literally: the engine solves its whole fixed batch every step (that
+    IS the compiled shape).  With ``fleet_slots = C > 1`` the batch is a
+    C-slot fleet of identical communities — each coalesced group lands
+    in a community slot (its reward price in that slot's row of the
+    ``(C, H)`` rp array, its state overrides at its homes' state rows) —
+    and only the requested homes' outputs are returned, de-interleaved
+    through ``engine.real_home_cols``.  ``C = 1`` is the round-11
+    single-community path, byte-identical (``[fleet]`` untouched).
+
+    Engine state row mapping is derived from the engine's own fleet
+    rows (``global_idx`` inverse), so the superset, type-bucketed, and
+    mesh-sharded variants all de-interleave through one code path
+    (parity: tests/test_serve_fleet.py)."""
 
     def __init__(self, config: dict):
         import numpy as np
 
         from dragg_tpu.data import load_environment, load_waterdraw_profiles
-        from dragg_tpu.engine import make_engine
-        from dragg_tpu.homes import build_home_batch, create_homes
+        from dragg_tpu.homes import build_fleet_batch, create_fleet_homes
         from dragg_tpu.telemetry.compile_obs import staged_compile
         from dragg_tpu.utils.compile_cache import enable_compile_cache
 
@@ -101,33 +155,76 @@ class EngineRunner:
         dt = env.dt
         hems = config["home"]["hems"]
         waterdraw = load_waterdraw_profiles(None, seed=seed)
-        homes = create_homes(config, 24 * dt, dt, waterdraw)
-        batch = build_home_batch(homes, int(hems["prediction_horizon"]) * dt,
-                                 dt, int(hems["sub_subhourly_steps"]))
-        self.engine = make_engine(batch, env, config,
-                                  env.start_index(env.data_start))
-        self.n_homes = self.engine.true_n_homes
-        rps0 = np.zeros((1, self.engine.params.horizon), np.float32)
+        homes = create_fleet_homes(config, 24 * dt, dt, waterdraw)
+        batch, fleet = build_fleet_batch(
+            homes, config, int(hems["prediction_horizon"]) * dt, dt,
+            int(hems["sub_subhourly_steps"]))
+        self.engine = self._build_engine(batch, env, config, fleet)
+        self.fleet_slots = 1 if fleet is None else fleet.n_communities
+        # The serving community size is PER SLOT: admission range-checks
+        # request homes against one community, whichever slot they land in.
+        self.n_homes = self.engine.true_n_homes // self.fleet_slots
+        H = self.engine.params.horizon
+        rps0 = (np.zeros((1, H), np.float32) if self.fleet_slots == 1
+                else np.zeros((1, self.fleet_slots, H), np.float32))
         self._runner, _state, _outs, self.compile_report = staged_compile(
             self.engine, self.engine.init_state(), 0, rps0, label="serve")
         self._rps0 = rps0
-        # Host-side template of the initial carried state, plus the
-        # community-order ranges of each state leaf-tuple element (one
-        # range for the superset engine, one per bucket otherwise).
+        # Host-side template of the initial carried state, the state row
+        # of each community-major global home index, and the merged
+        # output column carrying it.
         self._template = self.engine.init_state()
-        self._ranges = self._state_ranges()
+        self._state_pos = self._state_positions()
+        self._out_cols = np.asarray(self.engine.real_home_cols)
         import jax
 
         self.platform = jax.default_backend()  # device-call-ok: serving worker is the supervised jax child
 
-    def _state_ranges(self) -> list[tuple[int, int]]:
-        if getattr(self.engine, "_bucketed", False):
-            return [(c.comm_start, c.n_real) for c in self.engine._buckets]
-        return [(0, self.n_homes)]
+    def _build_engine(self, batch, env, config, fleet):
+        """Mirror the Aggregator's mesh decision: multi-device processes
+        shard the home axis automatically (``tpu.sharded`` forces either
+        way) — the de-interleave path is identical, only data placement
+        changes."""
+        from dragg_tpu.engine import make_engine
 
-    def _with_overrides(self, requests: list[dict]):
+        sharded = config.get("tpu", {}).get("sharded", "auto")
+        if sharded == "auto":
+            from dragg_tpu.resilience.devices import device_count
+
+            use_sharded = device_count() > 1
+        else:
+            use_sharded = bool(sharded)
+        if use_sharded:
+            from dragg_tpu.parallel import make_sharded_engine
+
+            return make_sharded_engine(batch, env, config,
+                                       env.start_index(env.data_start),
+                                       fleet=fleet)
+        return make_engine(batch, env, config,
+                           env.start_index(env.data_start), fleet=fleet)
+
+    # ------------------------------------------------------------- mapping
+    def _state_positions(self) -> dict[int, tuple[int, int]]:
+        """community-major global home index -> (state element, local row).
+        Derived from the engine's own fleet rows: batch row ``i`` carries
+        global home ``home_idx[i]``; bucketed engines slice batch rows
+        ``comm_start..comm_start+n_real`` into bucket element rows
+        ``0..n_real`` (shard padding appends after the real rows)."""
+        eng = self.engine
+        home_idx = self._np.asarray(eng._fleet_rows["home_idx"])
+        pos: dict[int, tuple[int, int]] = {}
+        if getattr(eng, "_bucketed", False):
+            for e, c in enumerate(eng._buckets):
+                for local in range(c.n_real):
+                    pos[int(home_idx[c.comm_start + local])] = (e, local)
+        else:
+            for row in range(eng.true_n_homes):
+                pos[int(home_idx[row])] = (0, row)
+        return pos
+
+    def _with_overrides(self, groups: list[dict]):
         """The template state with each request's scalar overrides applied
-        at its home's slot (field missing from the request = keep the
+        at its home's state row (field missing from the request = keep the
         engine's initial condition for that scalar)."""
         import jax.numpy as jnp
 
@@ -137,42 +234,66 @@ class EngineRunner:
         # isinstance-tuple check would shred it into its field arrays).
         bucketed = getattr(self.engine, "_bucketed", False)
         states = list(self._template) if bucketed else [self._template]
-        overridden = []
-        for (start, n_real), st in zip(self._ranges, states):
-            edits: dict[str, list] = {}
-            for req in requests:
-                home = int(req["home"])
-                if not start <= home < start + n_real:
-                    continue
+        edits: dict[tuple[int, str], list] = {}
+        for g in groups:
+            base = int(g.get("cslot", 0)) * self.n_homes
+            for req in g["requests"]:
+                elem, local = self._state_pos[base + int(req["home"])]
                 for field in ("temp_in", "temp_wh", "e_batt"):
                     val = (req.get("state") or {}).get(field)
                     if val is not None:
-                        edits.setdefault(field, []).append(
-                            (home - start, float(val)))
-            if edits:
-                repl = {}
-                for field, pairs in edits.items():
-                    arr = np.asarray(getattr(st, field)).copy()
-                    for local, val in pairs:
-                        arr[local] = val
-                    repl[field] = jnp.asarray(arr, dtype=jnp.float32)
-                st = st._replace(**repl)
-            overridden.append(st)
-        return tuple(overridden) if bucketed else overridden[0]
+                        edits.setdefault((elem, field), []).append(
+                            (local, float(val)))
+        by_elem: dict[int, dict] = {}
+        for (elem, field), pairs in edits.items():
+            arr = np.asarray(getattr(states[elem], field)).copy()
+            for local, val in pairs:
+                arr[local] = val
+            by_elem.setdefault(elem, {})[field] = jnp.asarray(
+                arr, dtype=jnp.float32)
+        for elem, repl in by_elem.items():
+            states[elem] = states[elem]._replace(**repl)
+        state = tuple(states) if bucketed else states[0]
+        mesh = getattr(self.engine, "mesh", None)
+        if mesh is not None and by_elem:
+            # Edited leaves came back as host arrays; re-commit the mesh
+            # placement the compiled executable was built against.
+            from dragg_tpu.parallel import shard_state
 
-    def solve(self, t: int, requests: list[dict]) -> dict:
+            state = shard_state(state, mesh, self.engine.axis_name)
+        return state
+
+    # --------------------------------------------------------------- solve
+    def solve(self, t: int, groups: list[dict], steps: int = 1) -> dict:
         np = self._np
-        state = self._with_overrides(requests)
-        rp = float(requests[0].get("rp", 0.0)) if requests else 0.0
-        rps = self._rps0 + np.float32(rp)
-        _state_out, outs = self._runner(state, t, rps)
-        fields = {f: np.asarray(getattr(outs, f))[0]
-                  for f in ("p_grid", "temp_in", "temp_wh", "e_batt",
-                            "hvac_cool_on", "hvac_heat_on", "wh_heat_on",
-                            "cost", "correct_solve")}
-        return {req["id"]: {f: round(float(v[int(req["home"])]), 6)
-                            for f, v in fields.items()}
-                for req in requests}
+        state = self._with_overrides(groups)
+        if self.fleet_slots == 1:
+            rp = float(groups[0].get("rp", 0.0)) if groups else 0.0
+            rps = self._rps0 + np.float32(rp)
+        else:
+            rp_c = np.zeros((self.fleet_slots, 1), np.float32)
+            for g in groups:
+                rp_c[int(g.get("cslot", 0))] = np.float32(g.get("rp") or 0.0)
+            rps = self._rps0 + rp_c[None]
+        want = [(req, int(g.get("cslot", 0)))
+                for g in groups for req in g["requests"]]
+        cols = self._out_cols
+        resp: dict[str, dict] = {}
+        for k in range(steps):
+            state, outs = self._runner(state, t + k, rps)
+            fields = {f: np.asarray(getattr(outs, f))[0]
+                      for f in RESPONSE_FIELDS}
+            for req, cslot in want:
+                col = cols[cslot * self.n_homes + int(req["home"])]
+                vals = {f: round(float(v[col]), 6)
+                        for f, v in fields.items()}
+                if steps > 1:
+                    telemetry.emit("serve.chunk", id=req["id"], step=k,
+                                   steps=steps, timestep=t + k, **vals)
+                resp[req["id"]] = {**vals, "cslot": cslot, "steps": steps}
+            if steps > 1:
+                beat({"stage": "serve:chunk", "step": k, "steps": steps})
+        return resp
 
 
 def serve_loop(runner, spool_dir: str, slot: int, gen: int,
@@ -207,12 +328,13 @@ def serve_loop(runner, spool_dir: str, slot: int, gen: int,
                 continue
             beat({"stage": "serve:batch", "batch": seq, "gen": gen})
             fault_hook("serve_batch")
+            groups = _as_groups(payload)
             t0 = time.perf_counter()
-            responses = runner.solve(int(payload.get("t", 0)),
-                                     payload.get("requests", []))
+            responses = runner.solve(int(payload.get("t", 0)), groups,
+                                     steps=max(1, int(payload.get("steps", 1))))
             resp = {"batch": seq, "platform": runner.platform, "gen": gen,
                     "elapsed_s": round(time.perf_counter() - t0, 4),
-                    "responses": responses}
+                    "groups": len(groups), "responses": responses}
             # Response BEFORE inbox unlink (spool ordering contract): a
             # crash between the two must leave the answer, not the work.
             spool.atomic_write_json(
@@ -255,7 +377,9 @@ def main() -> int:
         spool.ready_path(args.spool, args.slot, args.gen),
         {"slot": args.slot, "gen": args.gen, "platform": runner.platform,
          "warmup_s": round(time.perf_counter() - t0, 3),
-         "n_homes": runner.n_homes, "compile": report})
+         "n_homes": runner.n_homes,
+         "fleet_slots": getattr(runner, "fleet_slots", 1),
+         "compile": report})
     beat({"stage": "serve:ready", "slot": args.slot, "gen": args.gen})
     return serve_loop(runner, args.spool, args.slot, args.gen, args.poll_s,
                       epoch=args.epoch)

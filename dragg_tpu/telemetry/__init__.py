@@ -28,6 +28,7 @@ from dragg_tpu.telemetry.bus import (
     ENV_DIR,
     EVENTS_FILE,
     METRICS_FILE,
+    EventFollower,
     active,
     close_run,
     emit,
@@ -47,6 +48,7 @@ from dragg_tpu.telemetry.registry import EVENTS, METRICS
 
 __all__ = [
     "ENV_DIR", "EVENTS_FILE", "METRICS_FILE", "EVENTS", "METRICS",
+    "EventFollower",
     "active", "close_run", "emit", "events_path", "inc", "init_run",
     "observe", "run_dir", "selftest", "set_gauge", "snapshot", "span",
     "tail_events", "write_snapshot",

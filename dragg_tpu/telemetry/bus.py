@@ -310,6 +310,58 @@ def tail_events(events_path: str, limit: int = 50,
     return list(reversed(out))
 
 
+class EventFollower:
+    """Incremental reader of one events.jsonl stream — the counterpart
+    of :func:`tail_events` for consumers that poll repeatedly (the
+    serving daemon's ``/result?stream=1`` transport, the load harness
+    watching ``serve.done`` for daemon-side completion times): each
+    ``poll()`` costs O(new bytes), never a re-read of the tail."""
+
+    def __init__(self, path: str, *, tail_bytes: int | None = None):
+        """``tail_bytes`` bounds the FIRST read to the file's last N
+        bytes (opening a follower on a long-lived events file reads a
+        bounded backlog, then goes incremental); a torn first line is
+        dropped by the JSON parse."""
+        self.path = path
+        self._pos = 0
+        self._buf = b""
+        self._tail_bytes = tail_bytes
+        self._primed = tail_bytes is None
+
+    def poll(self, *, contains: bytes | None = None) -> list[dict]:
+        """Records appended since the last poll (torn tails wait for the
+        next poll).  ``contains`` pre-filters raw lines by substring
+        BEFORE the JSON parse — a consumer watching one event kind on a
+        busy stream (e.g. ``b'"serve.chunk"'``) skips the parse cost of
+        everything else."""
+        try:
+            with open(self.path, "rb") as f:
+                if not self._primed:
+                    f.seek(0, os.SEEK_END)
+                    self._pos = max(0, f.tell() - int(self._tail_bytes))
+                    self._primed = True
+                f.seek(self._pos)
+                data = f.read()
+                self._pos = f.tell()
+        except OSError:
+            return []
+        if not data:
+            return []
+        self._buf += data
+        out = []
+        while b"\n" in self._buf:
+            line, self._buf = self._buf.split(b"\n", 1)
+            if contains is not None and contains not in line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
+
+
 # -------------------------------------------------------------- snapshots
 def snapshot() -> dict:
     """The current metrics registry as one JSON-able dict

@@ -69,13 +69,25 @@ EVENTS: dict[str, str] = {
     # and the fsync'd journal tell one story.
     "serve.request": "serving daemon accepted + journaled one request "
                      "(id, timestep, home)",
-    "serve.assign": "one batch dispatched to a worker slot (batch, slot, "
-                    "gen, n, timestep)",
+    "serve.assign": "one coalesced batch dispatched to a worker slot "
+                    "(batch, slot, gen, n, groups, occupancy, timestep, "
+                    "steps, pattern, window_wait_s)",
+    "serve.chunk": "one incremental per-step result of a multi-chunk "
+                   "request, emitted by the worker and served over "
+                   "/result?stream=1 (id, step, steps, timestep, + the "
+                   "response fields)",
+    "serve.pattern": "a pattern lane came up — configured at boot, "
+                     "compile-on-demand spill, or journal replay (name, "
+                     "signature, source = config|spill|replay, workers, "
+                     "fleet_slots)",
+    "serve.stream": "a streaming /result?stream=1 connection closed "
+                    "(id, chunks, terminal, elapsed_s)",
     "serve.done": "one request answered and journaled terminal (id, "
                   "batch, platform, degraded)",
     "serve.failed": "one request failed terminally (id, reason, retries)",
     "serve.reject": "admission pushed back — 429 backpressure (id, "
-                    "reason = queue_full|probe_down, retry_after_s)",
+                    "reason = queue_full|probe_down|pattern_capacity|"
+                    "stream_capacity, retry_after_s)",
     "serve.replay": "journal replay at daemon start (requeued, terminal, "
                     "dropped_lines)",
     "serve.worker.launch": "worker slot launched a generation (slot, gen, "
@@ -231,6 +243,35 @@ METRICS: dict[str, tuple[str, str]] = {
     "serve.worker_restarts": ("counter",
                               "worker relaunches beyond each slot's first "
                               "generation"),
+    # Fleet-backed coalescing serving (ISSUE 13).
+    "serve.batch_occupancy": ("histogram",
+                              "filled community slots / fleet_slots per "
+                              "dispatched batch (1.0 = every slot of the "
+                              "warm fleet solve carried a request group)"),
+    "serve.coalesced_requests": ("histogram",
+                                 "requests folded into one dispatched "
+                                 "fleet batch (coalescing efficiency = "
+                                 "mean of this / solve)"),
+    "serve.batch_window_wait_s": ("histogram",
+                                  "oldest request's wait inside the "
+                                  "coalescing window at dispatch "
+                                  "(serve.batch_window_ms latency cost, "
+                                  "measured)"),
+    "serve.first_chunk_latency_s": ("histogram",
+                                    "accept -> first streamed chunk wall "
+                                    "seconds for /result?stream=1 "
+                                    "consumers"),
+    "serve.streams": ("counter",
+                      "streaming /result?stream=1 connections served"),
+    "serve.streams_rejected": ("counter",
+                               "streaming connections answered 429 past "
+                               "the serve.max_streams cap"),
+    "serve.spill_lanes": ("counter",
+                          "compile-on-demand pattern lanes created for "
+                          "unseen bucket-pattern signatures"),
+    "serve.patterns_active": ("gauge",
+                              "pattern lanes currently holding worker "
+                              "slots (default + configured + spill)"),
 }
 
 

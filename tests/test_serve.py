@@ -23,6 +23,7 @@ import pytest
 
 from dragg_tpu.config import default_config
 from dragg_tpu.resilience import faults
+from dragg_tpu.serve import patterns as patterns_mod
 from dragg_tpu.serve.daemon import ServeDaemon, serve_config
 from dragg_tpu.serve.journal import Journal, replay
 
@@ -117,6 +118,22 @@ def test_journal_torn_write_property(tmp_path):
             assert "b" in rep.terminal
         if whole_records >= 5:
             assert (rep.transition or {}).get("failure") == "TUNNEL_DOWN"
+
+
+def test_journal_pattern_record_replay(tmp_path):
+    """Pattern-lane provenance records fold into ReplayState.patterns
+    (newest wins) — the restart path that rebuilds spill lanes."""
+    path = str(tmp_path / "journal.jsonl")
+    j = Journal(path)
+    j.pattern("short", "h1[base:10]xC1", {"horizon_hours": 1}, "spill")
+    j.pattern("short", "h1[base:10]xC2",
+              {"horizon_hours": 1, "fleet_slots": 2}, "spill")
+    j.accepted("a", {"id": "a", "pattern": "short"})
+    j.close()
+    rep = replay(path)
+    assert set(rep.patterns) == {"short"}
+    assert rep.patterns["short"]["signature"] == "h1[base:10]xC2"
+    assert set(rep.pending) == {"a"}
 
 
 def test_journal_ignores_garbage_lines(tmp_path):
@@ -460,6 +477,68 @@ def test_request_deadline_expires_unserved_work(stub_daemon_factory):
     assert d.slots[0].gen >= 2
 
 
+def test_retry_survives_service_past_request_deadline(stub_daemon_factory):
+    """The request deadline governs QUEUED time only: when a worker dies
+    mid-service past it (a steps=N batch legitimately runs
+    batch_deadline_s·N), the requeued retry re-arms its queueing
+    deadline instead of expiring on the next tick — request_retries
+    stays reachable for exactly the long requests where a retry
+    matters."""
+    d, base = stub_daemon_factory(
+        "rearm", faults_spec="hang@serve_batch:1:once",
+        worker_stall_s=0.0, batch_deadline_s=2.0)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and _get(base, "/readyz")[0] != 200:
+        time.sleep(0.1)  # post only once dispatch is immediate
+    code, _ = _post(base, {"id": "long", "t": 0, "home": 0,
+                           "deadline_s": 1.0})
+    assert code == 202
+    outcomes = _wait_terminal(base, ["long"], timeout_s=40)
+    assert outcomes["long"]["status"] == "done"
+    assert d.slots[0].gen >= 2  # the first attempt really died
+
+
+def test_replayed_out_of_range_home_fails_terminally(tmp_path):
+    """A journal replayed against a SHRUNK community fails the
+    out-of-range request terminally at replay — it must never reach a
+    worker, where the unroutable home would crash the engine child and
+    burn its coalesced batch-mates' retries with it."""
+    sdir = str(tmp_path / "shrunk")
+    os.makedirs(sdir, exist_ok=True)
+    j = Journal(os.path.join(sdir, "journal.jsonl"))
+    j.accepted("big", {"id": "big", "t": 0, "home": 999})
+    j.accepted("ok", {"id": "ok", "t": 0, "home": 0})
+    j.close()
+    d = ServeDaemon(_serve_cfg(), sdir, platform="cpu", stub=True)
+    try:
+        assert "big" not in d.pending and "ok" in d.pending
+        d.start()
+        base = f"http://127.0.0.1:{d.port}"
+        outcomes = _wait_terminal(base, ["big", "ok"])
+        assert outcomes["big"]["status"] == "failed"
+        assert "outside lane" in outcomes["big"]["reason"]
+        assert outcomes["ok"]["status"] == "done"
+    finally:
+        d.stop(drain=False)
+
+
+def test_lane_config_pins_fleet_geometry():
+    """A base config reused from fleet TRAINING (communities = 8,
+    seed-strided DISTINCT communities) must not leak into a serving
+    lane: lane_config always pins [fleet] to the lane's own geometry
+    (identical copies, zero stride/offset)."""
+    cfg = default_config()
+    cfg["fleet"].update({"communities": 8, "seed_stride": 7,
+                         "weather_offset_hours": 3})
+    lane1 = patterns_mod.lane_config(cfg, {"fleet_slots": 1})
+    assert lane1["fleet"]["communities"] == 1
+    assert lane1["fleet"]["seed_stride"] == 0
+    assert lane1["fleet"]["weather_offset_hours"] == 0
+    lane4 = patterns_mod.lane_config(cfg, {"fleet_slots": 4})
+    assert lane4["fleet"]["communities"] == 4
+    assert lane4["fleet"]["seed_stride"] == 0
+
+
 def test_worker_pool_two_slots_share_the_queue(stub_daemon_factory):
     d, base = stub_daemon_factory("pool2", workers=2)
     ids = [f"w{i}" for i in range(8)]
@@ -471,6 +550,269 @@ def test_worker_pool_two_slots_share_the_queue(stub_daemon_factory):
     slots_used = {o["response"]["slot"] for o in outcomes.values()}
     assert len(d.slots) == 2
     assert slots_used <= {0, 1}
+
+
+# -------------------------------------------- fleet coalescing (ISSUE 13)
+def test_fleet_coalesces_rp_groups_into_one_batch(stub_daemon_factory):
+    """Three same-timestep requests with distinct reward prices fold
+    into ONE dispatched fleet batch — one group per community slot —
+    and a fourth request sharing a group's rp joins that group's slot.
+    The window is generous: all four fsync'd POSTs must land inside it
+    counted from the FIRST accept, or the daemon (correctly) dispatches
+    two batches and the single-batch assertion turns timing-flaky."""
+    d, base = stub_daemon_factory("coal", fleet_slots=4,
+                                  batch_window_ms=2000.0)
+    reqs = [{"id": "g0", "t": 5, "home": 0, "rp": 0.0},
+            {"id": "g1", "t": 5, "home": 1, "rp": 0.01},
+            {"id": "g2", "t": 5, "home": 0, "rp": 0.02},
+            {"id": "g3", "t": 5, "home": 4, "rp": 0.0}]
+    for r in reqs:
+        assert _post(base, r)[0] == 202
+    outcomes = _wait_terminal(base, [r["id"] for r in reqs])
+    resp = {rid: o["response"] for rid, o in outcomes.items()}
+    assert len({r["batch"] for r in resp.values()}) == 1, \
+        "distinct-rp groups were not coalesced into one fleet batch"
+    # One community slot per rp group; same-rp requests share a slot.
+    assert resp["g0"]["cslot"] == resp["g3"]["cslot"]
+    assert len({r["cslot"] for r in resp.values()}) == 3
+    # The stub answer is (t, home)-deterministic regardless of slot.
+    assert resp["g1"]["p_grid"] == 1.3
+    # Dispatch telemetry recorded the occupancy of the coalesced batch.
+    recs = [json.loads(line) for line in
+            open(os.path.join(d.serve_dir, "journal.jsonl"))]
+    assigned = [r for r in recs if r["state"] == "assigned"]
+    assert len(assigned) == 1 and len(assigned[0]["ids"]) == 4
+
+
+def test_fleet_slots_cap_groups_per_batch(stub_daemon_factory):
+    """More distinct rp groups than community slots split across
+    batches — a fleet solve never carries more groups than C."""
+    _d, base = stub_daemon_factory("cap", fleet_slots=2,
+                                   batch_window_ms=150.0)
+    ids = [f"c{i}" for i in range(4)]
+    for i, rid in enumerate(ids):
+        assert _post(base, {"id": rid, "t": 7, "home": i,
+                            "rp": 0.01 * i})[0] == 202
+    outcomes = _wait_terminal(base, ids)
+    batches = {o["response"]["batch"] for o in outcomes.values()}
+    assert len(batches) == 2
+    for o in outcomes.values():
+        assert o["response"]["cslot"] in (0, 1)
+
+
+def test_steps_validation(stub_daemon_factory):
+    _d, base = stub_daemon_factory("steps")
+    assert _post(base, {"id": "x1", "home": 0, "steps": 0})[0] == 400
+    assert _post(base, {"id": "x2", "home": 0, "steps": 10_000})[0] == 400
+    assert _post(base, {"id": "x3", "home": 0, "steps": "many"})[0] == 400
+    assert _post(base, {"id": "x4", "home": 0, "pattern": 7})[0] == 400
+
+
+# ------------------------------------------------- streaming (ISSUE 13)
+def test_streaming_result_chunks(stub_daemon_factory):
+    """/result?stream=1 answers NDJSON: one line per solved chunk (from
+    the events.jsonl tail the workers emit into), then the terminal
+    record; first-chunk delivery never waits for the full run."""
+    _d, base = stub_daemon_factory("stream")
+    assert _post(base, {"id": "s", "t": 0, "home": 2, "steps": 3})[0] == 202
+    with urllib.request.urlopen(base + "/result?id=s&stream=1",
+                                timeout=30) as r:
+        assert r.headers["Content-Type"] == "application/x-ndjson"
+        lines = [json.loads(ln) for ln in r.read().decode().splitlines()]
+    chunks = [ln for ln in lines if ln["kind"] == "chunk"]
+    assert [c["step"] for c in chunks] == [0, 1, 2]
+    assert chunks[0]["p_grid"] == 1.5   # stub (t=0, home=2)
+    assert chunks[2]["p_grid"] == 1.52  # stub (t=2, home=2)
+    final = lines[-1]
+    assert final["kind"] == "result" and final["status"] == "done"
+    assert final["response"]["steps"] == 3
+    assert final["response"]["p_grid"] == 1.52  # last chunk's fields
+    # The plain poll surface still answers, and unknown ids still 404.
+    assert _get(base, "/result?id=s")[1]["status"] == "done"
+    assert _get(base, "/result?id=nope&stream=1")[0] == 404
+
+
+# ------------------------------------- multi-pattern admission (ISSUE 13)
+def test_pattern_admission_spill_and_capacity(stub_daemon_factory):
+    d, base = stub_daemon_factory("pat", spill_patterns=1)
+    # Unknown lane NAME is a client error (names route, specs spill).
+    code, body = _post(base, {"id": "p0", "home": 0, "pattern": "nope"})
+    assert code == 400 and "unknown pattern lane" in body["error"]
+    # An inline spec for an unseen signature spills to a new lane.
+    code, _ = _post(base, {"id": "p1", "home": 0,
+                           "pattern": {"name": "short",
+                                       "horizon_hours": 1}})
+    assert code == 202
+    assert d.lanes["short"].source == "spill"
+    # The same signature (spelled without the name) reuses the lane.
+    assert _post(base, {"id": "p2", "home": 1,
+                        "pattern": {"horizon_hours": 1}})[0] == 202
+    assert sum(1 for ln in d.lanes.values() if ln.source == "spill") == 1
+    # A second distinct signature exceeds serve.spill_patterns → 429.
+    code, body = _post(base, {"id": "p3", "home": 0,
+                              "pattern": {"horizon_hours": 3}})
+    assert code == 429 and "pattern" in body["error"]
+    outcomes = _wait_terminal(base, ["p1", "p2"])
+    assert all(o["status"] == "done" for o in outcomes.values())
+    # Generation provenance is journaled (spill lanes only — config
+    # lanes are recoverable from config).
+    recs = [json.loads(line) for line in
+            open(os.path.join(d.serve_dir, "journal.jsonl"))]
+    pats = [r for r in recs if r["state"] == "pattern"]
+    assert [p["name"] for p in pats] == ["short"]
+    assert pats[0]["source"] == "spill" and "h1[" in pats[0]["signature"]
+    # Malformed specs are 400s and never journaled.
+    assert _post(base, {"id": "p4", "home": 0,
+                        "pattern": {"bogus_key": 1}})[0] == 400
+
+
+def test_spill_admission_guards_budget_and_size(stub_daemon_factory):
+    """Doomed inline specs never spend the bounded spill budget: an
+    oversize spec (the _INLINE_MAX / _INLINE_HOMES_MAX ceilings on
+    network-supplied values) and an out-of-range home are both 400s
+    BEFORE lane creation — no compile, no journaled pattern record —
+    and the budget stays available for the next valid spill."""
+    d, base = stub_daemon_factory("patguard", spill_patterns=1)
+    code, body = _post(base, {"id": "g0", "home": 0,
+                              "pattern": {"homes": {"total": 1_000_000}}})
+    assert code == 400 and "homes.total" in body["error"]
+    code, body = _post(base, {"id": "g1", "home": 0,
+                              "pattern": {"horizon_hours": 1,
+                                          "workers": 99}})
+    assert code == 400 and "workers" in body["error"]
+    code, body = _post(base, {"id": "g2", "home": 999,
+                              "pattern": {"horizon_hours": 1}})
+    assert code == 400 and "outside the serving community" in body["error"]
+    assert sum(1 for ln in d.lanes.values() if ln.source == "spill") == 0
+    recs = [json.loads(line) for line in
+            open(os.path.join(d.serve_dir, "journal.jsonl"))]
+    assert not [r for r in recs if r["state"] == "pattern"]
+    # The budget those rejections did NOT spend admits a valid spill.
+    assert _post(base, {"id": "g3", "home": 0,
+                        "pattern": {"horizon_hours": 1}})[0] == 202
+    assert _wait_terminal(base, ["g3"])["g3"]["status"] == "done"
+
+
+def test_spill_lane_rename_collision_never_overwrites(stub_daemon_factory):
+    """A client-chosen lane name can collide with the rename target
+    itself — the rename must search for a free suffix, never overwrite
+    an existing lane (an overwrite would leave the old lane's worker
+    slots dispatching batches shaped for the NEW lane's engine)."""
+    d, base = stub_daemon_factory("patcol", spill_patterns=4)
+    assert _post(base, {"id": "c0", "home": 0,
+                        "pattern": {"name": "x-3",
+                                    "horizon_hours": 1}})[0] == 202
+    assert _post(base, {"id": "c1", "home": 0,
+                        "pattern": {"name": "x",
+                                    "horizon_hours": 2}})[0] == 202
+    # A third signature also named 'x': the naive rename target
+    # f"x-{len(lanes)}" == "x-3" is TAKEN; it must land on a fresh name.
+    assert _post(base, {"id": "c2", "home": 0,
+                        "pattern": {"name": "x",
+                                    "horizon_hours": 3}})[0] == 202
+    spills = {n for n, ln in d.lanes.items() if ln.source == "spill"}
+    assert spills == {"x-3", "x", "x-4"}
+    # Every routed signature still points at a live lane that carries it.
+    for sig, name in d._sig_to_lane.items():
+        assert d.lanes[name].signature == sig
+    outcomes = _wait_terminal(base, ["c0", "c1", "c2"])
+    assert all(o["status"] == "done" for o in outcomes.values())
+
+
+def test_stream_capacity_answers_429(stub_daemon_factory):
+    """/result?stream=1 is bounded by serve.max_streams — past the cap
+    a stream answers 429 + Retry-After (each stream pins an HTTP thread
+    and an events-tail follower for up to its whole budget)."""
+    _d, base = stub_daemon_factory("nostream", max_streams=0,
+                                   retry_after_s=0.5)
+    assert _post(base, {"id": "s0", "home": 0})[0] == 202
+    assert _wait_terminal(base, ["s0"])["s0"]["status"] == "done"
+    code, body = _get(base, "/result?id=s0&stream=1")
+    assert code == 429 and "max_streams" in body["error"]
+    assert body["retry_after_s"] == 0.5
+    code, metrics = _get(base, "/metrics.json")
+    assert metrics["counters"]["serve.streams_rejected"] == 1.0
+    # The poll surface still answers, and unknown ids still 404 first.
+    assert _get(base, "/result?id=s0")[1]["status"] == "done"
+    assert _get(base, "/result?id=nope&stream=1")[0] == 404
+
+
+def test_spill_lane_rebuilt_on_restart(tmp_path):
+    """A journaled spill request replays onto a rebuilt lane: the
+    pattern record is the generation provenance of record."""
+    sdir = str(tmp_path / "spillre")
+    cfg = _serve_cfg()
+    d1 = ServeDaemon(cfg, sdir, platform="cpu", stub=True)
+    code, _ = d1.accept({"id": "sp", "home": 0,
+                         "pattern": {"name": "lane9", "horizon_hours": 1}})
+    assert code == 202
+    d1.stop(drain=False)
+    d2 = ServeDaemon(cfg, sdir, platform="cpu", stub=True)
+    try:
+        assert "lane9" in d2.lanes and d2.lanes["lane9"].source == "replay"
+        assert d2.pending["sp"]["lane"] == "lane9"
+        d2.start()
+        base = f"http://127.0.0.1:{d2.port}"
+        assert _wait_terminal(base, ["sp"])["sp"]["status"] == "done"
+    finally:
+        d2.stop(drain=False)
+
+
+# ------------------------- burst dedup property test (ISSUE 13 satellite)
+def test_burst_duplicate_posts_with_backpressure_property(stub_daemon_factory):
+    """Concurrent duplicate POSTs under queue backpressure: journal
+    replay stays correct — no request lost, none double-answered, every
+    duplicate answered from the terminal map without a second accepted
+    record (= without a re-solve)."""
+    d, base = stub_daemon_factory("burst", queue_max=6, retry_after_s=0.02)
+    ids = [f"u{i:02d}" for i in range(15)]
+    saw_429 = threading.Event()
+    errors: list[str] = []
+
+    def client(offset: int):
+        # Every client posts EVERY id, repeatedly — maximal duplication —
+        # retrying 429 backpressure with the advertised pacing.
+        for rep in range(2):
+            for rid in ids[offset:] + ids[:offset]:
+                body = {"id": rid, "t": int(rid[1:]) % 2,
+                        "home": int(rid[1:]) % 6}
+                for _attempt in range(80):
+                    code, _r = _post(base, body)
+                    if code in (200, 202):
+                        break
+                    if code == 429:
+                        saw_429.set()
+                        time.sleep(0.02)
+                    else:
+                        errors.append(f"{rid}: HTTP {code}")
+                        break
+                else:
+                    errors.append(f"{rid}: never admitted")
+
+    threads = [threading.Thread(target=client, args=(i * 3,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert saw_429.is_set(), "queue_max=6 never produced backpressure"
+    outcomes = _wait_terminal(base, ids)
+    assert all(o["status"] == "done" for o in outcomes.values())
+    # Journal property: exactly one accepted and one done per id —
+    # duplicates were answered from the terminal map, never re-journaled
+    # and never re-solved.
+    jpath = os.path.join(d.serve_dir, "journal.jsonl")
+    recs = [json.loads(line) for line in open(jpath)]
+    accepted = [r["id"] for r in recs if r["state"] == "accepted"]
+    done = [r["id"] for r in recs if r["state"] == "done"]
+    assert sorted(accepted) == sorted(ids), "lost or re-accepted ids"
+    assert sorted(done) == sorted(ids), "lost or double-answered ids"
+    rep = replay(jpath)
+    assert not rep.pending and set(rep.terminal) == set(ids)
+    # A late duplicate is idempotent: 200 with the recorded answer.
+    code, body = _post(base, {"id": ids[0]})
+    assert code == 200 and body["status"] == "done"
 
 
 def test_concurrent_submitters_all_terminate(stub_daemon_factory):

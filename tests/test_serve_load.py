@@ -1,0 +1,163 @@
+"""Load-harness + shared-schema tests (ISSUE 13 satellites).
+
+* the soak and the load generator share ONE request builder
+  (dragg_tpu/serve/loadgen.py) and ONE JSON-line envelope schema — both
+  pinned here, end-to-end via the real CLIs (stub workers, seconds);
+* the bench_trend ``serve`` series is hard-keyed: serve_load rows pair
+  only with serve_load rows and never gate against engine-throughput
+  history.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from dragg_tpu.serve import loadgen  # noqa: E402
+
+
+def _load_tool(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run_tool(args: list[str], timeout: int = 240) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(  # noqa: S603
+        [sys.executable] + args, cwd=ROOT, env=env, timeout=timeout,
+        capture_output=True, text=True)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    lines = [ln for ln in out.stdout.splitlines()
+             if ln.startswith("{")]
+    assert lines, out.stdout[-2000:]
+    return json.loads(lines[-1])
+
+
+# ------------------------------------------------------- shared builder
+def test_build_requests_default_reproduces_soak_trace():
+    """The historical soak trace shape is the builder's default output —
+    soak runs keep replaying the exact same stream they always did."""
+    reqs = loadgen.build_requests(8, 6)
+    assert [r["id"] for r in reqs] == [f"r{i:03d}" for i in range(8)]
+    for i, r in enumerate(reqs):
+        assert r["t"] == i % 3 and r["home"] == i % 6
+        assert ("state" in r) == (i % 4 == 0)
+        assert "rp" not in r and "steps" not in r and "pattern" not in r
+    # Seeded draws are deterministic and distribution knobs stick.
+    a = loadgen.build_requests(6, 4, rp_values=(0.0, 0.02), steps=3,
+                               pattern="short", seed=7)
+    b = loadgen.build_requests(6, 4, rp_values=(0.0, 0.02), steps=3,
+                               pattern="short", seed=7)
+    assert a == b
+    assert {r.get("rp") for r in a} == {None, 0.02}
+    assert all(r["steps"] == 3 and r["pattern"] == "short" for r in a)
+
+
+def test_envelope_schema_keys():
+    env = loadgen.result_envelope("x", ok=True, homes=4, requests=2,
+                                  metrics={}, violations=[], extra_key=1)
+    for key in loadgen.REQUIRED_KEYS:
+        assert key in env
+    assert env["schema"] == loadgen.SCHEMA and env["extra_key"] == 1
+
+
+# ------------------------------------------------ end-to-end CLI schema
+def test_serve_load_cli_emits_shared_schema(tmp_path):
+    r = _run_tool(["tools/serve_load.py", "--stub", "--rates", "16",
+                   "--duration-s", "1", "--root", str(tmp_path / "load")])
+    for key in loadgen.REQUIRED_KEYS:
+        assert key in r, key
+    assert r["schema"] == loadgen.SCHEMA
+    assert r["tool"] == "serve_load" and r["ok"] is True
+    assert r["metric"] == "serve_sat_rps" and r["value"] > 0
+    assert r["serve"].startswith("pool-C")
+    assert r["levels"] and r["levels"][0]["p99_s"] is not None
+    assert r["violations"] == []
+
+
+def test_serve_soak_cli_emits_shared_schema(tmp_path):
+    r = _run_tool(["tools/serve_soak.py", "--stub", "--scenario",
+                   "baseline", "--homes", "4", "--trace-len", "6",
+                   "--root", str(tmp_path / "soak")])
+    for key in loadgen.REQUIRED_KEYS:
+        assert key in r, key
+    assert r["schema"] == loadgen.SCHEMA
+    assert r["tool"] == "serve_soak" and r["ok"] is True
+
+
+# -------------------------------------------------- bench_trend series
+def test_bench_trend_serve_series_is_hard_keyed(tmp_path):
+    """serve rows pair with serve rows of the SAME pool geometry and
+    never against engine-throughput rows — the serve key is a hard key
+    with its own gate."""
+    bench_trend = _load_tool("bench_trend")
+
+    def row(ordinal, **kw):
+        base = dict(metric="serve_sat_rps", platform="cpu", solver="ipm",
+                    value=10.0, serve="pool-C8x1w")
+        base.update(kw)
+        p = tmp_path / f"BENCH_r{ordinal:02d}.json"
+        p.write_text(json.dumps(base))
+        return str(p)
+
+    arts = [
+        row(1, metric="engine", value=100.0, serve="none"),
+        row(2, metric="engine", value=100.0, serve="none"),
+        row(3, value=10.0),
+        row(4, value=10.5),
+        row(5, value=12.0, serve="pool-C1x1w"),  # different geometry
+    ]
+    entries = []
+    for i, p in enumerate(arts):
+        entries.extend(bench_trend.load_artifact(p, i + 1))
+    trend = bench_trend.build_trend(entries, 0.10)
+    keys = [(r["key"]["metric"], r["key"]["serve"]) for r in trend["rows"]]
+    assert ("engine", "none") in keys
+    assert ("serve_sat_rps", "pool-C8x1w") in keys
+    # The C1 row has no partner: no pair mixes pool geometries, and no
+    # pair mixes serve rows with engine rows.
+    assert len(keys) == 2
+    assert trend["n_regressions"] == 0
+    # A regressing serve pair gates like any other series.
+    arts.append(row(6, value=5.0))
+    entries = []
+    for i, p in enumerate(arts):
+        entries.extend(bench_trend.load_artifact(p, i + 1))
+    trend = bench_trend.build_trend(entries, 0.10)
+    assert trend["n_regressions"] == 1
+
+
+# ------------------------------------------------- events-tail follower
+def test_event_follower_contains_prefilter(tmp_path):
+    """``poll(contains=...)`` skips the JSON parse of non-matching lines
+    (each /result?stream=1 consumer follows the FULL events stream, so
+    the chunk filter must not pay for every other event kind) — and
+    filtered-out lines never resurface on later polls."""
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "serve.request", "id": "a"}) + "\n")
+        f.write(json.dumps({"event": "serve.chunk", "id": "a",
+                            "step": 0}) + "\n")
+    fo = loadgen.EventFollower(path)
+    recs = fo.poll(contains=b'"serve.chunk"')
+    assert [r["event"] for r in recs] == ["serve.chunk"]
+    # Incremental: only appended matches show up on the next poll.
+    with open(path, "a") as f:
+        f.write(json.dumps({"event": "serve.done", "id": "a"}) + "\n")
+        f.write(json.dumps({"event": "serve.chunk", "id": "a",
+                            "step": 1}) + "\n")
+    recs = fo.poll(contains=b'"serve.chunk"')
+    assert [(r["event"], r["step"]) for r in recs] == [("serve.chunk", 1)]
+    # Unfiltered polling still sees everything appended after that.
+    with open(path, "a") as f:
+        f.write(json.dumps({"event": "serve.failed", "id": "b"}) + "\n")
+    assert [r["event"] for r in fo.poll()] == ["serve.failed"]

@@ -9,7 +9,8 @@ hardware allows" needs round-over-round evidence, not one-off A/Bs).
 Comparability rules (CLAUDE.md "Round-5 semantic defaults"):
 
 * entries are compared ONLY within an identical hard key
-  ``(metric, platform, solver, semantics, data)`` — a semantics flip
+  ``(metric, platform, solver, semantics, data, communities, mix,
+  precision, rl, serve)`` — a semantics flip
   (relaxation vs integer) or environment flip (synthetic vs bundled)
   changes the measured workload, so rate deltas across them are not
   perf signals;
@@ -51,7 +52,7 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 HARD_KEY = ("metric", "platform", "solver", "semantics", "data",
-            "communities", "mix", "precision", "rl")
+            "communities", "mix", "precision", "rl", "serve")
 
 
 def _round_ordinal(path: str, fallback: int) -> int:
@@ -119,7 +120,7 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         return dict(source=source, ordinal=ordinal,
                     metric="metrics_snapshot", platform="?", solver="?",
                     semantics="?", data="?", communities=1, mix="?",
-                    precision="?", rl="none",
+                    precision="?", rl="none", serve="none",
                     bucketed=False,
                     fallback=False, degraded=None,
                     value=float(gauges.get("bench.rate_ts_per_s", 0.0)),
@@ -161,6 +162,14 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         # and never gate against MPC-baseline history.  Era default:
         # every pre-field artifact measured the baseline ("none").
         rl=str(rec.get("rl", "none")),
+        # Serving rows are a HARD key (ISSUE 13): a serve_load saturation
+        # rate (tools/serve_load.py — warm fleet-backed pool, SLO-gated
+        # latency curve) is a different workload than any engine
+        # throughput at the same shape, so "serve" rows form their own
+        # series — keyed by pool geometry (fleet slots × workers) — and
+        # never gate against engine-throughput history.  Era default:
+        # every pre-field artifact measured engines, not the pool.
+        serve=str(rec.get("serve", "none")),
         bucketed=bool(rec.get("bucketed", False)),
         fallback=bool(rec.get("fallback", False)),
         degraded=rec.get("degraded"),
@@ -286,8 +295,10 @@ def print_table(trend: dict, out=sys.stderr) -> None:
         prec = (f"/{k['precision']}"
                 if k.get("precision", "f32") != "f32" else "")
         rl = (f"/rl:{k['rl']}" if k.get("rl", "none") != "none" else "")
+        srv = (f"/serve:{k['serve']}"
+               if k.get("serve", "none") != "none" else "")
         print(f"  {k['metric']} [{k['platform']}/{k['solver']}/"
-              f"{k['semantics']}/{k['data']}{fleet}{mix}{prec}{rl}] "
+              f"{k['semantics']}/{k['data']}{fleet}{mix}{prec}{rl}{srv}] "
               f"{r['from_source']} → {r['to_source']}", file=out)
         print(f"    rate  {r['rate'][0]:.3f} → {r['rate'][1]:.3f} "
               f"({_fmt_pct(r['rate_delta'])}) {r['rate_verdict']}",

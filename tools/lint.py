@@ -19,8 +19,11 @@ what the stdlib can check:
   ``# device-call-ok: <why>`` marker — and no un-deadlined
   ``subprocess.run/check_output/check_call/call`` (a child that can
   hang forever defeats the supervision; pass ``timeout=``);
-* accept-loop discipline in `dragg_tpu/serve/` (ISSUE 7): the serving
-  daemon must stay interruptible — ``serve_forever()`` needs an explicit
+* accept-loop discipline in `dragg_tpu/serve/` plus the serving tools
+  `tools/serve_load.py` / `tools/serve_soak.py` (ISSUE 7; scope extended
+  by ISSUE 13 — the load harness runs an in-process daemon, so the same
+  deadline discipline applies): the serving daemon must stay
+  interruptible — ``serve_forever()`` needs an explicit
   ``poll_interval=`` (the default blocks shutdown on a quiet socket
   longer than the drain budget expects) and raw ``socket.accept()``
   loops are disallowed unless the line carries
@@ -129,7 +132,9 @@ _ACCEPT_MARKER = "# accept-timeout-ok:"
 
 def _is_serve_scope(path: str) -> bool:
     rel = os.path.relpath(path, ROOT)
-    return rel.startswith(os.path.join("dragg_tpu", "serve") + os.sep)
+    return (rel.startswith(os.path.join("dragg_tpu", "serve") + os.sep)
+            or rel in (os.path.join("tools", "serve_load.py"),
+                       os.path.join("tools", "serve_soak.py")))
 
 
 def check_accept_loop_discipline(tree, lines: list[str], rel: str) -> list[str]:

@@ -65,6 +65,15 @@ step "test/serve-soak-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   bash -c 'python tools/serve_soak.py --smoke | tee /tmp/serve_soak_smoke.json &&
            python -c "import json; r=json.load(open(\"/tmp/serve_soak_smoke.json\")); assert r[\"ok\"], r[\"violations\"]"'
 
+# --- job: serve-load smoke (ISSUE 13): the fleet-backed serving pool's
+#     SLO-gated load harness — a small C=4 fleet worker (real engine),
+#     ~20 requests at one rate; asserts the level passed its SLO
+#     (p99 < deadline) and zero journal anomalies (no lost, no
+#     double-answered)
+step "test/serve-load-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  bash -c 'python tools/serve_load.py --smoke | tee /tmp/serve_load_smoke.json &&
+           python -c "import json; r=json.load(open(\"/tmp/serve_load_smoke.json\")); assert r[\"ok\"] and not r[\"violations\"], r; lv=r[\"levels\"][0]; assert lv[\"p99_s\"] is not None and lv[\"p99_s\"] < r[\"metrics\"][\"slo_p99_s\"], lv"'
+
 # --- job: fleet smoke (ISSUE 8): 4 communities × 64 homes folded into one
 #     batched fleet engine (type buckets hold C·B_type homes under one
 #     compiled pattern set); asserts solve rate, comfort bands, finiteness,

@@ -1,0 +1,366 @@
+#!/usr/bin/env python
+"""Deterministic SLO-gated load harness for the serving daemon
+(ISSUE 13 acceptance harness — ROADMAP item 3's "latency-curve claim").
+
+Drives one in-process :class:`dragg_tpu.serve.ServeDaemon` (real engine
+workers by default; ``--stub`` for protocol-only) with a seeded,
+reproducible request stream at stepped request rates until the SLO
+breaks, and emits the full p50/p99-vs-req/s curve plus the saturation
+point as ONE JSON line (repo bench convention) in the shared
+``serve_bench_v1`` envelope (dragg_tpu/serve/loadgen.py — the soak
+emits the same schema).
+
+Per level: ``n = rate × duration`` requests are submitted open-loop on a
+deterministic schedule (reward prices cycle ``--rp-groups`` distinct
+values — distinct rp values form distinct coalescing groups, which is
+exactly what the fleet-backed pool folds into one warm C-slot solve);
+completion times come from the daemon's own ``serve.done`` events
+(events.jsonl tail — no poll traffic inflating the measurement).  A
+level passes its SLO when p99 ≤ ``--slo-p99``, nothing failed, nothing
+was lost, and rejects stayed under ``--max-reject-frac``.  The first
+breaching level ends the ladder; saturation = the last passing level's
+achieved req/s.
+
+The JSON line carries ``metric=serve_sat_rps`` and a ``serve`` series
+key, so ``tools/bench_trend.py`` folds it into its own hard-keyed
+``serve`` series (±10 % gate) that never gates against
+engine-throughput history.
+
+Usage::
+
+    python tools/serve_load.py --smoke          # CI stage (small fleet)
+    python tools/serve_load.py --stub --rates 4,8,16,32
+    python tools/serve_load.py --homes 6 --horizon-hours 2 \\
+        --fleet-slots 8 --rates 2,4,8,16 --duration-s 10
+
+Headline numbers go to ``docs/perf_notes.md`` per the repo convention.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dragg_tpu import telemetry  # noqa: E402
+from dragg_tpu.config import default_config  # noqa: E402
+from dragg_tpu.resilience.supervisor import assert_parent_has_no_jax  # noqa: E402
+from dragg_tpu.serve import ServeDaemon  # noqa: E402
+from dragg_tpu.serve import loadgen  # noqa: E402
+
+
+_log = loadgen.make_log("serve_load")
+_http = loadgen.http_call
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    """Nearest-rank percentile of an ascending list (None when empty)."""
+    if not sorted_vals:
+        return None
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def _hist_delta(snap0: dict, snap1: dict, name: str) -> tuple[int, float]:
+    """(count, sum) growth of one histogram between two snapshots."""
+    h0 = (snap0.get("histograms") or {}).get(name) or {}
+    h1 = (snap1.get("histograms") or {}).get(name) or {}
+    return (int((h1.get("count") or 0) - (h0.get("count") or 0)),
+            float((h1.get("sum") or 0.0) - (h0.get("sum") or 0.0)))
+
+
+def wait_ready(base: str, budget_s: float) -> bool:
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        try:
+            code, _ = _http("GET", base + "/readyz", timeout=5.0)
+            if code == 200:
+                return True
+        except OSError:
+            pass
+        time.sleep(0.25)
+    return False
+
+
+def run_level(base: str, events_path: str, reqs: list[dict], rate: float,
+              wait_budget_s: float) -> dict:
+    """Submit one level's requests open-loop at ``rate`` req/s and
+    measure accept→answer latency from the daemon's serve.done events."""
+    # tail_bytes=0 primes at EOF — prior levels' history is discarded
+    # WITHOUT reading it (an unbounded follower starts at byte 0 and
+    # would re-parse every earlier level's events each ladder step).
+    follower = loadgen.EventFollower(events_path, tail_bytes=0)
+    follower.poll()  # prime at EOF now, BEFORE the first submission
+    send_wall: dict[str, float] = {}
+    rejected: list[str] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+    done_wall: dict[str, float] = {}
+    failed: dict[str, str] = {}
+    ids = {r["id"] for r in reqs}
+    stop = threading.Event()
+
+    def watch():
+        # Completion times come from the serve.done event's wall-clock
+        # `t` (the envelope's `mono` is bus-relative) — 1 ms resolution,
+        # plenty against second-scale SLOs, and no /result poll traffic
+        # inflating the measurement.
+        while not stop.is_set():
+            for rec in follower.poll():
+                ev, rid = rec.get("event"), rec.get("id")
+                if rid not in ids:
+                    continue
+                if ev == "serve.done":
+                    done_wall[rid] = float(rec.get("t") or time.time())
+                elif ev == "serve.failed":
+                    failed[rid] = str(rec.get("reason"))
+            time.sleep(0.02)
+
+    watcher = threading.Thread(target=watch, daemon=True)
+    watcher.start()
+    t0 = time.monotonic()
+    t0_wall = time.time()
+    for i, req in enumerate(reqs):
+        target = t0 + i / rate
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        wall = time.time()
+        try:
+            code, _body = _http("POST", base + "/solve", req)
+        except OSError as e:
+            with lock:
+                errors.append(f"{req['id']}: {e!r}")
+            continue
+        if code in (200, 202):
+            send_wall[req["id"]] = wall
+        elif code == 429:
+            rejected.append(req["id"])
+        else:
+            errors.append(f"{req['id']}: HTTP {code}")
+    submit_span = time.monotonic() - t0
+    # Wait for every accepted id to terminate.
+    deadline = time.monotonic() + wait_budget_s
+    while time.monotonic() < deadline:
+        if all(rid in done_wall or rid in failed for rid in send_wall):
+            break
+        time.sleep(0.05)
+    stop.set()
+    watcher.join(timeout=2.0)
+    for rec in follower.poll():  # final sweep
+        if rec.get("id") in ids and rec.get("event") == "serve.done":
+            done_wall.setdefault(rec["id"],
+                                 float(rec.get("t") or time.time()))
+    lost = [rid for rid in send_wall
+            if rid not in done_wall and rid not in failed]
+    lats = sorted(max(0.0, done_wall[rid] - send_wall[rid])
+                  for rid in done_wall if rid in send_wall)
+    span = (max(done_wall.values()) - t0_wall) if done_wall else submit_span
+    return {
+        "rate_rps": rate,
+        "offered": len(reqs),
+        "accepted": len(send_wall),
+        "done": len(done_wall),
+        "rejected": len(rejected),
+        "failed": len(failed),
+        "lost": len(lost),
+        "errors": errors[:5],
+        "achieved_rps": round(len(done_wall) / max(1e-3, span), 3),
+        "p50_s": round(_percentile(lats, 0.50), 4) if lats else None,
+        "p99_s": round(_percentile(lats, 0.99), 4) if lats else None,
+        "max_s": round(lats[-1], 4) if lats else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized run: small fleet worker, one low rate, "
+                         "~20 requests (the acceptance gate)")
+    ap.add_argument("--stub", action="store_true",
+                    help="stub workers (protocol/coalescing only, no jax)")
+    ap.add_argument("--homes", type=int, default=6)
+    ap.add_argument("--horizon-hours", type=int, default=2)
+    ap.add_argument("--fleet-slots", type=int, default=4,
+                    help="community slots C per worker engine "
+                         "(1 = the round-11 single-shape pool)")
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--batch-window-ms", type=float, default=25.0)
+    ap.add_argument("--rates", default=None,
+                    help="comma-separated req/s ladder (default: "
+                         "2,4,8,16,32,64; smoke: one low rate)")
+    ap.add_argument("--duration-s", type=float, default=5.0,
+                    help="submission window per level")
+    ap.add_argument("--rp-groups", type=int, default=4,
+                    help="distinct reward-price values cycling through "
+                         "the stream (distinct rp = distinct coalescing "
+                         "groups)")
+    ap.add_argument("--steps", type=int, default=1,
+                    help="multi-chunk request length (streaming path)")
+    ap.add_argument("--t-window", type=int, default=1,
+                    help="distinct timesteps cycling through the stream "
+                         "(requests coalesce only within one timestep)")
+    ap.add_argument("--slo-p99", type=float, default=None,
+                    help="p99 latency SLO in seconds (default: 5 stub / "
+                         "30 engine)")
+    ap.add_argument("--max-reject-frac", type=float, default=0.01)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ready-budget-s", type=float, default=900.0,
+                    help="warmup budget before the first level (cold "
+                         "engine compile)")
+    ap.add_argument("--root", default=None,
+                    help="working directory (default: a fresh "
+                         "/tmp/dragg_serve_load_<pid>)")
+    args = ap.parse_args(argv)
+
+    assert_parent_has_no_jax()
+    slo = args.slo_p99 if args.slo_p99 is not None \
+        else (5.0 if args.stub else 30.0)
+    if args.rates:
+        rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    elif args.smoke:
+        rates = [4.0]
+    else:
+        rates = [2.0, 4.0, 8.0, 16.0, 32.0, 64.0]
+    duration = 5.0 if args.smoke and args.duration_s == 5.0 \
+        else args.duration_s
+    root = args.root or f"/tmp/dragg_serve_load_{os.getpid()}"
+    os.makedirs(root, exist_ok=True)
+
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = args.homes
+    cfg["community"]["homes_pv"] = max(1, args.homes // 6)
+    cfg["community"]["homes_battery"] = max(1, args.homes // 6)
+    cfg["community"]["homes_pv_battery"] = max(1, args.homes // 6)
+    cfg["home"]["hems"]["prediction_horizon"] = args.horizon_hours
+    cfg["tpu"]["compile_cache_dir"] = os.path.join(root, "compile_cache")
+    cfg["serve"].update({
+        "fleet_slots": max(1, args.fleet_slots),
+        "workers": max(1, args.workers),
+        "batch_window_ms": float(args.batch_window_ms),
+        "poll_s": 0.01,
+        "queue_max": 4096,
+        "request_deadline_s": max(600.0, 4 * slo),
+        "batch_deadline_s": 300.0,
+        "worker_stall_s": 300.0,
+    })
+
+    rp_values = tuple(round(0.01 * g, 4) for g in range(args.rp_groups))
+    _log(f"root={root} homes={args.homes} h={args.horizon_hours} "
+         f"C={args.fleet_slots} workers={args.workers} stub={args.stub} "
+         f"rates={rates} rp_groups={args.rp_groups} slo_p99={slo}s")
+
+    daemon = ServeDaemon(copy.deepcopy(cfg), root, platform="cpu",
+                         port=0, stub=args.stub, log=_log)
+    daemon.start()
+    base = f"http://127.0.0.1:{daemon.port}"
+    levels = []
+    all_ids: list[str] = []
+    violations: list[str] = []
+    warmup_s = None
+    try:
+        t_warm = time.monotonic()
+        if not wait_ready(base, args.ready_budget_s):
+            violations.append("worker never became ready inside the "
+                              "warmup budget")
+        warmup_s = round(time.monotonic() - t_warm, 2)
+        events_path = telemetry.events_path() or os.path.join(
+            root, telemetry.EVENTS_FILE)
+        for li, rate in enumerate(rates):
+            if violations:
+                break
+            n = max(1, int(round(rate * duration)))
+            if args.smoke:
+                n = max(n, 20)
+            reqs = loadgen.build_requests(
+                n, args.homes, prefix=f"l{li}r", t_window=args.t_window,
+                rp_values=rp_values, steps=args.steps,
+                seed=args.seed + li)
+            all_ids += [r["id"] for r in reqs]
+            snap0 = telemetry.snapshot()
+            level = run_level(base, events_path, reqs, rate,
+                              wait_budget_s=max(60.0, 6 * slo))
+            snap1 = telemetry.snapshot()
+            occ_n, occ_sum = _hist_delta(snap0, snap1,
+                                         "serve.batch_occupancy")
+            co_n, co_sum = _hist_delta(snap0, snap1,
+                                       "serve.coalesced_requests")
+            level["batches"] = occ_n
+            level["occupancy_mean"] = (round(occ_sum / occ_n, 4)
+                                       if occ_n else None)
+            level["coalesced_mean"] = (round(co_sum / co_n, 4)
+                                       if co_n else None)
+            breach = []
+            if level["p99_s"] is None or level["p99_s"] > slo:
+                breach.append(f"p99 {level['p99_s']}s > SLO {slo}s")
+            if level["failed"] or level["lost"]:
+                breach.append(f"{level['failed']} failed, "
+                              f"{level['lost']} lost")
+            if level["rejected"] > args.max_reject_frac * level["offered"]:
+                breach.append(f"{level['rejected']}/{level['offered']} "
+                              f"rejected")
+            level["slo_ok"] = not breach
+            level["breach"] = breach
+            levels.append(level)
+            _log(f"level {rate} req/s: done={level['done']} "
+                 f"p50={level['p50_s']}s p99={level['p99_s']}s "
+                 f"occ={level['occupancy_mean']} "
+                 f"coalesced={level['coalesced_mean']} "
+                 f"{'OK' if level['slo_ok'] else 'BREACH ' + '; '.join(breach)}")
+            if breach:
+                break
+    finally:
+        daemon.stop(drain=True)
+    violations += loadgen.journal_anomalies(
+        os.path.join(root, "journal.jsonl"), all_ids)
+
+    passing = [lv for lv in levels if lv["slo_ok"]]
+    sat = passing[-1]["achieved_rps"] if passing else 0.0
+    head = passing[-1] if passing else (levels[-1] if levels else {})
+    result = loadgen.result_envelope(
+        "serve_load",
+        ok=not violations and bool(passing),
+        homes=args.homes,
+        requests=len(all_ids),
+        metrics={
+            "saturation_rps": sat,
+            "p50_s": head.get("p50_s"),
+            "p99_s": head.get("p99_s"),
+            "occupancy_mean": head.get("occupancy_mean"),
+            "coalesced_mean": head.get("coalesced_mean"),
+            "warmup_s": warmup_s,
+            "slo_p99_s": slo,
+        },
+        violations=violations,
+        # bench_trend series fields: `serve` is the hard key that keeps
+        # these rows off the engine-throughput history.
+        metric="serve_sat_rps",
+        value=sat,
+        platform="stub" if args.stub else "cpu",
+        solver=str(cfg["home"]["hems"]["solver"]),
+        serve=f"pool-C{args.fleet_slots}x{args.workers}w"
+              f"{'-stub' if args.stub else ''}",
+        fleet_slots=args.fleet_slots,
+        workers=args.workers,
+        horizon_hours=args.horizon_hours,
+        steps=args.steps,
+        rp_groups=args.rp_groups,
+        batch_window_ms=args.batch_window_ms,
+        seed=args.seed,
+        smoke=bool(args.smoke),
+        stub=bool(args.stub),
+        levels=levels,
+    )
+    print(json.dumps(result, default=str))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,13 +43,12 @@ from __future__ import annotations
 
 import argparse
 import copy
+import functools
 import json
 import os
 import signal
 import sys
 import time
-import urllib.error
-import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -59,31 +58,20 @@ from dragg_tpu.resilience import faults  # noqa: E402
 from dragg_tpu.resilience.supervisor import assert_parent_has_no_jax  # noqa: E402
 from dragg_tpu.serve import ServeDaemon  # noqa: E402
 from dragg_tpu.serve import journal as journal_mod  # noqa: E402
+from dragg_tpu.serve import loadgen  # noqa: E402
 
 
-def _log(msg: str) -> None:
-    print(f"[serve_soak] {msg}", file=sys.stderr, flush=True)
-
-
-def _http(method: str, url: str, body=None, timeout: float = 10.0):
-    data = json.dumps(body).encode() if body is not None else None
-    req = urllib.request.Request(url, data=data, method=method)
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.status, json.loads(r.read())
-    except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read())
+_log = loadgen.make_log("serve_soak")
+_http = functools.partial(loadgen.http_call, timeout=10.0)
 
 
 def make_trace(n_requests: int, n_homes: int, path: str) -> list[dict]:
-    """The deterministic replayed trace: ids r00.., timesteps cycling a
-    small window, homes cycling the community, a few state overrides."""
-    trace = []
-    for i in range(n_requests):
-        req = {"id": f"r{i:03d}", "t": i % 3, "home": i % n_homes}
-        if i % 4 == 0:
-            req["state"] = {"temp_in": 18.0 + (i % 5)}
-        trace.append(req)
+    """The deterministic replayed trace — the load harness's request
+    builder with its defaults (ids r00.., timesteps cycling a small
+    window, homes cycling the community, a few state overrides): soak
+    and load replay the SAME distribution family by construction
+    (loadgen.build_requests; schema test pins the sharing)."""
+    trace = loadgen.build_requests(n_requests, n_homes)
     with open(path, "w") as f:
         for req in trace:
             f.write(json.dumps(req) + "\n")
@@ -448,11 +436,12 @@ def main(argv=None) -> int:
                 f"the cold start ({cold_ready_s}s) — compile cache not "
                 f"helping")
 
-    result = {
-        "tool": "serve_soak", "ok": not violations, "smoke": bool(args.smoke),
-        "homes": homes, "horizon_hours": horizon, "trace_len": len(trace),
-        "stub": bool(args.stub),
-        "metrics": {
+    result = loadgen.result_envelope(
+        "serve_soak",
+        ok=not violations,
+        homes=homes,
+        requests=len(trace),
+        metrics={
             "cold_ready_s": cold_ready_s,
             "first_action_latency_proxy_s": cold_ready_s,
             "sustained_rps_baseline":
@@ -461,9 +450,13 @@ def main(argv=None) -> int:
             "restart_warmup_s": crash.get("restart_warmup_s"),
             "restart_cache": crash.get("restart_cache"),
         },
-        "violations": violations,
-        "scenarios": reports,
-    }
+        violations=violations,
+        smoke=bool(args.smoke),
+        horizon_hours=horizon,
+        trace_len=len(trace),
+        stub=bool(args.stub),
+        scenarios=reports,
+    )
     print(json.dumps(result, default=str))
     return 0 if result["ok"] else 1
 
