@@ -1,0 +1,130 @@
+"""dragglint CLI — ``python -m dragg_tpu.analysis`` (ISSUE 14).
+
+Exit 0 iff no live error-severity findings.  ``tools/lint.py`` shims
+here so CI, the pre-commit habit, and muscle memory all keep working.
+
+    python -m dragg_tpu.analysis                 # whole repo + project rules
+    python -m dragg_tpu.analysis dragg_tpu/ops   # a subtree
+    python -m dragg_tpu.analysis --changed       # git-diff'd files only
+    python -m dragg_tpu.analysis --json out.json # findings artifact (CI)
+    python -m dragg_tpu.analysis --list-rules    # the DT0xx catalog
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+from dragg_tpu.analysis.core import (
+    BASELINE_NAME,
+    ROOT,
+    analyze,
+    iter_py_files,
+)
+from dragg_tpu.analysis.rules import catalog, make_rules
+
+
+def changed_py_files(root: str) -> list[str]:
+    """Working-tree .py files that differ from HEAD (staged, unstaged,
+    or untracked) — the fast pre-commit scope.  Deleted files drop out
+    naturally (they no longer exist to analyze)."""
+    proc = subprocess.run(
+        ["git", "-C", root, "status", "--porcelain"],
+        capture_output=True, text=True, timeout=30)
+    if proc.returncode != 0:
+        raise RuntimeError(f"git status failed: {proc.stderr.strip()}")
+    out = []
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:          # rename: analyze the new side
+            path = path.split(" -> ", 1)[1]
+        path = path.strip('"')
+        full = os.path.join(root, path)
+        if path.endswith(".py") and os.path.isfile(full):
+            out.append(full)
+    return sorted(set(out))
+
+
+def expand_paths(root: str, args_paths: list[str]) -> list[str] | None:
+    """Positional paths -> concrete .py files (dirs recurse); None means
+    the full default walk."""
+    if not args_paths:
+        return None
+    out: list[str] = []
+    for p in args_paths:
+        full = os.path.abspath(p)
+        if os.path.isdir(full):
+            out.extend(iter_py_files(full))
+        else:
+            out.append(full)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dragg_tpu.analysis",
+        description="dragglint: rule-based static analysis for JAX/"
+                    "device/journal discipline (docs/analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: whole repo)")
+    ap.add_argument("--root", default=ROOT,
+                    help="repo root (default: autodetected)")
+    ap.add_argument("--changed", action="store_true",
+                    help="analyze only git-changed .py files (fast "
+                         "pre-commit mode; project rules still run)")
+    ap.add_argument("--json", metavar="PATH", dest="json_out",
+                    help="write the findings document to PATH ('-' for "
+                         "stdout) — the CI artifact")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/{BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the committed baseline (show all debt)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for row in catalog():
+            print(f"{row['id']}  {row['severity']:<5}  {row['name']:<20} "
+                  f"scope={','.join(row['scope'])}")
+        return 0
+
+    if args.changed:
+        if args.paths:
+            ap.error("--changed and explicit paths are mutually "
+                     "exclusive — naming paths under --changed would "
+                     "silently skip the unchanged ones")
+        paths = changed_py_files(args.root)
+    else:
+        paths = expand_paths(args.root, args.paths)
+
+    res = analyze(root=args.root, paths=paths, rules=make_rules(),
+                  baseline_path=args.baseline,
+                  use_baseline=not args.no_baseline)
+
+    doc = res.to_dict()
+    if args.json_out == "-":
+        print(json.dumps(doc, indent=1))
+    else:
+        for f in res.findings:
+            if f.live:
+                print(f.render())
+        if args.json_out:
+            with open(args.json_out, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1)
+    for note in res.notes:
+        print(f"dragglint: note: {note}", file=sys.stderr)
+    s = doc["summary"]
+    print(f"dragglint: {res.files} files, {s['errors']} error(s), "
+          f"{s['warns']} warn(s), {s['baselined']} baselined, "
+          f"{s['suppressed']} suppressed", file=sys.stderr)
+    return res.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

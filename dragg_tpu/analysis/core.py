@@ -1,0 +1,481 @@
+"""dragglint core — single-pass AST dispatch, findings, suppressions,
+baseline (ISSUE 14).
+
+The framework that replaced ``tools/lint.py``'s seven ad-hoc checks:
+
+* every rule declares the AST node types it wants (``node_types``) and
+  one shared recursive walk dispatches each node to every interested
+  rule, maintaining the lexical scope stack (function / lambda / class)
+  the JAX rules need — ONE walk per file regardless of rule count (the
+  perf guard in tests/test_analysis.py pins the full-repo run);
+* stable rule IDs (``DT0xx``), per-rule severity (``error`` fails the
+  run, ``warn`` is reported only) and per-rule scope globs (fnmatch
+  against the repo-relative posix path; ``*`` crosses ``/``);
+* ONE suppression syntax — ``# dragg: disable=DT0xx[,DT0yy][, reason]``
+  on the offending line, or ``# dragg: disable-file=DT0xx[, reason]``
+  anywhere in the file — with the legacy per-check markers
+  (``# device-call-ok:`` etc.) grandfathered: still honored, but each
+  run warns once so downstream callers migrate;
+* a committed baseline (``.dragglint-baseline.json`` at the repo root):
+  entries ``{rule, path, count, reason}`` absorb up to ``count``
+  findings of ``rule`` in ``path``, so a new rule can land warn-first
+  against existing debt and ratchet — findings beyond the count stay
+  live errors, and a shrunk count is reported as a stale entry to
+  tighten.
+
+This module must stay importable with NO third-party dependencies (in
+particular: no jax) — the analyzer is exactly the tool you reach for
+when the axon tunnel is wedged and ``import jax`` would hang
+(CLAUDE.md gotchas).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BASELINE_NAME = ".dragglint-baseline.json"
+SKIP_DIRS = {".git", "__pycache__", ".cache", "outputs", "native/_build",
+             ".pytest_cache", ".claude"}
+
+SEVERITIES = ("error", "warn")
+
+# The canonical ID registry (rules.RULE_IDS re-exports it; the catalog
+# test pins docs/analysis.md + fixture coverage against it).  Lives here
+# so suppression parsing can validate IDs without importing the rules.
+KNOWN_RULE_IDS = ("DT001", "DT002", "DT003", "DT004", "DT005", "DT006",
+                  "DT007", "DT008", "DT009", "DT010", "DT011", "DT012",
+                  "DT013", "DT014", "DT015", "DT016")
+
+# Legacy per-check markers (rounds 6-14) — grandfathered so downstream
+# docs/snippets keep working, mapped onto the rule IDs that replaced
+# them.  ``# noqa`` keeps its historical meaning on import lines.
+LEGACY_MARKERS = {
+    "# device-call-ok:": "DT004",
+    "# accept-timeout-ok:": "DT006",
+    "# telemetry-name-ok:": "DT007",
+    "# precision-ok:": "DT008",
+    "# kkt-inv-ok:": "DT009",
+}
+_DISABLE = "# dragg: disable="
+_DISABLE_FILE = "# dragg: disable-file="
+
+
+@dataclass
+class Finding:
+    """One analyzer finding.  ``suppressed`` names the mechanism that
+    silenced it (None = live); live error-severity findings fail the
+    run."""
+
+    rule: str
+    severity: str
+    path: str          # repo-relative, posix separators
+    line: int
+    message: str
+    suppressed: str | None = None   # None | inline | file | legacy | baseline
+    reason: str = ""
+
+    @property
+    def live(self) -> bool:
+        return self.suppressed is None
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "severity": self.severity, "path": self.path,
+             "line": self.line, "message": self.message}
+        if self.suppressed:
+            d["suppressed"] = self.suppressed
+            if self.reason:
+                d["reason"] = self.reason
+        return d
+
+    def render(self) -> str:
+        tag = f" [{self.suppressed}]" if self.suppressed else ""
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"{self.severity}{tag}: {self.message}")
+
+
+class Rule:
+    """Base class for per-file rules.
+
+    Subclasses set ``id``/``name``/``severity``/``scope`` (and optionally
+    ``exclude``) and implement some of:
+
+    * ``visit(node, ctx)`` for each node whose type is in ``node_types``
+      (the shared walk calls it exactly once per node);
+    * ``begin_file(ctx)`` / ``end_file(ctx)`` around each file's walk
+      (per-file state lives on the rule instance — one analyzer run owns
+      one instance set, built fresh by :func:`make_rules`);
+    * ``on_lines(ctx)`` for purely textual checks (no AST needed).
+    """
+
+    id: str = "DT000"
+    name: str = "unnamed"
+    severity: str = "error"
+    scope: tuple[str, ...] = ("*",)
+    exclude: tuple[str, ...] = ()
+    node_types: tuple[type, ...] = ()
+
+    def applies(self, rel: str) -> bool:
+        return (any(fnmatch.fnmatchcase(rel, g) for g in self.scope)
+                and not any(fnmatch.fnmatchcase(rel, g) for g in self.exclude))
+
+    def configure(self, root: str) -> None:
+        """Called once by :func:`analyze` with the repo root under
+        analysis — rules that read repo files outside the walked set
+        (the telemetry registry) re-anchor here instead of silently
+        using the installation's own tree."""
+
+    def begin_file(self, ctx: FileContext) -> None:
+        pass
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        pass
+
+    def end_file(self, ctx: FileContext) -> None:
+        pass
+
+    def on_lines(self, ctx: FileContext) -> None:
+        pass
+
+
+class ProjectRule(Rule):
+    """Repo-level rule (cross-file consistency — home-type registry,
+    config docs).  Runs once per analysis, after the per-file walks."""
+
+    def run_project(self, root: str) -> list[Finding]:
+        return []
+
+
+@dataclass
+class FileContext:
+    """Everything a rule may need about the file under analysis."""
+
+    rel: str                       # repo-relative posix path
+    src: str
+    lines: list[str]
+    tree: ast.AST | None
+    findings: list[Finding] = field(default_factory=list)
+    scope_stack: list[ast.AST] = field(default_factory=list)
+
+    def line_text(self, lineno: int) -> str:
+        return self.lines[lineno - 1] if 0 < lineno <= len(self.lines) else ""
+
+    def report(self, rule: Rule, lineno: int, message: str) -> None:
+        self.findings.append(Finding(rule.id, rule.severity, self.rel,
+                                     lineno, message))
+
+    def enclosing_functions(self) -> list[ast.AST]:
+        """Innermost-last function/lambda scopes around the current node."""
+        return [n for n in self.scope_stack
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda))]
+
+
+@dataclass
+class Result:
+    """One analysis run: findings plus run-level notes (legacy-marker
+    warnings, stale baseline entries)."""
+
+    findings: list[Finding]
+    notes: list[str]
+    files: int
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings
+                if f.live and f.severity == "error"]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.errors else 0
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "files": self.files,
+            "findings": [f.to_dict() for f in self.findings],
+            "notes": list(self.notes),
+            "summary": {
+                "errors": len(self.errors),
+                "warns": len([f for f in self.findings
+                              if f.live and f.severity == "warn"]),
+                "suppressed": len([f for f in self.findings
+                                   if f.suppressed not in (None, "baseline")]),
+                "baselined": len([f for f in self.findings
+                                  if f.suppressed == "baseline"]),
+            },
+        }
+
+
+# --------------------------------------------------------------- suppression
+def parse_disable(comment_tail: str) -> tuple[set[str], str]:
+    """``DT004,DT005, reason text`` -> ({'DT004','DT005'}, 'reason text').
+    Tokens from the front that look like rule IDs are IDs; the remainder
+    is the free-form reason."""
+    ids: set[str] = set()
+    parts = comment_tail.split(",")
+    i = 0
+    while i < len(parts):
+        tok = parts[i].strip()
+        if len(tok) == 5 and tok[:2] == "DT" and tok[2:].isdigit():
+            ids.add(tok)
+            i += 1
+        else:
+            break
+    reason = ",".join(parts[i:]).strip()
+    return ids, reason
+
+
+@dataclass
+class Suppressions:
+    """Per-file suppression state parsed once from the source lines."""
+
+    by_line: dict[int, set[str]]        # inline disables
+    reasons: dict[int, str]
+    file_wide: set[str]                 # disable-file IDs
+    file_reasons: dict[str, str]
+    legacy_by_line: dict[int, str]      # lineno -> rule id (legacy marker)
+    malformed: list[tuple[int, str]]    # (lineno, detail) — DT016 feed
+
+    @classmethod
+    def parse(cls, lines: list[str]) -> Suppressions:
+        by_line: dict[int, set[str]] = {}
+        reasons: dict[int, str] = {}
+        file_wide: set[str] = set()
+        file_reasons: dict[str, str] = {}
+        legacy: dict[int, str] = {}
+        malformed: list[tuple[int, str]] = []
+        known = set(KNOWN_RULE_IDS)
+
+        def vet(ids: set[str], tail: str, lineno: int) -> set[str]:
+            """Drop unknown IDs and record malformed/unknown suppressions
+            (a typo'd ID is a silent no-op otherwise — the author thinks
+            the site is covered when it is not, DT016)."""
+            head = tail.split(",")[0].strip().lower()
+            if not ids:
+                # Not malformed: documentation DESCRIBING the syntax —
+                # the "DT0xx" placeholder (possibly "DT0xx[,DT0yy]…"),
+                # or a marker that ENDS a string literal (the parser's
+                # own constants, fixtures built by concatenation).
+                if head.startswith("dt0xx") or \
+                        tail.strip()[:1] in ("'", '"', "`"):
+                    return ids
+            # Scan EVERY comma token for id-like-but-invalid entries —
+            # a typo'd ID after a valid one ("DT004,DT05, reason") would
+            # otherwise fold silently into the reason text.
+            bad = [t.strip() for t in tail.split(",")
+                   if re.fullmatch(r"(?i)dt\d+", t.strip())
+                   and t.strip() not in known]
+            for t in bad:
+                malformed.append(
+                    (lineno, f"unknown or malformed rule ID {t}"))
+            if not ids and not bad:
+                malformed.append(
+                    (lineno, "suppression names no valid rule ID"))
+            return ids & known
+
+        for i, line in enumerate(lines, 1):
+            if _DISABLE_FILE in line:
+                tail = line.split(_DISABLE_FILE, 1)[1]
+                ids, reason = parse_disable(tail)
+                ids = vet(ids, tail, i)
+                file_wide |= ids
+                for rid in ids:
+                    file_reasons[rid] = reason
+            elif _DISABLE in line:
+                tail = line.split(_DISABLE, 1)[1]
+                ids, reason = parse_disable(tail)
+                by_line[i] = vet(ids, tail, i)
+                reasons[i] = reason
+            for marker, rid in LEGACY_MARKERS.items():
+                if marker in line:
+                    legacy[i] = rid
+        return cls(by_line, reasons, file_wide, file_reasons, legacy,
+                   malformed)
+
+    def apply(self, finding: Finding, line_text: str) -> str | None:
+        """Mark ``finding`` suppressed in place when a marker covers it;
+        returns 'legacy' when a legacy marker did (caller counts those
+        for the one-time migration warning)."""
+        rid = finding.rule
+        if rid in self.by_line.get(finding.line, ()):  # inline
+            finding.suppressed = "inline"
+            finding.reason = self.reasons.get(finding.line, "")
+        elif rid in self.file_wide:
+            finding.suppressed = "file"
+            finding.reason = self.file_reasons.get(rid, "")
+        elif self.legacy_by_line.get(finding.line) == rid:
+            finding.suppressed = "legacy"
+        elif rid == "DT002" and "noqa" in line_text:
+            # ``# noqa`` is NOT a legacy dragglint marker — it keeps its
+            # permanent flake8 meaning (the hosted CI runs flake8 on the
+            # same files), so it suppresses DT002 without the migration
+            # warning.
+            finding.suppressed = "noqa"
+        return finding.suppressed
+
+
+# ------------------------------------------------------------------ baseline
+def load_baseline(path: str) -> list[dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return []
+    return list(data.get("entries", []))
+
+
+def apply_baseline(findings: list[Finding], entries: list[dict],
+                   notes: list[str],
+                   analyzed: set[str] | None = None) -> None:
+    """Suppress up to ``count`` live findings per (rule, path) entry.
+    Findings beyond the count stay live (the ratchet); a count larger
+    than the live finding tally is reported stale so it gets tightened
+    — but ONLY when the entry's path was actually analyzed this run
+    (``analyzed``; None = everything): a --changed or subtree run that
+    skipped the file must not tell the developer to ratchet to zero."""
+    for e in entries:
+        rule, path = e.get("rule", ""), e.get("path", "")
+        try:
+            count = int(e.get("count", 0))
+        except (TypeError, ValueError):
+            notes.append(f"malformed baseline entry {rule} {path}: count "
+                         f"{e.get('count')!r} is not an integer — entry "
+                         f"ignored")
+            continue
+        reason = e.get("reason", "")
+        if not reason:
+            notes.append(f"baseline entry {rule} {path}: missing reason "
+                         f"(every baselined debt needs one)")
+        matched = 0
+        for f in findings:
+            if matched >= count:
+                break
+            if f.live and f.rule == rule and f.path == path:
+                f.suppressed = "baseline"
+                f.reason = reason
+                matched += 1
+        if matched < count and (analyzed is None or path in analyzed):
+            notes.append(
+                f"stale baseline entry: {rule} {path} allows {count} but "
+                f"only {matched} found — ratchet the count down")
+
+
+# ---------------------------------------------------------------- the walk
+def _dispatch_walk(tree: ast.AST, rules: list[Rule], ctx: FileContext) -> None:
+    """ONE recursive traversal dispatching each node to every interested
+    rule, maintaining ``ctx.scope_stack`` (class/function/lambda nesting)
+    so rules can ask about their lexical context."""
+    interest: dict[type, list[Rule]] = {}
+    for r in rules:
+        for t in r.node_types:
+            interest.setdefault(t, []).append(r)
+    if not interest:
+        return
+    scope_types = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+    stack = ctx.scope_stack
+
+    def visit(node: ast.AST) -> None:
+        for r in interest.get(type(node), ()):
+            r.visit(node, ctx)
+        scoped = isinstance(node, scope_types)
+        if scoped:
+            stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if scoped:
+            stack.pop()
+
+    visit(tree)
+
+
+def check_source(src: str, rel: str, rules: list[Rule]) -> list[Finding]:
+    """Run the per-file pipeline on one source string (the test fixtures'
+    entry point — ``rel`` decides which scope globs apply).  Inline /
+    file-level / legacy suppressions are applied; baseline is not."""
+    applicable = [r for r in rules
+                  if not isinstance(r, ProjectRule) and r.applies(rel)]
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [Finding("DT001", "error", rel, e.lineno or 1,
+                        f"syntax error: {e.msg}")]
+    ctx = FileContext(rel=rel, src=src, lines=lines, tree=tree)
+    for r in applicable:
+        r.begin_file(ctx)
+        r.on_lines(ctx)
+    _dispatch_walk(tree, applicable, ctx)
+    for r in applicable:
+        r.end_file(ctx)
+    sup = Suppressions.parse(lines)
+    for lineno, detail in sup.malformed:
+        ctx.findings.append(Finding(
+            "DT016", "error", rel, lineno,
+            f"{detail} — a broken suppression is a silent no-op; fix "
+            f"the ID list (# dragg: disable=DT0xx[, reason])"))
+    for f in ctx.findings:
+        sup.apply(f, ctx.line_text(f.line))
+    ctx.findings.sort(key=lambda f: (f.line, f.rule))
+    return ctx.findings
+
+
+def iter_py_files(root: str):
+    for base, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs
+                   if d not in SKIP_DIRS and not d.startswith(".")]
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(base, f)
+
+
+def analyze(root: str = ROOT, paths: list[str] | None = None,
+            rules: list[Rule] | None = None,
+            baseline_path: str | None = None,
+            use_baseline: bool = True) -> Result:
+    """Analyze ``paths`` (default: every .py under ``root``) and the
+    project-level rules; apply the committed baseline unless disabled."""
+    from dragg_tpu.analysis.rules import make_rules
+
+    rules = make_rules() if rules is None else rules
+    for r in rules:
+        r.configure(root)
+    notes: list[str] = []
+    findings: list[Finding] = []
+    legacy_seen: list[str] = []
+    analyzed: set[str] = set()
+    files = 0
+    for path in (paths if paths is not None else iter_py_files(root)):
+        rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+        analyzed.add(rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            notes.append(f"unreadable: {rel}: {e}")
+            continue
+        files += 1
+        file_findings = check_source(src, rel, rules)
+        for f in file_findings:
+            if f.suppressed == "legacy":
+                legacy_seen.append(f"{f.path}:{f.line}")
+        findings.extend(file_findings)
+    for r in rules:
+        if isinstance(r, ProjectRule):
+            findings.extend(r.run_project(root))
+    if legacy_seen:
+        notes.append(
+            f"legacy suppression markers honored at {len(legacy_seen)} "
+            f"site(s) (first: {legacy_seen[0]}) — migrate to "
+            f"'# dragg: disable=DT0xx, reason' (docs/analysis.md)")
+    if use_baseline:
+        bp = baseline_path or os.path.join(root, BASELINE_NAME)
+        apply_baseline(findings, load_baseline(bp), notes, analyzed)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Result(findings=findings, notes=notes, files=files)

@@ -1,0 +1,182 @@
+"""dragglint project rules — repo-level consistency checks that span
+files (ISSUE 14 satellite: migrated from tools/lint.py's home-type
+check and tests/test_homes_data.py's config-doc check; the tests now
+assert them through ``analysis.run_rules``).
+
+DT010 home-type co-registration: every ``homes.HOME_TYPES`` entry must
+      carry an ``ops/qp.TYPE_SPECS`` block spec, appear (quoted) in a
+      parity-bearing test file, and be documented in docs/config.md —
+      a scenario home type cannot ship half-wired (ISSUE 10).
+DT011 config-key documentation: docs/config.md documents every leaf
+      key of ``config.default_config`` within its own section (the
+      CLAUDE.md convention: "config keys must be documented — a test
+      enforces it"; the test now routes through this rule).
+
+Both rules read literal tables via ast where possible; DT011 imports
+``dragg_tpu.config`` (stdlib-only by construction) for the live default
+tree — still no jax anywhere on the analyzer's import path.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from dragg_tpu.analysis.core import Finding, ProjectRule
+
+
+def literal_names(path: str, var: str) -> list[str] | None:
+    """String members of a top-level tuple/dict literal assigned to
+    ``var`` in ``path`` (tuple -> elements, dict -> keys); None on parse
+    failure so the rule degrades quietly instead of crashing the run."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        for t in targets:
+            if not (isinstance(t, ast.Name) and t.id == var):
+                continue
+            v = node.value
+            if isinstance(v, ast.Tuple):
+                return [e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+            if isinstance(v, ast.Dict):
+                return [k.value for k in v.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+    return None
+
+
+class HomeTypeRule(ProjectRule):
+    """DT010 (docstring above)."""
+
+    id = "DT010"
+    name = "home-type-registry"
+    scope = ("dragg_tpu/homes.py", "dragg_tpu/ops/qp.py")
+
+    def run_project(self, root: str) -> list[Finding]:
+        home_types = literal_names(
+            os.path.join(root, "dragg_tpu", "homes.py"), "HOME_TYPES")
+        specs = literal_names(
+            os.path.join(root, "dragg_tpu", "ops", "qp.py"), "TYPE_SPECS")
+        if home_types is None or specs is None:
+            return []  # parse problems are reported per-file (DT001)
+        try:
+            with open(os.path.join(root, "docs", "config.md"),
+                      encoding="utf-8") as f:
+                doc = f.read()
+        except OSError:
+            doc = ""
+        # Parity evidence: the quoted type name appears in a test file
+        # whose source mentions parity (the test_qp_parity /
+        # test_bucketed / test_scenarios convention).
+        parity_src = ""
+        tests_dir = os.path.join(root, "tests")
+        try:
+            test_files = sorted(os.listdir(tests_dir))
+        except OSError:
+            test_files = []
+        for fn in test_files:
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(tests_dir, fn),
+                          encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            if "parity" in src.lower():
+                parity_src += src
+        out = []
+
+        def report(path, msg):
+            out.append(Finding(self.id, self.severity, path, 1, msg))
+
+        for t in home_types:
+            if t not in specs:
+                report("dragg_tpu/homes.py",
+                       f"HOME_TYPES entry {t!r} has no ops/qp.TYPE_SPECS "
+                       f"block spec — the bucketed engine cannot "
+                       f"shape-specialize it")
+            if f"`{t}`" not in doc and f"homes_{t}" not in doc:
+                report("docs/config.md",
+                       f"HOME_TYPES entry {t!r} undocumented — mention "
+                       f"`{t}` (or its homes_{t} count key)")
+            if f'"{t}"' not in parity_src and f"'{t}'" not in parity_src:
+                report("tests",
+                       f"HOME_TYPES entry {t!r} appears in no parity-"
+                       f"bearing test file — add objective-parity "
+                       f"coverage (tests/test_qp_parity.py pattern)")
+        return out
+
+
+class ConfigDocRule(ProjectRule):
+    """DT011 (docstring above).  ``config`` is injectable so the
+    negative self-test can run against a synthetic tree without
+    doctoring the live package."""
+
+    id = "DT011"
+    name = "config-doc"
+    scope = ("dragg_tpu/config.py", "docs/config.md")
+
+    # Distribution keys are documented as a family, not per key.
+    FAMILIES = ("home.hvac.", "home.wh.", "home.battery.", "home.pv.",
+                "home.ev.", "home.heat_pump.")
+
+    def __init__(self, config: dict | None = None):
+        self._config = config
+
+    def run_project(self, root: str) -> list[Finding]:
+        if self._config is None:
+            # Lazy: dragg_tpu.config is stdlib-only (tomllib + copy) —
+            # safe on the analyzer's jax-free import path.
+            from dragg_tpu.config import default_config
+
+            config = default_config()
+        else:
+            config = self._config
+        doc_path = os.path.join(root, "docs", "config.md")
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                doc = f.read()
+        except OSError:
+            return [Finding(self.id, self.severity, "docs/config.md", 1,
+                            "docs/config.md missing — every config key "
+                            "must be documented there")]
+
+        def leaves(d, pre=""):
+            for k, v in d.items():
+                if isinstance(v, dict):
+                    yield from leaves(v, pre + k + ".")
+                else:
+                    yield pre + k, k
+
+        # Match within the key's own section so a leaf name shared with
+        # an already-documented key elsewhere can't satisfy the check.
+        sections = {}
+        for block in doc.split("\n## ")[1:]:
+            title, _, body = block.partition("\n")
+            sections[title.strip().split()[0].strip("[]")] = body
+
+        def section_bodies(path):
+            top = path.split(".")[0]
+            for name, body in sections.items():
+                if name == top or name.startswith(top):
+                    yield body
+
+        out = []
+        for path, key in leaves(config):
+            if path.startswith(self.FAMILIES):
+                continue
+            if not any(f"`{key}`" in body for body in section_bodies(path)):
+                out.append(Finding(
+                    self.id, self.severity, "docs/config.md", 1,
+                    f"config key '{path}' undocumented — document "
+                    f"`{key}` in its [{path.split('.')[0]}] section"))
+        return out

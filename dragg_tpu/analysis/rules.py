@@ -1,0 +1,763 @@
+"""dragglint rules — every invariant the repo learned the hard way, as
+one catalog of DT0xx rules (ISSUE 14; full rationale per rule in
+docs/analysis.md).
+
+DT001 parse            every file parses (check-ast parity)
+DT002 unused-import    autoflake parity (``# noqa`` grandfathered)
+DT003 whitespace       no tabs in indent / trailing ws / missing EOF \\n
+DT004 device-call      no bare jax.devices()/local_devices()/
+                       default_backend() — a wedged axon tunnel hangs
+                       backend init (CLAUDE.md gotchas, rounds 2-4)
+DT005 subprocess-deadline  subprocess.run/check_* need timeout=
+DT006 accept-loop      serve_forever() needs poll_interval=; raw
+                       socket.accept() needs a suppression (ISSUE 7)
+DT007 telemetry-name   emits name central-registry literals (round 7)
+DT008 precision        dense contractions route through mxu_einsum in
+                       the dense-family solver files (ISSUE 11/round 14)
+DT009 kkt-inverse      no generic linalg.inv outside ops/ (round 10)
+DT012 traced-host-sync no .item()/float()/bool()/np.asarray/device_get
+                       in functions reachable from jit/scan roots — a
+                       host sync inside the fused step serializes the
+                       MXU hot loop (observatory zero-extra-syncs
+                       invariant, arxiv 2311.18056 MXU-nativeness)
+DT013 donation         jitted entry points carrying large state should
+                       donate the carry (round-12 HBM halving; the CPU
+                       sync caveat is the documented suppression)
+DT014 determinism      no wall-clock / global-stream randomness in the
+                       framework — seeds flow from config (fleet
+                       seed-stride contract, round 12/15)
+DT015 journal-fsync    record-writing paths in the serve journal and
+                       checkpoint spool fsync before acknowledging
+                       (the round-11 durability contract)
+
+Project rules DT010 (home-type co-registration) and DT011 (config-key
+documentation) live in dragg_tpu/analysis/project.py.
+
+No third-party imports here (core.py docstring: the analyzer must run
+while jax would hang).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from dragg_tpu.analysis.core import KNOWN_RULE_IDS, FileContext, Rule
+
+# Scope shorthands (fnmatch globs against repo-relative posix paths;
+# ``*`` crosses ``/``).  The framework-wide scope is the ISSUE-14
+# widening: tools/ + bench.py entry points AND the whole package.
+FRAMEWORK = ("dragg_tpu/*", "tools/*", "bench.py")
+
+
+class UnusedImportRule(Rule):
+    """DT002: a bound import never referenced (autoflake parity).  Names
+    quoted anywhere in the file (``__all__`` / getattr re-export idioms)
+    count as used; ``# noqa`` on the import line is grandfathered."""
+
+    id = "DT002"
+    name = "unused-import"
+    node_types = (ast.Import, ast.ImportFrom, ast.Name)
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._imported: dict[str, int] = {}
+        self._used: set[str] = set()
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                self._imported[a.asname or a.name.split(".")[0]] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    self._imported[a.asname or a.name] = node.lineno
+        else:
+            self._used.add(node.id)
+
+    def end_file(self, ctx: FileContext) -> None:
+        for name, lineno in sorted(self._imported.items(),
+                                   key=lambda kv: kv[1]):
+            if name in self._used or name == "annotations":
+                continue
+            if f'"{name}"' in ctx.src or f"'{name}'" in ctx.src:
+                continue
+            ctx.report(self, lineno, f"unused import '{name}'")
+
+
+class WhitespaceRule(Rule):
+    """DT003: trailing whitespace, tabs in indentation, newline at EOF."""
+
+    id = "DT003"
+    name = "whitespace"
+
+    def on_lines(self, ctx: FileContext) -> None:
+        for i, line in enumerate(ctx.lines, 1):
+            if line != line.rstrip():
+                ctx.report(self, i, "trailing whitespace")
+            if line[:len(line) - len(line.lstrip())].count("\t"):
+                ctx.report(self, i, "tab in indentation")
+        if ctx.src and not ctx.src.endswith("\n"):
+            ctx.report(self, len(ctx.lines), "no newline at end of file")
+
+
+class DeviceCallRule(Rule):
+    """DT004: bare jax.devices()/local_devices()/default_backend().  A
+    wedged axon tunnel makes backend init HANG (CLAUDE.md; rounds 2-4
+    outages) — device touches run in supervised/probed children, or
+    through the one sanctioned helper (resilience.devices)."""
+
+    id = "DT004"
+    name = "device-call"
+    scope = FRAMEWORK
+    node_types = (ast.Call,)
+    _CALLS = {"devices", "local_devices", "default_backend"}
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+                and fn.value.id == "jax" and fn.attr in self._CALLS):
+            ctx.report(self, node.lineno,
+                       f"bare jax.{fn.attr}() — probe/supervise it "
+                       f"(dragg_tpu/resilience) or route through "
+                       f"resilience.devices, the sanctioned helper")
+
+
+class SubprocessDeadlineRule(Rule):
+    """DT005: subprocess.run/check_output/check_call/call without
+    timeout= — an un-deadlined child can hang forever, defeating the
+    supervision layer (CLAUDE.md; the round-4 wedge burned hours)."""
+
+    id = "DT005"
+    name = "subprocess-deadline"
+    scope = FRAMEWORK
+    node_types = (ast.Call,)
+    _FNS = {"run", "check_output", "check_call", "call"}
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+                and fn.value.id == "subprocess" and fn.attr in self._FNS
+                and not any(kw.arg == "timeout" for kw in node.keywords)):
+            ctx.report(self, node.lineno,
+                       f"subprocess.{fn.attr}() without timeout= — an "
+                       f"un-deadlined child can hang forever (use "
+                       f"resilience.supervisor or pass a timeout)")
+
+
+class AcceptLoopRule(Rule):
+    """DT006: the serving daemon must stay interruptible —
+    serve_forever() needs an explicit poll_interval= and raw
+    socket.accept() loops need a socket timeout (ISSUE 7 drain
+    budget)."""
+
+    id = "DT006"
+    name = "accept-loop"
+    scope = FRAMEWORK
+    node_types = (ast.Call,)
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        if fn.attr == "serve_forever":
+            if not any(kw.arg == "poll_interval" for kw in node.keywords):
+                ctx.report(self, node.lineno,
+                           "serve_forever() without poll_interval= — a "
+                           "quiet socket must not outlive the drain "
+                           "budget")
+        elif fn.attr == "accept" and not node.args and not node.keywords:
+            ctx.report(self, node.lineno,
+                       "raw socket accept() — an un-timeouted accept "
+                       "loop cannot drain; set a socket timeout and "
+                       "suppress with the reason")
+
+
+class TelemetryNameRule(Rule):
+    """DT007: telemetry.emit/span/observe/inc/set_gauge must name a
+    central-registry entry as a string literal (round 7 — free strings
+    fragment the unified stream)."""
+
+    id = "DT007"
+    name = "telemetry-name"
+    scope = FRAMEWORK
+    node_types = (ast.Call,)
+    _FNS = {"emit": "EVENTS", "span": "METRICS", "observe": "METRICS",
+            "inc": "METRICS", "set_gauge": "METRICS"}
+
+    def __init__(self, registry_path: str | None = None):
+        self._explicit_path = registry_path
+        self._registry_path = registry_path or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "dragg_tpu", "telemetry", "registry.py")
+        self._registry: dict | None = None
+        self._loaded = False
+
+    def configure(self, root: str) -> None:
+        """Validate names against the ANALYZED tree's registry, not this
+        installation's (`--root` may point at another checkout); an
+        explicit constructor path still wins."""
+        if self._explicit_path is None:
+            self._registry_path = os.path.join(
+                root, "dragg_tpu", "telemetry", "registry.py")
+            self._loaded = False
+            self._registry = None
+
+    def _load_registry(self) -> dict | None:
+        """{'EVENTS': set, 'METRICS': set} parsed from the registry
+        module's literal tables via ast (no import — the analyzer stays
+        dependency-free)."""
+        if self._loaded:
+            return self._registry
+        self._loaded = True
+        try:
+            with open(self._registry_path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return None
+        names: dict = {"EVENTS": set(), "METRICS": set()}
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if (isinstance(t, ast.Name) and t.id in names
+                        and isinstance(node.value, ast.Dict)):
+                    names[t.id] |= {k.value for k in node.value.keys
+                                    if isinstance(k, ast.Constant)
+                                    and isinstance(k.value, str)}
+        self._registry = names
+        return names
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "telemetry" and fn.attr in self._FNS):
+            return
+        reg = self._load_registry()
+        if reg is None:
+            return
+        table = self._FNS[fn.attr]
+        arg = node.args[0] if node.args else None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in reg[table]:
+                ctx.report(self, node.lineno,
+                           f"telemetry.{fn.attr}({arg.value!r}) names "
+                           f"nothing in registry.{table} — register it "
+                           f"in dragg_tpu/telemetry/registry.py (and "
+                           f"docs/telemetry.md)")
+        else:
+            ctx.report(self, node.lineno,
+                       f"telemetry.{fn.attr}() with a computed name — "
+                       f"pass a registry literal, or suppress with the "
+                       f"reason if every runtime value is registered")
+
+
+class PrecisionRule(Rule):
+    """DT008: dense contractions in the solver families route through
+    ops/precision.mxu_einsum, which owns the f32/bf16x3 cast discipline
+    (ISSUE 11/round 14; rounds 2+9 measured hand-rolled dtypes
+    diverging).  Non-matmul einsums (a trace) get a reasoned
+    suppression."""
+
+    id = "DT008"
+    name = "precision"
+    scope = ("dragg_tpu/ops/*",)
+    exclude = ("dragg_tpu/ops/precision.py",)
+    node_types = (ast.Call,)
+    _CONTRACTIONS = {"einsum", "dot", "matmul", "tensordot", "dot_general"}
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute)
+                and fn.attr in self._CONTRACTIONS):
+            ctx.report(self, node.lineno,
+                       f"bare dense contraction ({fn.attr}) — route it "
+                       f"through ops/precision.mxu_einsum (which owns "
+                       f"the f32/bf16x3 cast policy), or suppress with "
+                       f"the reason if it is outside the dense-family "
+                       f"policy")
+
+
+class KktInverseRule(Rule):
+    """DT009: no direct linalg.inv outside dragg_tpu/ops/ — KKT-sized
+    operators go through the equilibrated, condition-checked route
+    (ops.reluqp.equilibrated_spd_inverse; round 10: a generic LU inverse
+    silently amplifies f32 conditioning error into the hot loop)."""
+
+    id = "DT009"
+    name = "kkt-inverse"
+    scope = FRAMEWORK
+    exclude = ("dragg_tpu/ops/*",)
+    node_types = (ast.Call,)
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "inv"
+                and isinstance(fn.value, ast.Attribute)
+                and fn.value.attr == "linalg"):
+            ctx.report(self, node.lineno,
+                       "direct linalg.inv outside ops/ — KKT-sized "
+                       "inverses must go through "
+                       "ops.reluqp.equilibrated_spd_inverse; suppress "
+                       "with the reason if the operand is provably not "
+                       "KKT-sized")
+
+
+def _jit_target(node: ast.Call):
+    """The function reference a ``jax.jit(...)``/``jit(...)`` call wraps
+    (first positional arg), or None."""
+    fn = node.func
+    is_jit = (isinstance(fn, ast.Name) and fn.id == "jit") or (
+        isinstance(fn, ast.Attribute) and fn.attr == "jit"
+        and isinstance(fn.value, ast.Name) and fn.value.id == "jax")
+    return node.args[0] if is_jit and node.args else None
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """Whether ``node`` is a reference to jax.jit / jit (for partial)."""
+    return ((isinstance(node, ast.Name) and node.id == "jit")
+            or (isinstance(node, ast.Attribute) and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax"))
+
+
+_TRACE_FNS = {"scan": 1, "while_loop": 2, "fori_loop": 1, "cond": 2,
+              "map": 1, "associative_scan": 1}
+# fn-name -> how many leading callable args to treat as traced roots
+# (while_loop/cond take (cond_fn, body_fn) / (true_fn, false_fn);
+# fori_loop's body is its THIRD arg — special-cased below).
+
+
+def _traced_fn_args(node: ast.Call) -> list[ast.AST]:
+    """Function-valued args of a lax.scan/while_loop/fori_loop/cond/map
+    call — every one of them is traced when the call executes."""
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr in _TRACE_FNS):
+        return []
+    base = fn.value
+    if not ((isinstance(base, ast.Name) and base.id in ("lax", "jax"))
+            or (isinstance(base, ast.Attribute) and base.attr == "lax")):
+        return []
+    if fn.attr == "fori_loop":
+        return list(node.args[2:3])
+    if fn.attr == "while_loop":
+        return list(node.args[:2])
+    if fn.attr == "cond":
+        return list(node.args[1:3])
+    return list(node.args[:1])
+
+
+class TracedHostSyncRule(Rule):
+    """DT012: no host syncs in traced code.  ``.item()``, ``jax.
+    device_get``, ``np.asarray`` and ``float()``/``bool()``/``int()`` of
+    a traced value inside any function reachable from a ``jax.jit`` /
+    ``lax.scan``-family root either fail the trace or (worse, via
+    callbacks/weak typing) silently force a device→host round trip per
+    step — exactly what the observatory's zero-extra-syncs invariant and
+    the fused fleet RL step (one jitted step, arxiv 2402.15932) forbid.
+
+    Reachability is per-file and name-level: jit/scan roots plus the
+    closure of same-file calls (``f(...)`` and ``self.f(...)``).
+    ``float()``/``bool()``/``int()`` are only flagged when the argument
+    names a PARAMETER of a reachable function (parameters of traced
+    functions are traced; config attributes like ``self.params.dt`` are
+    static and stay legal).  The rule is ``static_argnames``-aware:
+    names listed in any ``jax.jit(..., static_argnames=...)`` in the
+    file (directly or via a module-level tuple like the solvers'
+    ``_STATIC``) are Python values at trace time, so host reads of them
+    (``int(bank)``, ``np.asarray(pat.rows)``) are setup, not syncs."""
+
+    id = "DT012"
+    name = "traced-host-sync"
+    scope = ("dragg_tpu/engine.py", "dragg_tpu/ops/*", "dragg_tpu/rl/*")
+    node_types = (ast.Call, ast.FunctionDef, ast.AsyncFunctionDef,
+                  ast.Assign)
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._defs: dict[str, list[ast.AST]] = {}
+        self._roots: set[ast.AST] = set()
+        self._root_names: set[str] = set()
+        self._edges: list[tuple[ast.AST | None, str]] = []
+        self._candidates: list[tuple[ast.AST, list[ast.AST], str]] = []
+        self._static_names: set[str] = set()
+        self._module_tuples: dict[str, set[str]] = {}
+
+    def _record_static(self, call: ast.Call) -> None:
+        """Union the names in a ``static_argnames=`` kwarg (literal
+        tuple/str, or a module-level tuple constant by name)."""
+        for kw in call.keywords:
+            # static_argnums deliberately NOT accepted: its values are
+            # positional indices, which a name-keyed filter cannot map to
+            # parameters — claiming to honor it would silently not.
+            if kw.arg != "static_argnames":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                self._static_names.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                self._static_names |= {e.value for e in v.elts
+                                       if isinstance(e, ast.Constant)
+                                       and isinstance(e.value, str)}
+            elif isinstance(v, ast.Name):
+                self._static_names |= self._module_tuples.get(v.id, set())
+
+    @staticmethod
+    def _base_name(node: ast.AST) -> str | None:
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    # ---------------------------------------------------------- collection
+    def _enclosing(self, ctx: FileContext) -> ast.AST | None:
+        fns = ctx.enclosing_functions()
+        return fns[-1] if fns else None
+
+    def _mark_root_ref(self, ref: ast.AST) -> None:
+        if isinstance(ref, ast.Name):
+            self._root_names.add(ref.id)
+        elif isinstance(ref, ast.Attribute):      # jax.jit(self._chunk_entry)
+            self._root_names.add(ref.attr)
+        elif isinstance(ref, ast.Lambda):
+            self._roots.add(ref)
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, ast.Assign):
+            # Module-level tuple-of-str constants (the solvers' _STATIC).
+            if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                names = {e.value for e in node.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)}
+                if names:
+                    self._module_tuples[node.targets[0].id] = names
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._defs.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                if _is_jit_ref(dec) or (
+                        isinstance(dec, ast.Call)
+                        and (any(_is_jit_ref(a) for a in dec.args)
+                             or _is_jit_ref(dec.func))):
+                    self._roots.add(node)
+                if isinstance(dec, ast.Call):
+                    self._record_static(dec)
+            return
+        # ast.Call
+        if _is_jit_ref(node.func) or any(_is_jit_ref(a) for a in node.args):
+            self._record_static(node)
+        target = _jit_target(node)
+        if target is not None:
+            self._mark_root_ref(target)
+        for ref in _traced_fn_args(node):
+            self._mark_root_ref(ref)
+        enclosing = self._enclosing(ctx)
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            self._edges.append((enclosing, fn.id))
+        elif isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "self":
+            self._edges.append((enclosing, fn.attr))
+        # Host-sync candidates (scope stack copied: flagged iff any
+        # enclosing function ends up reachable).
+        stack = list(ctx.enclosing_functions())
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "item" and not node.args:
+                self._candidates.append((node, stack, ".item()"))
+            elif fn.attr == "device_get" and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "jax":
+                self._candidates.append((node, stack, "jax.device_get"))
+            elif fn.attr == "asarray" and isinstance(fn.value, ast.Name) \
+                    and fn.value.id in ("np", "numpy", "onp") and node.args:
+                self._candidates.append(
+                    (node, stack, f"{fn.value.id}.asarray"))
+        elif isinstance(fn, ast.Name) and fn.id in ("float", "bool", "int") \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Name):
+            self._candidates.append((node, stack, f"{fn.id}()"))
+
+    # ---------------------------------------------------------- resolution
+    @staticmethod
+    def _params(fn_node: ast.AST) -> set[str]:
+        a = fn_node.args
+        names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        return set(names)
+
+    def end_file(self, ctx: FileContext) -> None:
+        reachable: set[ast.AST] = set(self._roots)
+        pending = set(self._root_names)
+        resolved: set[str] = set()
+        while True:
+            for name in pending - resolved:
+                resolved.add(name)
+                reachable.update(self._defs.get(name, ()))
+            new_names = {name for enc, name in self._edges
+                         if enc in reachable} - resolved
+            if not new_names:
+                break
+            pending |= new_names
+        for node, stack, kind in self._candidates:
+            hit = [s for s in stack if s in reachable]
+            if not hit:
+                continue
+            if kind.endswith("()") and kind != ".item()":
+                # float()/bool()/int(): only traced when the argument is
+                # a parameter of a reachable enclosing function.
+                argname = node.args[0].id
+                if not any(argname in self._params(s) for s in hit):
+                    continue
+            # static_argnames values are Python at trace time — reading
+            # them on the host is setup, not a sync.
+            base = self._base_name(node.args[0]) if node.args else None
+            if base is not None and base in self._static_names \
+                    and kind != ".item()":
+                continue
+            ctx.report(self, node.lineno,
+                       f"{kind} on a value inside jit/scan-reachable "
+                       f"code — a host sync here serializes the fused "
+                       f"step (move it outside the traced region, or "
+                       f"suppress with the reason if the value is "
+                       f"provably static)")
+
+
+def _carries_state(params: set[str]) -> str | None:
+    for p in params:
+        low = p.lower()
+        if low in ("state", "carry", "cstate", "community_state") or \
+                low.endswith("_state") or low.endswith("_carry"):
+            return p
+    return None
+
+
+class DonationRule(Rule):
+    """DT013: a jitted step entry point whose signature carries large
+    state (a ``state``/``carry`` parameter) without ``donate_argnums`` /
+    ``donate_argnames`` re-allocates the carry every dispatch — donation
+    halves the carry HBM at the 100k-home target (round 12).  The
+    documented counter-case IS the suppression example: XLA:CPU executes
+    donated computations synchronously (round-12 caveat, engine.
+    run_chunk docstring), so CPU-path entries suppress with that
+    reason."""
+
+    id = "DT013"
+    name = "donation"
+    scope = ("dragg_tpu/*",)
+    node_types = (ast.Call, ast.FunctionDef, ast.AsyncFunctionDef)
+
+    def begin_file(self, ctx: FileContext) -> None:
+        # name -> [(def node, its enclosing-function stack)] — the stack
+        # disambiguates same-named nested defs (engine.py has two
+        # distinct `wrapped`s; resolving by bare name would cross-talk).
+        self._defs: dict[str, list[tuple[ast.AST, tuple[ast.AST, ...]]]] = {}
+        self._deferred: list[tuple[ast.Call, str, tuple[ast.AST, ...]]] = []
+
+    @staticmethod
+    def _donates(call: ast.Call) -> bool:
+        return any(kw.arg in ("donate_argnums", "donate_argnames")
+                   for kw in call.keywords)
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._defs.setdefault(node.name, []).append(
+                (node, tuple(ctx.enclosing_functions())))
+            for dec in node.decorator_list:
+                donated = isinstance(dec, ast.Call) and self._donates(dec)
+                if (_is_jit_ref(dec) or (isinstance(dec, ast.Call) and (
+                        _is_jit_ref(dec.func)
+                        or any(_is_jit_ref(a) for a in dec.args)))) \
+                        and not donated:
+                    p = _carries_state(TracedHostSyncRule._params(node))
+                    if p:
+                        ctx.report(self, node.lineno, self._msg(node.name, p))
+            return
+        target = _jit_target(node)
+        if target is None or self._donates(node):
+            return
+        stack = tuple(ctx.enclosing_functions())
+        if isinstance(target, ast.Name):
+            self._deferred.append((node, target.id, stack))
+        elif isinstance(target, ast.Attribute):
+            self._deferred.append((node, target.attr, stack))
+
+    def _msg(self, fn_name: str, param: str) -> str:
+        return (f"jit of '{fn_name}' carries state parameter '{param}' "
+                f"without donate_argnums — donation halves the carry "
+                f"HBM (round 12); suppress with the reason when the "
+                f"non-donated entry is deliberate (e.g. the XLA:CPU "
+                f"synchronous-donation caveat, engine.run_chunk)")
+
+    def end_file(self, ctx: FileContext) -> None:
+        for call, name, stack in self._deferred:
+            cands = self._defs.get(name, ())
+            if not cands:
+                continue
+            # Resolve to the lexically NEAREST def: the one sharing the
+            # longest enclosing-function prefix with the call site.
+            def shared(dstack):
+                n = 0
+                for a, b in zip(stack, dstack):
+                    if a is not b:
+                        break
+                    n += 1
+                return n
+            d, _ = max(cands, key=lambda c: shared(c[1]))
+            p = _carries_state(TracedHostSyncRule._params(d))
+            if p:
+                ctx.report(self, call.lineno, self._msg(name, p))
+
+
+class DeterminismRule(Rule):
+    """DT014: wall-clock and global-stream randomness in the framework
+    break run reproducibility — seeds must flow from config (community c
+    seeds ``random_seed + c*seed_stride``; fleet/RL runs are pinned
+    deterministic by tests).  Seeded constructors (``random.Random(s)``,
+    ``np.random.RandomState(s)``, ``default_rng``) and ``jax.random.*``
+    are the sanctioned routes.  Wall-clock protocol sites (heartbeats,
+    progress telemetry) suppress with the reason; ``time.monotonic`` is
+    always fine (elapsed measurement is not identity)."""
+
+    id = "DT014"
+    name = "determinism"
+    scope = ("dragg_tpu/*",)
+    exclude = ("dragg_tpu/telemetry/*", "dragg_tpu/analysis/*")
+    node_types = (ast.Call,)
+    _SEEDED = {"Random", "SystemRandom", "RandomState", "default_rng",
+               "Generator", "PCG64"}
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        base = fn.value
+        # time.time / time.time_ns
+        if isinstance(base, ast.Name) and base.id == "time" \
+                and fn.attr in ("time", "time_ns"):
+            ctx.report(self, node.lineno,
+                       f"time.{fn.attr}() in framework code — wall "
+                       f"clock is nondeterministic state; thread times "
+                       f"from config/telemetry or suppress with the "
+                       f"reason (heartbeat/progress protocol sites)")
+        # datetime.now / datetime.utcnow (datetime.X or datetime.datetime.X)
+        elif fn.attr in ("now", "utcnow", "today") and (
+                (isinstance(base, ast.Name) and base.id == "datetime")
+                or (isinstance(base, ast.Attribute)
+                    and base.attr == "datetime")):
+            ctx.report(self, node.lineno,
+                       f"datetime.{fn.attr}() in framework code — wall "
+                       f"clock is nondeterministic state; suppress with "
+                       f"the reason if this is presentation-only")
+        # random.X (module-level global stream)
+        elif isinstance(base, ast.Name) and base.id == "random" \
+                and fn.attr not in self._SEEDED:
+            ctx.report(self, node.lineno,
+                       f"random.{fn.attr}() uses the process-global "
+                       f"stream — seed an explicit random.Random(seed) "
+                       f"from config (fleet seed-stride contract)")
+        # np.random.X / numpy.random.X (module-level global stream)
+        elif isinstance(base, ast.Attribute) and base.attr == "random" \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id in ("np", "numpy") \
+                and fn.attr not in self._SEEDED:
+            ctx.report(self, node.lineno,
+                       f"np.random.{fn.attr}() uses the process-global "
+                       f"stream — use np.random.RandomState(seed)/"
+                       f"default_rng(seed) seeded from config")
+
+
+class JournalFsyncRule(Rule):
+    """DT015: the serve journal's durability contract (an acknowledged
+    request survives ANY process death) and the checkpoint spool's
+    resume contract both hinge on write+flush+fsync BEFORE the caller
+    proceeds — a rename without fsync can publish an empty file after
+    power loss.  Every function in the journal/spool scope that writes
+    records must fsync in the same function."""
+
+    id = "DT015"
+    name = "journal-fsync"
+    scope = ("dragg_tpu/serve/journal.py", "dragg_tpu/serve/spool.py",
+             "dragg_tpu/checkpoint.py")
+    node_types = (ast.Call,)
+    _WRITERS = {"write", "writelines", "savez", "savez_compressed"}
+
+    _MODULE = "<module>"   # holder for writes outside any function —
+    # module-init code in the durability files is held to the same
+    # contract (a blind spot here would let an un-fsync'd publish back)
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._writes: dict[object, int] = {}    # holder -> first lineno
+        self._fsyncs: set[object] = set()
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        fn = node.func
+        is_write = (isinstance(fn, ast.Attribute)
+                    and fn.attr in self._WRITERS) or (
+            isinstance(fn, ast.Attribute) and fn.attr == "dump"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in ("json", "pickle"))
+        is_fsync = isinstance(fn, ast.Attribute) and fn.attr == "fsync"
+        if not (is_write or is_fsync):
+            return
+        fns = ctx.enclosing_functions()
+        holder = fns[-1] if fns else self._MODULE
+        if is_fsync:
+            self._fsyncs.add(holder)
+        else:
+            self._writes.setdefault(holder, node.lineno)
+
+    def end_file(self, ctx: FileContext) -> None:
+        for holder, lineno in self._writes.items():
+            if holder not in self._fsyncs:
+                where = (holder if holder is self._MODULE
+                         else f"'{getattr(holder, 'name', '<lambda>')}'")
+                ctx.report(self, lineno,
+                           f"record write in {where} without os.fsync "
+                           f"before returning — a crash can lose an "
+                           f"acknowledged record (journal/checkpoint "
+                           f"durability contract)")
+
+
+def make_rules() -> list[Rule]:
+    """Fresh rule instances for one analysis run (rules hold per-file
+    state).  Project rules are appended so ``analyze`` runs them after
+    the per-file walks."""
+    from dragg_tpu.analysis.project import ConfigDocRule, HomeTypeRule
+
+    return [
+        UnusedImportRule(),
+        WhitespaceRule(),
+        DeviceCallRule(),
+        SubprocessDeadlineRule(),
+        AcceptLoopRule(),
+        TelemetryNameRule(),
+        PrecisionRule(),
+        KktInverseRule(),
+        TracedHostSyncRule(),
+        DonationRule(),
+        DeterminismRule(),
+        JournalFsyncRule(),
+        HomeTypeRule(),
+        ConfigDocRule(),
+    ]
+
+
+RULE_IDS = KNOWN_RULE_IDS
+
+
+def catalog() -> list[dict]:
+    """[{id, name, severity, scope}] for --list-rules and the docs
+    test (docs/analysis.md must document every registered rule).
+    DT001 (parse) and DT016 (bad-suppression) are framework-level —
+    emitted by core.check_source, not rule instances."""
+    rows = [{"id": "DT001", "name": "parse", "severity": "error",
+             "scope": ("*",)},
+            {"id": "DT016", "name": "bad-suppression", "severity": "error",
+             "scope": ("*",)}]
+    for r in make_rules():
+        rows.append({"id": r.id, "name": r.name, "severity": r.severity,
+                     "scope": r.scope})
+    rows.sort(key=lambda r: r["id"])
+    return rows

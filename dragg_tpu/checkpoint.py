@@ -58,7 +58,10 @@ def save_pytree(path: str, tree) -> None:
     leaves = jax.tree_util.tree_leaves(tree)
     arrays = {f"leaf_{i:04d}": to_host(l) for i, l in enumerate(leaves)}
     tmp = path + ".tmp.npz"
-    np.savez_compressed(tmp, **arrays)
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 
@@ -153,7 +156,10 @@ def save_pytree_local(path: str, tree, timestep: int) -> None:
     arrays = {f"leaf_{i:04d}": _local_block(l) for i, l in enumerate(leaves)}
     arrays["__timestep__"] = np.asarray(timestep, np.int64)
     tmp = f"{path}.tmp{jax.process_index()}.npz"
-    np.savez_compressed(tmp, **arrays)
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 
@@ -237,6 +243,8 @@ def save_progress(path: str, progress: dict) -> None:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(progress, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 

@@ -450,6 +450,9 @@ def serve(config=None, outputs_dir: str | None = None, port: int = 8050,
         f"({len(dash.ref.files)} runs)"
     )
     try:
-        httpd.serve_forever()
+        # Explicit poll_interval keeps Ctrl-C/shutdown responsive on a
+        # quiet socket (the serving daemon's DT006 discipline, applied
+        # repo-wide now the lint scope covers the dashboard too).
+        httpd.serve_forever(poll_interval=0.5)
     finally:
         httpd.server_close()

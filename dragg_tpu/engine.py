@@ -636,8 +636,8 @@ class Engine:
             # the bucket slices.)
             self.batch = type(batch)(*[jnp.asarray(np.asarray(f))
                                        for f in batch])
-        self._step_fn = jax.jit(self._step_entry)
-        self._chunk_fn = jax.jit(self._chunk_entry)
+        self._step_fn = jax.jit(self._step_entry)  # dragg: disable=DT013, single-step API contract — callers reuse the passed state (tests/tools replay it)
+        self._chunk_fn = jax.jit(self._chunk_entry)  # dragg: disable=DT013, the deliberately NON-donating twin — XLA:CPU executes donated computations synchronously (round-12 caveat, run_chunk docstring); run_chunk builds _chunk_fn_donate for accelerator paths
 
     def _build_buckets(self, batch, check_mask) -> None:
         """Materialize the per-type bucket contexts: slice the community
@@ -1936,7 +1936,7 @@ class Engine:
                         return self._solve(ctx, state[i], qp, factor[i],
                                            refresh)[0]
 
-                jitted = jax.jit(wrapped)
+                jitted = jax.jit(wrapped)  # dragg: disable=DT013, per-bucket attribution fns — the bench times each bucket against the SAME state/factor tuples; donation would invalidate them across buckets
                 return lambda state, t, rp, refresh, factor: jitted(
                     consts, state, t, rp, refresh, factor)
 

@@ -543,7 +543,7 @@ def _admm_impl(
         valid = ages[None, :] < cnt[:, None]                 # (B, K)
         G = jnp.transpose(hist_s - hist_t, (1, 0, 2)) * valid[..., None]  # (B, K, D)
         M = mxu_einsum("bkd,bjd->bkj", G, G, precision="f32")
-        gnorm = jnp.maximum(jnp.einsum("bkk->b", M), 1e-12)  # precision-ok: diagonal trace, not a matmul
+        gnorm = jnp.maximum(jnp.einsum("bkk->b", M), 1e-12)  # dragg: disable=DT008, diagonal trace, not a matmul
         M = M + (1e-8 * gnorm)[:, None, None] * jnp.eye(K_aa, dtype=dtype)
         # Invalid slots: unit diagonal, excluded from the sum-to-one row.
         inv = ~valid
@@ -557,7 +557,7 @@ def _admm_impl(
         rhs = jnp.zeros((B, K_aa + 1), dtype).at[:, -1].set(1.0)
         gamma = jnp.linalg.solve(kkt, rhs[..., None])[..., 0][:, :K_aa]  # (B, K)
         gamma = gamma * o
-        s_acc = jnp.einsum("bk,kbd->bd", gamma, hist_t)  # precision-ok: AA extrapolation weights (check-window work, historical default precision kept bit-exact)
+        s_acc = jnp.einsum("bk,kbd->bd", gamma, hist_t)  # dragg: disable=DT008, AA extrapolation weights (check-window work, historical default precision kept bit-exact)
         finite = jnp.all(jnp.isfinite(s_acc), axis=1)
         use = (cnt >= 2) & ~done & ~revert & finite
         s_next = jnp.where(use[:, None], s_acc, base)

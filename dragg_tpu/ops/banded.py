@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dragg_tpu.ops.precision import mxu_einsum
+
 MAX_BAND = 12  # fall back to the dense factorization beyond this bandwidth
 
 
@@ -215,7 +217,12 @@ def banded_explicit_inverse(plan: BandPlan, contrib: jnp.ndarray) -> jnp.ndarray
     Lb = banded_cholesky(Sb, bw)
     eye = jnp.broadcast_to(jnp.eye(m, dtype=contrib.dtype), (B, m, m))
     Linv = banded_forward_solve(Lb, eye, bw)           # (B, m, m), permuted
-    Sinv_p = jnp.einsum("bkm,bkn->bmn", Linv, Linv,
-                        precision=lax.Precision.HIGHEST)
+    # The one dense GEMM of the banded family routes through the policy
+    # module like every MXU contraction (DT008); the f32 default is the
+    # historical einsum(precision=HIGHEST) bit-for-bit.  Sinv formation
+    # feeds the reluqp hot loop, so it stays pinned f32 regardless of
+    # tpu.precision (the bf16x3 policy covers the ITERATION matmuls, not
+    # the operator build — ops/precision.py docstring).
+    Sinv_p = mxu_einsum("bkm,bkn->bmn", Linv, Linv)
     inv = plan.inv
     return Sinv_p[:, inv][:, :, inv]                   # back to original order

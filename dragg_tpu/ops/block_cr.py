@@ -45,6 +45,8 @@ solves (dragg/mpc_calc.py:141-145), batched community-wide.
 
 from __future__ import annotations
 
+# dragg: disable-file=DT008, block-CR's (bw,bw) block einsums are outside the round-14 dense-family policy (it covers the reluqp/admm iteration matmuls); repinning them to HIGHEST would change on-TPU numerics without a recorded measurement — revisit with an on-chip A/B (docs/perf_notes.md convention)
+
 import jax
 import jax.numpy as jnp
 

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dragg_tpu.ops import pallas_band
+from dragg_tpu.ops.precision import mxu_einsum
 
 
 def _auto_blocks(m: int, n: int, itemsize: int, B: int,
@@ -286,14 +287,19 @@ def reference_window(A, Sinv, Dinv, w, qs, bs, ls, us, rho, x, z, nu, y,
     """Pure-lax mirror of the fused kernel — the normative spelling of
     one check window (same math and operation order as ops/reluqp.py's
     ``one_iter`` + ``residuals``, restated here so the kernel has an
-    in-module reference the interpreter-mode tests pin it against)."""
-    prec = lax.Precision.HIGHEST
+    in-module reference the interpreter-mode tests pin it against).
+
+    Contractions route through ``mxu_einsum`` like the reluqp path they
+    mirror (DT008); its f32 default is the historical
+    ``einsum(precision=HIGHEST)`` bit-for-bit, and the fused kernel is
+    f32-only by contract (iter_kernel='pallas' rejects bf16x3), so the
+    mirror stays pinned f32 too."""
 
     def mv(v):
-        return jnp.einsum("bmn,bn->bm", A, v, precision=prec)
+        return mxu_einsum("bmn,bn->bm", A, v)
 
     def mvt(v):
-        return jnp.einsum("bmn,bm->bn", A, v, precision=prec)
+        return mxu_einsum("bmn,bm->bn", A, v)
 
     rho_c = rho[:, None]
 
@@ -301,7 +307,7 @@ def reference_window(A, Sinv, Dinv, w, qs, bs, ls, us, rho, x, z, nu, y,
         x, z, nu, y = carry
         rhs = sigma * x - qs + w * (rho_c * z - y)
         t = mv(Dinv * rhs) - bs
-        nu_t = jnp.einsum("bmn,bn->bm", Sinv, t, precision=prec)
+        nu_t = mxu_einsum("bmn,bn->bm", Sinv, t)
         x_t = Dinv * (rhs - mvt(nu_t))
         z_t = w * x_t
         x_new = alpha * x_t + (1.0 - alpha) * x

@@ -31,10 +31,17 @@ def make_mesh(n_devices: int | None = None, axis_name: str = HOMES_AXIS,
     parallelism taxonomy for this workload (SURVEY.md §2.3: TP/PP/SP/EP are
     structurally absent in the reference; DP-over-homes is the core
     strategy).  Multi-host pod slices extend the same axis over DCN —
-    ``jax.devices()`` already enumerates all processes' devices.
+    the device enumeration already spans all processes.
+
+    Device enumeration routes through the sanctioned helper
+    (resilience.devices — never a bare ``jax.devices()``, CLAUDE.md):
+    mesh construction only runs on device-committed paths (supervised
+    children, engine builds), which is exactly that helper's contract.
     """
     if devices is None:
-        devices = jax.devices()
+        from dragg_tpu.resilience.devices import device_list
+
+        devices = device_list()
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (axis_name,))

@@ -119,7 +119,7 @@ class Reformat:
         self.sample_home: str | None = None
         self._results_cache: dict = {}
         self.save_path = os.path.join(
-            self.outputs_dir, "images", datetime.now().strftime("%m%dT%H%M%S")
+            self.outputs_dir, "images", datetime.now().strftime("%m%dT%H%M%S")  # dragg: disable=DT014, presentation-only image dir stamp
         )
 
     # -------------------------------------------------- parameter spaces

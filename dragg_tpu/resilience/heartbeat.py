@@ -43,7 +43,7 @@ def beat(progress: dict | None = None) -> None:
                        **({"progress": progress} if progress else {}))
     except Exception:
         pass
-    payload = {"t": time.time(), **({"progress": progress} if progress else {})}
+    payload = {"t": time.time(), **({"progress": progress} if progress else {})}  # dragg: disable=DT014, heartbeat protocol IS wall-clock — cross-process stall age
     tmp = f"{path}.tmp{os.getpid()}"
     try:
         with open(tmp, "w") as f:
@@ -63,7 +63,7 @@ def read(path: str) -> tuple[float | None, dict | None]:
     try:
         with open(path) as f:
             payload = json.load(f)
-        return max(0.0, time.time() - float(payload["t"])), \
-            payload.get("progress")
+        age = max(0.0, time.time() - float(payload["t"]))  # dragg: disable=DT014, heartbeat protocol IS wall-clock — cross-process stall age
+        return age, payload.get("progress")
     except (OSError, ValueError, KeyError):
         return None, None

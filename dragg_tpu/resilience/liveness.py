@@ -130,7 +130,7 @@ def check_liveness(timeout_s: float = 60.0,
                    elapsed_s=report.elapsed_s)
     telemetry.observe("probe.elapsed_s", report.elapsed_s)
     if report.kind is not None:
-        telemetry.emit("failure." + report.kind,  # telemetry-name-ok: kind from taxonomy.FAILURE_KINDS, each registered literally
+        telemetry.emit("failure." + report.kind,  # dragg: disable=DT007, kind from taxonomy.FAILURE_KINDS, each registered literally
                        source="probe", detail=report.detail)
     return report
 

@@ -188,7 +188,7 @@ def run_supervised(argv: list[str], deadline_s: float, *,
     t0 = time.monotonic()
     # Seed the heartbeat at launch so stall time is measured from start.
     with open(hb_path, "w") as f:
-        json.dump({"t": time.time()}, f)
+        json.dump({"t": time.time()}, f)  # dragg: disable=DT014, heartbeat seed file — the stall-kill protocol is wall-clock
     timed_out = stalled = False
     try:
         proc = subprocess.Popen(argv, env=child_env, cwd=cwd,
@@ -261,7 +261,7 @@ def run_supervised(argv: list[str], deadline_s: float, *,
     if failure is not None:
         # The taxonomy kind IS the event type — wedge forensics grep one
         # stream for "failure." instead of three ad-hoc transcripts.
-        telemetry.emit("failure." + failure,  # telemetry-name-ok: kind from taxonomy.FAILURE_KINDS, each registered literally
+        telemetry.emit("failure." + failure,  # dragg: disable=DT007, kind from taxonomy.FAILURE_KINDS, each registered literally
                        source="supervisor", label=label or argv[0],
                        rc=rc, elapsed_s=round(elapsed, 3),
                        progress=progress)

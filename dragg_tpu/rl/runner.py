@@ -143,7 +143,7 @@ def run_rl_agg(agg) -> None:
     )
 
     @jax.jit
-    def chunk(consts, carry, ts):
+    def chunk(consts, carry, ts):  # dragg: disable=DT013, carry is host-snapshotted for the checkpoint AFTER dispatch and re-used by try_resume templates; donation pending a measured A/B (round-12 CPU caveat: donated dispatch runs synchronously)
         # The factor cache enters/leaves here so the checkpointed carry
         # (and try_resume's template) never includes it.  Engine constants
         # arrive as arguments via the same _bound mechanism as
@@ -160,7 +160,7 @@ def run_rl_agg(agg) -> None:
     agg.log.logger.info(
         f"Performing RL AGG run for horizon: {config['home']['hems']['prediction_horizon']}"
     )
-    agg.start_time = time.time()
+    agg.start_time = time.time()  # dragg: disable=DT014, wall-clock elapsed accounting for progress telemetry
     case_dir = os.path.join(agg.run_dir, agg.case)
     carry, t = agg.try_resume((cstate, acarry, env))
     if agg.resumed_from is not None:
@@ -255,11 +255,11 @@ def run_rl_simplified(agg) -> None:
         return (acarry, new_env), (rec, load, cost, rp, env.setpoint)
 
     @jax.jit
-    def run(carry, ts):
+    def run(carry, ts):  # dragg: disable=DT013, simplified-response carry is tiny (agent params + env scalars) and re-read for logging; donation buys nothing here
         return lax.scan(step, carry, ts)
 
     agg.log.logger.info("Performing RL simplified-response run")
-    agg.start_time = time.time()
+    agg.start_time = time.time()  # dragg: disable=DT014, wall-clock elapsed accounting for progress telemetry
     (acarry, env), (recs, loads, costs, rps, sps) = run(
         (agent.carry, env0), jnp.arange(agg.num_timesteps)
     )

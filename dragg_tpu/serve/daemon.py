@@ -766,7 +766,7 @@ class ServeDaemon:
             return
         kind = slot.verdict(timed_out=ctx.get("timed_out", False),
                             stalled=ctx.get("stalled", False))
-        telemetry.emit("failure." + kind,  # telemetry-name-ok: kind from taxonomy.FAILURE_KINDS, each registered literally
+        telemetry.emit("failure." + kind,  # dragg: disable=DT007, kind from taxonomy.FAILURE_KINDS, each registered literally
                        source="serve", label=f"w{slot.slot} gen={slot.gen}",
                        rc=rc)
         telemetry.emit("serve.worker.exit", slot=slot.slot, gen=slot.gen,

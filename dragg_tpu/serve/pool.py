@@ -84,7 +84,7 @@ class WorkerSlot:
         sdir = spool.slot_dir(self.spool_dir, self.slot)
         fd, self.hb_path = tempfile.mkstemp(prefix=f"hb-{self.gen}-", dir=sdir)
         os.close(fd)
-        hb_seed = {"t": time.time()}
+        hb_seed = {"t": time.time()}  # dragg: disable=DT014, heartbeat seed — the worker stall-kill protocol is wall-clock
         with open(self.hb_path, "w") as f:
             import json
 

@@ -69,6 +69,8 @@ def write_epoch(spool: str, token: str) -> None:
     tmp = f"{epoch_path(spool)}.tmp{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
         f.write(token)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, epoch_path(spool))
 
 

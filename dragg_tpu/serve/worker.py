@@ -178,7 +178,7 @@ class EngineRunner:
         self._out_cols = np.asarray(self.engine.real_home_cols)
         import jax
 
-        self.platform = jax.default_backend()  # device-call-ok: serving worker is the supervised jax child
+        self.platform = jax.default_backend()  # dragg: disable=DT004, serving worker is the supervised jax child
 
     def _build_engine(self, batch, env, config, fleet):
         """Mirror the Aggregator's mesh decision: multi-device processes

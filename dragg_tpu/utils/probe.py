@@ -89,7 +89,7 @@ def probe_tpu(timeout_s: float = 60.0) -> tuple[bool, str]:
 def append_probe_log(path: str, alive: bool, detail: str) -> str:
     """Append one timestamped verdict line to the probe transcript (the
     committed outage/uptime record round 3 lacked); returns the line."""
-    stamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+    stamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")  # dragg: disable=DT014, outage transcript wall-clock stamp (presentation-only)
     line = f"{stamp} {'LIVE' if alive else 'DOWN'} {detail}"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "a") as f:

@@ -103,42 +103,11 @@ class TestData:
 
 def test_config_reference_doc_covers_all_keys():
     """docs/config.md documents every leaf key in default_config — a new
-    knob without documentation fails here."""
-    import os
+    knob without documentation fails here.  The check itself is now
+    dragglint rule DT011 (dragg_tpu/analysis/project.py, ISSUE 14); this
+    test asserts it through the run_rules wrapper so the suite and the
+    analyzer CLI can never disagree."""
+    from dragg_tpu.analysis import run_rules
 
-    from dragg_tpu.config import default_config
-
-    doc_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "docs", "config.md")
-    with open(doc_path) as f:
-        doc = f.read()
-
-    def leaves(d, pre=""):
-        for k, v in d.items():
-            if isinstance(v, dict):
-                yield from leaves(v, pre + k + ".")
-            else:
-                yield pre + k, k
-
-    # Match within the key's own section so a leaf name shared with an
-    # already-documented key in another section can't satisfy the check.
-    sections = {}
-    for block in doc.split("\n## ")[1:]:
-        title, _, body = block.partition("\n")
-        sections[title.strip().split()[0].strip("[]")] = body
-
-    def section_for(path):
-        top = path.split(".")[0]
-        for name, body in sections.items():
-            if name == top or name.startswith(top):
-                yield body
-
-    # Distribution keys are documented as a family, not per key.
-    families = ("home.hvac.", "home.wh.", "home.battery.", "home.pv.",
-                "home.ev.", "home.heat_pump.")
-    missing = [
-        path for path, key in leaves(default_config())
-        if not path.startswith(families)
-        and not any(f"`{key}`" in body for body in section_for(path))
-    ]
-    assert not missing, f"undocumented config keys: {missing}"
+    findings = run_rules(select={"DT011"})
+    assert findings == [], [f.render() for f in findings]

@@ -1,243 +1,84 @@
-"""The offline lint floor runs from the suite (round 6), so the
-device-call discipline in entry points — no bare jax.devices(), no
-un-deadlined subprocess calls in tools/ or bench.py — is CI-enforced,
-not advisory."""
+"""tools/lint.py is a thin shim over dragglint (ISSUE 14) — these tests
+pin the COMPATIBILITY story: the historical entry point still gates the
+repo, and the five legacy suppression markers are grandfathered.  The
+rule-by-rule fixtures live in tests/test_analysis.py."""
 
-import importlib.util
 import os
 import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _load_lint():
-    spec = importlib.util.spec_from_file_location(
-        "dragg_lint", os.path.join(ROOT, "tools", "lint.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+LINT = os.path.join(ROOT, "tools", "lint.py")
 
 
 def test_repo_passes_lint():
-    proc = subprocess.run([sys.executable, os.path.join(ROOT, "tools", "lint.py")],
+    """The CI entry point (`python tools/lint.py`) exits clean at HEAD —
+    whole-package scope, empty-or-fully-reasoned baseline."""
+    proc = subprocess.run([sys.executable, LINT],
                           capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "dragglint:" in proc.stderr      # it really is the analyzer
+
+
+def test_shim_forwards_arguments():
+    """Shim arguments pass through to the analyzer CLI."""
+    proc = subprocess.run([sys.executable, LINT, "--list-rules"],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert "DT004" in proc.stdout and "DT015" in proc.stdout
+
+
+def test_legacy_markers_grandfathered_through_shim(tmp_path):
+    """A file using only pre-ISSUE-14 markers still passes (downstream
+    docs/snippets must not break), and the run carries the one-time
+    migration warning."""
+    tool = tmp_path / "legacy_tool.py"
+    tool.write_text(
+        "import jax\n"
+        "import subprocess\n"
+        "d = jax.devices()  # device-call-ok: supervised child\n"
+        "subprocess.run(['true'], timeout=5)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, LINT, "--root", ROOT, "--no-baseline", str(tool)],
+        capture_output=True, text=True, timeout=60)
+    # The file lands outside the repo root, so give it an in-scope rel
+    # path via the API instead for the scope-dependent half:
+    sys.path.insert(0, ROOT)
+    from dragg_tpu.analysis import check_source, make_rules
+
+    got = check_source(tool.read_text(), "tools/legacy_tool.py",
+                       make_rules())
+    dt004 = [f for f in got if f.rule == "DT004"]
+    assert dt004 and dt004[0].suppressed == "legacy"
+    assert not [f for f in got if f.live and f.severity == "error"]
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
-def test_device_discipline_flags_bare_calls(tmp_path):
-    lint = _load_lint()
-    bad = tmp_path / "bad_tool.py"
-    bad.write_text(
-        "import subprocess\n"
-        "import jax\n"
-        "d = jax.devices()\n"
-        "ok = jax.devices()  # device-call-ok: supervised child\n"
-        "subprocess.run(['true'])\n"
-        "subprocess.run(['true'], timeout=5)\n"
-    )
-    # The rule is scoped to entry points (tools/ + bench.py); call the
-    # checker directly so the fixture file need not live in the repo.
-    import ast
-
-    src = bad.read_text()
-    problems = lint.check_device_discipline(
-        ast.parse(src), src.splitlines(), "tools/bad_tool.py")
-    assert len(problems) == 2
-    assert any("jax.devices" in p and ":3:" in p for p in problems)
-    assert any("subprocess.run" in p and ":5:" in p for p in problems)
-
-
-def test_device_discipline_scoping():
-    lint = _load_lint()
-    assert lint._is_entry_point(os.path.join(ROOT, "bench.py"))
-    assert lint._is_entry_point(os.path.join(ROOT, "tools", "x.py"))
-    assert not lint._is_entry_point(os.path.join(ROOT, "dragg_tpu", "engine.py"))
-    # ISSUE 7: the serving subsystem is an entry-point scope too — its
-    # parent is the one process that must never touch a device bare.
-    assert lint._is_entry_point(
-        os.path.join(ROOT, "dragg_tpu", "serve", "daemon.py"))
-    assert lint._is_serve_scope(
-        os.path.join(ROOT, "dragg_tpu", "serve", "worker.py"))
-    assert not lint._is_serve_scope(
-        os.path.join(ROOT, "dragg_tpu", "engine.py"))
-    # ISSUE 8: the aggregator's entry paths joined the scope — its one
-    # sanctioned device enumeration routes through
-    # resilience.devices.device_count, so any bare jax.devices() that
-    # reappears there is flagged.
-    assert lint._is_entry_point(
-        os.path.join(ROOT, "dragg_tpu", "aggregator.py"))
-    # The sanctioned helper's module itself stays out of entry scope
-    # (documented single escape hatch).
-    assert not lint._is_entry_point(
-        os.path.join(ROOT, "dragg_tpu", "resilience", "devices.py"))
-
-
-def test_aggregator_has_no_bare_device_calls():
-    """The satellite's teeth: aggregator.py must contain no bare
-    jax.devices()/local_devices()/default_backend() (ISSUE 8 routed the
-    round-8 sharding probe through resilience.devices.device_count)."""
-    lint = _load_lint()
-    import ast
-
-    path = os.path.join(ROOT, "dragg_tpu", "aggregator.py")
-    with open(path) as f:
-        src = f.read()
-    problems = lint.check_device_discipline(
-        ast.parse(src), src.splitlines(), "dragg_tpu/aggregator.py")
-    assert problems == [], problems
-    assert "device_count" in src  # the sanctioned route is actually used
-
-
-def test_accept_loop_discipline():
-    """ISSUE 7 rule: serving-daemon accept loops must stay interruptible
-    — serve_forever() needs poll_interval=, raw socket accept() needs the
-    accept-timeout-ok marker."""
-    import ast
-
-    lint = _load_lint()
-    src = (
-        "httpd.serve_forever()\n"                                   # bad
-        "httpd.serve_forever(poll_interval=0.2)\n"                  # ok
-        "conn, addr = sock.accept()\n"                              # bad
-        "conn, addr = sock.accept()  "
-        "# accept-timeout-ok: settimeout(1.0) above\n"              # marked
-    )
-    problems = lint.check_accept_loop_discipline(
-        ast.parse(src), src.splitlines(), "dragg_tpu/serve/x.py")
-    assert len(problems) == 2, problems
-    assert any("serve_forever" in p and ":1:" in p for p in problems)
-    assert any("accept()" in p and ":3:" in p for p in problems)
-
-
-def test_telemetry_name_discipline(tmp_path):
-    """Round-7 rule: telemetry emits in dragg_tpu/, tools/, and bench.py
-    must name central-registry entries as literals; computed names need
-    the telemetry-name-ok marker."""
-    import ast
-
-    lint = _load_lint()
-    src = (
-        "from dragg_tpu import telemetry\n"
-        "telemetry.emit('chunk.done', t0=0)\n"                  # ok: registered
-        "telemetry.emit('made.up.event')\n"                     # bad
-        "telemetry.observe('engine.chunk_device_s', 1.0)\n"     # ok
-        "telemetry.span('free.string.metric')\n"                # bad
-        "kind = 'WEDGED'\n"
-        "telemetry.emit('failure.' + kind)\n"                   # bad: no marker
-        "telemetry.emit('failure.' + kind)  "
-        "# telemetry-name-ok: taxonomy kinds are registered\n"  # ok: marked
-    )
-    problems = lint.check_telemetry_names(
-        ast.parse(src), src.splitlines(), "dragg_tpu/x.py")
-    assert len(problems) == 3, problems
-    assert any("made.up.event" in p and ":3:" in p for p in problems)
-    assert any("free.string.metric" in p and ":5:" in p for p in problems)
-    assert any("computed name" in p and ":7:" in p for p in problems)
-
-
-def test_telemetry_scope():
-    lint = _load_lint()
-    assert lint._is_telemetry_scope(os.path.join(ROOT, "dragg_tpu", "engine.py"))
-    assert lint._is_telemetry_scope(os.path.join(ROOT, "bench.py"))
-    assert lint._is_telemetry_scope(os.path.join(ROOT, "tools", "x.py"))
-    assert not lint._is_telemetry_scope(os.path.join(ROOT, "tests", "x.py"))
-
-
-def test_kkt_inverse_discipline(tmp_path):
-    """Round-10 rule: direct np/jnp.linalg.inv outside dragg_tpu/ops/ is
-    rejected — KKT-sized inverses must go through the equilibrated,
-    condition-checked helper (ops.reluqp.equilibrated_spd_inverse); the
-    kkt-inv-ok marker opts out sites with provably non-KKT operands."""
-    import ast
-
-    lint = _load_lint()
-    src = (
-        "import numpy as np\n"
-        "import jax.numpy as jnp\n"
-        "a = np.linalg.inv(S)\n"                               # bad
-        "b = jnp.linalg.inv(K)\n"                              # bad
-        "c = np.linalg.inv(rot2x2)  # kkt-inv-ok: 2x2 rotation\n"  # marked
-        "d = np.linalg.solve(S, r)\n"                          # fine
-        "e = jnp.linalg.cholesky(S)\n"                         # fine
-    )
-    problems = lint.check_kkt_inverse_discipline(
-        ast.parse(src), src.splitlines(), "dragg_tpu/x.py")
-    assert len(problems) == 2, problems
-    assert any(":3:" in p for p in problems)
-    assert any(":4:" in p for p in problems)
-
-
-def test_kkt_inverse_scope():
-    """The rule covers framework + entry-point code but NOT dragg_tpu/ops/
-    — the solver kernels own their factorization-internal inverses."""
-    lint = _load_lint()
-    assert lint._is_kkt_inv_scope(os.path.join(ROOT, "dragg_tpu", "engine.py"))
-    assert lint._is_kkt_inv_scope(os.path.join(ROOT, "bench.py"))
-    assert lint._is_kkt_inv_scope(os.path.join(ROOT, "tools", "x.py"))
-    assert not lint._is_kkt_inv_scope(
-        os.path.join(ROOT, "dragg_tpu", "ops", "reluqp.py"))
-    assert not lint._is_kkt_inv_scope(os.path.join(ROOT, "tests", "x.py"))
-
-
-def test_home_type_registry_rule():
-    """ISSUE 10: every HOME_TYPES entry must carry a TYPE_SPECS spec, a
-    parity-bearing test mention, and a docs/config.md mention — the live
-    repo passes, and the checker actually reads the live tables."""
-    lint = _load_lint()
-    assert lint.check_home_type_registry() == []
-    # The checker reads the REAL type lists (not a stale copy).
-    from dragg_tpu.homes import HOME_TYPES
-    from dragg_tpu.ops.qp import TYPE_SPECS
-
-    got = lint._literal_names(
-        os.path.join(ROOT, "dragg_tpu", "homes.py"), "HOME_TYPES")
-    assert tuple(got) == HOME_TYPES
-    got_specs = lint._literal_names(
-        os.path.join(ROOT, "dragg_tpu", "ops", "qp.py"), "TYPE_SPECS")
-    assert set(got_specs) == set(TYPE_SPECS)
-    assert {"ev", "heat_pump"} <= set(got)
-
-
-def test_precision_discipline(tmp_path):
-    """ISSUE 11: dense contractions in the precision-disciplined solver
-    files must route through ops/precision.mxu_einsum — bare
-    jnp.einsum/dot/matmul/lax.dot_general are rejected unless the line
-    carries the precision-ok marker (non-matmul einsums like a trace)."""
-    import ast
-
-    lint = _load_lint()
-    src = (
-        "import jax.numpy as jnp\n"
-        "from jax import lax\n"
-        "from dragg_tpu.ops.precision import mxu_einsum\n"
-        "a = jnp.einsum('bmn,bn->bm', A, x)\n"                    # bad
-        "b = jnp.matmul(A, x)\n"                                  # bad
-        "c = lax.dot_general(A, x, d)\n"                          # bad
-        "d = jnp.einsum('bkk->b', M)  # precision-ok: trace\n"    # marked
-        "e = mxu_einsum('bmn,bn->bm', A, x, precision='f32')\n"   # routed
-        "f = jnp.linalg.cholesky(S)\n"                            # fine
-    )
-    problems = lint.check_precision_discipline(
-        ast.parse(src), src.splitlines(), "dragg_tpu/ops/reluqp.py")
-    assert len(problems) == 3, problems
-    assert any(":4:" in p for p in problems)
-    assert any(":5:" in p for p in problems)
-    assert any(":6:" in p for p in problems)
-
-
-def test_precision_discipline_scope():
-    """The rule covers exactly the two dense solver files — the helper
-    module itself (which owns the bare einsum) and everything else stay
-    out of scope."""
-    lint = _load_lint()
-    assert lint._is_precision_scope(
-        os.path.join(ROOT, "dragg_tpu", "ops", "reluqp.py"))
-    assert lint._is_precision_scope(
-        os.path.join(ROOT, "dragg_tpu", "ops", "admm.py"))
-    assert not lint._is_precision_scope(
-        os.path.join(ROOT, "dragg_tpu", "ops", "precision.py"))
-    assert not lint._is_precision_scope(
-        os.path.join(ROOT, "dragg_tpu", "ops", "ipm.py"))
-    assert not lint._is_precision_scope(
-        os.path.join(ROOT, "dragg_tpu", "engine.py"))
+def test_new_syntax_everywhere_in_tree():
+    """Satellite: the tree itself uses the unified syntax — no legacy
+    markers remain in committed .py files (they are only honored for
+    DOWNSTREAM compatibility).  `# noqa` is exempt: it keeps its
+    permanent flake8 meaning."""
+    legacy = ("# device-call-ok:", "# accept-timeout-ok:",
+              "# telemetry-name-ok:", "# precision-ok:", "# kkt-inv-ok:")
+    offenders = []
+    for base, dirs, files in os.walk(ROOT):
+        dirs[:] = [d for d in dirs
+                   if not d.startswith(".") and d != "__pycache__"]
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(base, fn)
+            rel = os.path.relpath(path, ROOT)
+            if rel.replace(os.sep, "/") in (
+                    "tests/test_lint.py", "tests/test_analysis.py",
+                    "dragg_tpu/analysis/core.py", "tools/lint.py"):
+                continue        # the marker TABLE, these fixtures, and
+                                # the shim docstring DESCRIBING them
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            for m in legacy:
+                if m in src:
+                    offenders.append(f"{rel}: {m}")
+    assert not offenders, offenders

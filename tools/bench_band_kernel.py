@@ -34,7 +34,7 @@ def main():
     from dragg_tpu.ops import banded as bd
     from dragg_tpu.ops import pallas_band as pb
 
-    dev = jax.devices()[0]  # device-call-ok: runs under the runbook supervisor deadline
+    dev = jax.devices()[0]  # dragg: disable=DT004, runs under the runbook supervisor deadline
     B, bw = args.homes, 4
     m = 3 * args.horizon + 5  # MPC Schur size at H decision steps
     rng = np.random.default_rng(0)

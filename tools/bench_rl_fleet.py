@@ -148,8 +148,8 @@ def main():
         "semantics": "integer" if args.case == "rl_agg" else "n/a",
         "mix": "legacy",
         "precision": "f32",
-        "platform": jax.devices()[0].platform,  # device-call-ok: supervised child
-        "n_devices": len(jax.devices()),  # device-call-ok: supervised child
+        "platform": jax.devices()[0].platform,  # dragg: disable=DT004, supervised child
+        "n_devices": len(jax.devices()),  # dragg: disable=DT004, supervised child
         "cold_s": round(times[0], 2),
         "warm_s": round(warm_s, 2),
         # Home-steps/s: fleet total homes × sim steps per warm second —

@@ -1,473 +1,38 @@
 #!/usr/bin/env python
-"""Dependency-free lint, runnable in the hermetic build image.
+"""Thin shim over dragglint (``python -m dragg_tpu.analysis``) — ISSUE 14.
 
-Mirrors the enforcement the reference gets from its pre-commit suite
-(reference .pre-commit-config.yaml: flake8, autoflake, check-ast) with
-what the stdlib can check:
+This file used to BE the lint: 473 lines of seven ad-hoc checks with
+one ast re-walk each, inconsistent suppression markers, and entry-point
+whitelists.  Those checks are now rules DT001-DT011 of the dragglint
+analyzer (plus the JAX-specific DT012-DT015 and the suppression
+validator DT016 the old lint never had),
+with a single-pass visitor dispatch, one suppression syntax
+(``# dragg: disable=DT0xx, reason``), per-rule scope globs covering the
+WHOLE package, and a committed baseline (``.dragglint-baseline.json``).
+Rule catalog and workflow: docs/analysis.md.
 
-* every Python file parses (`check-ast` parity);
-* no unused imports (autoflake parity; `# noqa` opt-out honored);
-* no tabs in indentation, no trailing whitespace, newline at EOF;
-* device-call discipline in `tools/`, `bench.py`, `dragg_tpu/serve/`,
-  and `dragg_tpu/aggregator.py` (round 6; serve added by ISSUE 7, the
-  aggregator's entry paths by ISSUE 8 — its one sanctioned device
-  enumeration is ``resilience.devices.device_count``): no bare
-  ``jax.devices()``/``jax.default_backend()``/``jax.local_devices()`` —
-  a wedged tunnel hangs backend init, so device calls in entry points
-  must run inside a supervised/probed child (dragg_tpu/resilience);
-  lines that legitimately run in a supervised child carry a
-  ``# device-call-ok: <why>`` marker — and no un-deadlined
-  ``subprocess.run/check_output/check_call/call`` (a child that can
-  hang forever defeats the supervision; pass ``timeout=``);
-* accept-loop discipline in `dragg_tpu/serve/` plus the serving tools
-  `tools/serve_load.py` / `tools/serve_soak.py` (ISSUE 7; scope extended
-  by ISSUE 13 — the load harness runs an in-process daemon, so the same
-  deadline discipline applies): the serving daemon must stay
-  interruptible — ``serve_forever()`` needs an explicit
-  ``poll_interval=`` (the default blocks shutdown on a quiet socket
-  longer than the drain budget expects) and raw ``socket.accept()``
-  loops are disallowed unless the line carries
-  ``# accept-timeout-ok: <why>`` (a timeout is set on the socket);
-* telemetry-name discipline in `dragg_tpu/`, `tools/`, and `bench.py`
-  (round 7): every ``telemetry.emit/span/observe/inc/set_gauge`` call
-  must name an entry of the central registry
-  (dragg_tpu/telemetry/registry.py) as a string LITERAL — free strings
-  fragment the unified stream the registry exists to keep analyzable.
-  Computed names carry a ``# telemetry-name-ok: <why>`` marker (e.g.
-  the taxonomy-kind events, whose kinds are each registered literally);
-* home-type co-registration (ISSUE 10): every ``homes.HOME_TYPES`` entry
-  must carry an ``ops/qp.TYPE_SPECS`` block spec, appear (quoted) in a
-  parity-bearing test file under ``tests/``, and be documented in
-  ``docs/config.md`` — a new scenario home type cannot ship half-wired
-  (solving in a bucket nobody parity-checked or documented);
-* precision discipline in the dense solver files (ISSUE 11):
-  ``dragg_tpu/ops/reluqp.py`` and ``dragg_tpu/ops/admm.py`` may not call
-  ``jnp.einsum``/``jnp.dot``/``jnp.matmul``/``jnp.tensordot``/
-  ``lax.dot_general`` directly — every dense contraction routes through
-  ``dragg_tpu/ops/precision.py`` (``mxu_einsum``), which owns the
-  f32/bf16x3 cast discipline (bf16 compute with f32 accumulation; f32
-  residual path — the rounds-2/9 divergence mode was exactly a
-  hand-rolled dtype).  Non-matmul einsums (e.g. a diagonal trace) carry
-  a ``# precision-ok: <why>`` marker;
-* KKT-inverse discipline in the same scope (round 10): no direct
-  ``np.linalg.inv``/``jnp.linalg.inv`` outside ``dragg_tpu/ops/`` — the
-  dense rho-bank operators of the reluqp family must be built through
-  the equilibrated, condition-checked Cholesky route
-  (``ops.reluqp.equilibrated_spd_inverse``); an unequilibrated generic
-  LU inverse of a KKT-sized operand silently amplifies float32
-  conditioning error into the hot loop.  Sites whose operand is
-  provably not KKT-sized carry a ``# kkt-inv-ok: <why>`` marker.
+The shim keeps every historical entry point working unchanged:
 
-The full flake8/autoflake hooks run via .pre-commit-config.yaml and CI
-where those tools are installable; this script is the offline floor and
-is itself wired into CI so the two can't drift silently.
+* ``python tools/lint.py`` in CI, run_ci_locally.sh, and muscle memory;
+* the legacy markers (``# device-call-ok:`` / ``# accept-timeout-ok:``
+  / ``# telemetry-name-ok:`` / ``# precision-ok:`` / ``# kkt-inv-ok:``
+  and ``# noqa`` on imports) are grandfathered by the analyzer itself —
+  still honored, warned once per run (except noqa, which keeps its
+  permanent flake8 meaning) — so downstream docs/snippets don't break.
+
+Arguments pass through: ``python tools/lint.py --changed`` etc.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SKIP_DIRS = {".git", "__pycache__", ".cache", "outputs", "native/_build",
-             ".pytest_cache", ".claude"}
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
 
-
-def iter_py_files():
-    for base, dirs, files in os.walk(ROOT):
-        dirs[:] = [d for d in dirs
-                   if d not in SKIP_DIRS and not d.startswith(".")]
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(base, f)
-
-
-class ImportUsage(ast.NodeVisitor):
-    def __init__(self):
-        self.imported: dict[str, int] = {}   # bound name -> lineno
-        self.used: set[str] = set()
-
-    def visit_Import(self, node):
-        for a in node.names:
-            name = a.asname or a.name.split(".")[0]
-            self.imported[name] = node.lineno
-
-    def visit_ImportFrom(self, node):
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.imported[a.asname or a.name] = node.lineno
-
-    def visit_Name(self, node):
-        self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-
-# Entry-point files where every device touch must be supervised or
-# probed: tools/ CLIs, the bench harness, the serving daemon, and (round
-# 12) the aggregator's engine-build / simulation entry paths — the
-# aggregator runs inside supervised children on every shipped path, and
-# its one legitimate device enumeration routes through the sanctioned
-# helper (dragg_tpu.resilience.devices.device_count) so a future bare
-# call can't sneak back in (CLAUDE.md gotcha — never bare
-# jax.devices()).
-_DEVICE_CALLS = {"devices", "local_devices", "default_backend"}
-_SUBPROCESS_FNS = {"run", "check_output", "check_call", "call"}
-_DEVICE_MARKER = "# device-call-ok:"
-
-
-def _is_entry_point(path: str) -> bool:
-    rel = os.path.relpath(path, ROOT)
-    return (rel == "bench.py" or rel.startswith("tools" + os.sep)
-            or rel == os.path.join("dragg_tpu", "aggregator.py")
-            or _is_serve_scope(path))
-
-
-# Accept-loop discipline (ISSUE 7; see the module docstring bullet).
-_ACCEPT_MARKER = "# accept-timeout-ok:"
-
-
-def _is_serve_scope(path: str) -> bool:
-    rel = os.path.relpath(path, ROOT)
-    return (rel.startswith(os.path.join("dragg_tpu", "serve") + os.sep)
-            or rel in (os.path.join("tools", "serve_load.py"),
-                       os.path.join("tools", "serve_soak.py")))
-
-
-def check_accept_loop_discipline(tree, lines: list[str], rel: str) -> list[str]:
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if not isinstance(fn, ast.Attribute):
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if fn.attr == "serve_forever":
-            if not any(kw.arg == "poll_interval" for kw in node.keywords) \
-                    and _ACCEPT_MARKER not in line:
-                problems.append(
-                    f"{rel}:{node.lineno}: serve_forever() without "
-                    f"poll_interval= in the serving daemon — a quiet "
-                    f"socket must not outlive the drain budget; pass "
-                    f"poll_interval= or mark the line "
-                    f"'{_ACCEPT_MARKER} <why>'")
-        elif fn.attr == "accept" and not node.args and not node.keywords:
-            if _ACCEPT_MARKER not in line:
-                problems.append(
-                    f"{rel}:{node.lineno}: raw socket accept() in the "
-                    f"serving daemon — an un-timeouted accept loop cannot "
-                    f"drain; set a socket timeout and mark the line "
-                    f"'{_ACCEPT_MARKER} <why>'")
-    return problems
-
-
-# Telemetry-name discipline (round 7): emits in framework + entry-point
-# code must reference the central registry so the unified event stream
-# stays analyzable (one schema, documented in docs/telemetry.md).
-_TELEMETRY_FNS = {"emit": "EVENTS", "span": "METRICS", "observe": "METRICS",
-                  "inc": "METRICS", "set_gauge": "METRICS"}
-_TELEMETRY_MARKER = "# telemetry-name-ok:"
-_REGISTRY_PATH = os.path.join(ROOT, "dragg_tpu", "telemetry", "registry.py")
-_registry_names_cache: dict | None = None
-
-
-def _telemetry_registry() -> dict | None:
-    """{'EVENTS': set, 'METRICS': set} parsed from the registry module's
-    literal tables via ast (no import — lint stays dependency-free)."""
-    global _registry_names_cache
-    if _registry_names_cache is not None:
-        return _registry_names_cache
-    try:
-        with open(_REGISTRY_PATH, encoding="utf-8") as f:
-            tree = ast.parse(f.read())
-    except (OSError, SyntaxError):
-        return None
-    names: dict = {"EVENTS": set(), "METRICS": set()}
-    for node in ast.walk(tree):
-        if not (isinstance(node, (ast.Assign, ast.AnnAssign))):
-            continue
-        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
-        for t in targets:
-            if isinstance(t, ast.Name) and t.id in names \
-                    and isinstance(node.value, ast.Dict):
-                names[t.id] |= {k.value for k in node.value.keys
-                                if isinstance(k, ast.Constant)
-                                and isinstance(k.value, str)}
-    _registry_names_cache = names
-    return names
-
-
-def _is_telemetry_scope(path: str) -> bool:
-    rel = os.path.relpath(path, ROOT)
-    return (rel == "bench.py" or rel.startswith("tools" + os.sep)
-            or rel.startswith("dragg_tpu" + os.sep))
-
-
-def check_telemetry_names(tree, lines: list[str], rel: str) -> list[str]:
-    reg = _telemetry_registry()
-    if reg is None:
-        return []
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if not (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
-                and fn.value.id == "telemetry" and fn.attr in _TELEMETRY_FNS):
-            continue
-        table = _TELEMETRY_FNS[fn.attr]
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        arg = node.args[0] if node.args else None
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            if arg.value not in reg[table]:
-                problems.append(
-                    f"{rel}:{node.lineno}: telemetry.{fn.attr}"
-                    f"({arg.value!r}) names nothing in registry.{table} — "
-                    f"register it in dragg_tpu/telemetry/registry.py (and "
-                    f"docs/telemetry.md)")
-        elif _TELEMETRY_MARKER not in line:
-            problems.append(
-                f"{rel}:{node.lineno}: telemetry.{fn.attr}() with a "
-                f"computed name — pass a registry literal, or mark the "
-                f"line '{_TELEMETRY_MARKER} <why>' if every runtime value "
-                f"is registered")
-    return problems
-
-
-# Precision discipline (ISSUE 11; see the module docstring bullet).
-_PRECISION_MARKER = "# precision-ok:"
-_PRECISION_FILES = (os.path.join("dragg_tpu", "ops", "reluqp.py"),
-                    os.path.join("dragg_tpu", "ops", "admm.py"))
-_DENSE_CONTRACTIONS = {"einsum", "dot", "matmul", "tensordot",
-                       "dot_general"}
-
-
-def _is_precision_scope(path: str) -> bool:
-    return os.path.relpath(path, ROOT) in _PRECISION_FILES
-
-
-def check_precision_discipline(tree, lines: list[str], rel: str) -> list[str]:
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        # Matches jnp.einsum / np.dot / lax.dot_general / lax.linalg...
-        # — any attribute call named like a dense contraction.
-        if not (isinstance(fn, ast.Attribute)
-                and fn.attr in _DENSE_CONTRACTIONS):
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if _PRECISION_MARKER not in line:
-            problems.append(
-                f"{rel}:{node.lineno}: bare dense contraction "
-                f"({fn.attr}) in a precision-disciplined solver file — "
-                f"route it through ops/precision.mxu_einsum (which owns "
-                f"the f32/bf16x3 cast policy), or mark the line "
-                f"'{_PRECISION_MARKER} <why>' if it is not a matmul")
-    return problems
-
-
-# KKT-inverse discipline (round 10; see the module docstring bullet).
-_INV_MARKER = "# kkt-inv-ok:"
-
-
-def _is_kkt_inv_scope(path: str) -> bool:
-    rel = os.path.relpath(path, ROOT)
-    return (_is_telemetry_scope(path)
-            and not rel.startswith(os.path.join("dragg_tpu", "ops") + os.sep))
-
-
-def check_kkt_inverse_discipline(tree, lines: list[str], rel: str) -> list[str]:
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        # Matches any `<base>.linalg.inv(...)` — np, jnp, scipy aliases.
-        if not (isinstance(fn, ast.Attribute) and fn.attr == "inv"
-                and isinstance(fn.value, ast.Attribute)
-                and fn.value.attr == "linalg"):
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if _INV_MARKER not in line:
-            problems.append(
-                f"{rel}:{node.lineno}: direct linalg.inv outside ops/ — "
-                f"KKT-sized inverses must go through the equilibrated, "
-                f"condition-checked helper "
-                f"(dragg_tpu.ops.reluqp.equilibrated_spd_inverse); mark "
-                f"the line '{_INV_MARKER} <why>' if the operand is "
-                f"provably not KKT-sized")
-    return problems
-
-
-def check_device_discipline(tree, lines: list[str], rel: str) -> list[str]:
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if not (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)):
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if fn.value.id == "jax" and fn.attr in _DEVICE_CALLS:
-            if _DEVICE_MARKER not in line:
-                problems.append(
-                    f"{rel}:{node.lineno}: bare jax.{fn.attr}() in an entry "
-                    f"point — probe/supervise it (dragg_tpu/resilience), or "
-                    f"mark the line '{_DEVICE_MARKER} <why>' if it runs in a "
-                    f"supervised child")
-        if fn.value.id == "subprocess" and fn.attr in _SUBPROCESS_FNS:
-            if not any(kw.arg == "timeout" for kw in node.keywords):
-                problems.append(
-                    f"{rel}:{node.lineno}: subprocess.{fn.attr}() without "
-                    f"timeout= in an entry point — an un-deadlined child can "
-                    f"hang forever (use resilience.supervisor or pass a "
-                    f"timeout)")
-    return problems
-
-
-# Home-type co-registration (ISSUE 10; see the module docstring bullet).
-def _literal_names(path: str, var: str) -> list[str] | None:
-    """String members of a top-level tuple/dict literal assigned to
-    ``var`` in ``path`` (tuple → elements, dict → keys); None on parse
-    failure so the rule degrades quietly rather than crashing lint."""
-    try:
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read())
-    except (OSError, SyntaxError):
-        return None
-    for node in tree.body:
-        targets = (node.targets if isinstance(node, ast.Assign)
-                   else [node.target] if isinstance(node, ast.AnnAssign)
-                   else [])
-        for t in targets:
-            if not (isinstance(t, ast.Name) and t.id == var):
-                continue
-            v = node.value
-            if isinstance(v, ast.Tuple):
-                return [e.value for e in v.elts
-                        if isinstance(e, ast.Constant)
-                        and isinstance(e.value, str)]
-            if isinstance(v, ast.Dict):
-                return [k.value for k in v.keys
-                        if isinstance(k, ast.Constant)
-                        and isinstance(k.value, str)]
-    return None
-
-
-def check_home_type_registry() -> list[str]:
-    home_types = _literal_names(
-        os.path.join(ROOT, "dragg_tpu", "homes.py"), "HOME_TYPES")
-    specs = _literal_names(
-        os.path.join(ROOT, "dragg_tpu", "ops", "qp.py"), "TYPE_SPECS")
-    if home_types is None or specs is None:
-        return []  # parse problems are reported per-file already
-    try:
-        with open(os.path.join(ROOT, "docs", "config.md"),
-                  encoding="utf-8") as f:
-            doc = f.read()
-    except OSError:
-        doc = ""
-    # Parity evidence: the quoted type name appears in a test file whose
-    # source mentions parity (the test_qp_parity / test_bucketed /
-    # test_scenarios convention).
-    parity_src = ""
-    tests_dir = os.path.join(ROOT, "tests")
-    try:
-        test_files = sorted(os.listdir(tests_dir))
-    except OSError:
-        test_files = []
-    for fn in test_files:
-        if not fn.endswith(".py"):
-            continue
-        try:
-            with open(os.path.join(tests_dir, fn), encoding="utf-8") as f:
-                src = f.read()
-        except OSError:
-            continue
-        if "parity" in src.lower():
-            parity_src += src
-    problems = []
-    for t in home_types:
-        if t not in specs:
-            problems.append(
-                f"dragg_tpu/homes.py: HOME_TYPES entry {t!r} has no "
-                f"ops/qp.TYPE_SPECS block spec — the bucketed engine "
-                f"cannot shape-specialize it")
-        if f"`{t}`" not in doc and f"homes_{t}" not in doc:
-            problems.append(
-                f"docs/config.md: HOME_TYPES entry {t!r} undocumented — "
-                f"mention `{t}` (or its homes_{t} count key)")
-        if f'"{t}"' not in parity_src and f"'{t}'" not in parity_src:
-            problems.append(
-                f"tests/: HOME_TYPES entry {t!r} appears in no parity-"
-                f"bearing test file — add objective-parity coverage "
-                f"(tests/test_qp_parity.py pattern)")
-    return problems
-
-
-def check_file(path: str) -> list[str]:
-    problems = []
-    rel = os.path.relpath(path, ROOT)
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=rel)
-    except SyntaxError as e:
-        return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
-
-    lines = src.splitlines()
-    for i, line in enumerate(lines, 1):
-        if line != line.rstrip():
-            problems.append(f"{rel}:{i}: trailing whitespace")
-        if line[:len(line) - len(line.lstrip())].count("\t"):
-            problems.append(f"{rel}:{i}: tab in indentation")
-    if src and not src.endswith("\n"):
-        problems.append(f"{rel}:{len(lines)}: no newline at end of file")
-
-    uses = ImportUsage()
-    uses.visit(tree)
-    # Names referenced in __all__ or docstring-level re-export idioms count.
-    for name, lineno in sorted(uses.imported.items(), key=lambda kv: kv[1]):
-        if name in uses.used or name == "annotations":
-            continue
-        line = lines[lineno - 1] if lineno <= len(lines) else ""
-        if "noqa" in line:
-            continue
-        if f'"{name}"' in src or f"'{name}'" in src:  # __all__ / getattr use
-            continue
-        problems.append(f"{rel}:{lineno}: unused import '{name}'")
-    if _is_entry_point(path):
-        problems.extend(check_device_discipline(tree, lines, rel))
-    if _is_serve_scope(path):
-        problems.extend(check_accept_loop_discipline(tree, lines, rel))
-    if _is_telemetry_scope(path):
-        problems.extend(check_telemetry_names(tree, lines, rel))
-    if _is_kkt_inv_scope(path):
-        problems.extend(check_kkt_inverse_discipline(tree, lines, rel))
-    if _is_precision_scope(path):
-        problems.extend(check_precision_discipline(tree, lines, rel))
-    return problems
-
-
-def main() -> int:
-    all_problems = []
-    n = 0
-    for path in sorted(iter_py_files()):
-        n += 1
-        all_problems.extend(check_file(path))
-    all_problems.extend(check_home_type_registry())
-    for p in all_problems:
-        print(p)
-    print(f"lint: {n} files, {len(all_problems)} problem(s)",
-          file=sys.stderr)
-    return 1 if all_problems else 0
-
+from dragg_tpu.analysis.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

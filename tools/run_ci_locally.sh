@@ -32,7 +32,11 @@ say "ci.yml local execution on $(uname -sr), python $(python -V 2>&1)"
 
 # --- job: lint (mirrors ci.yml lint steps; flake8 args pinned to the
 #     workflow's list so drift against tools/lint.py is exercised here)
-step "lint/offline" python tools/lint.py
+# dragglint (ISSUE 14): the full analyzer with a JSON findings artifact
+# — rule catalog in docs/analysis.md; tools/lint.py is a shim over the
+# same engine, exercised separately so the shim path cannot rot.
+step "lint/dragglint" python -m dragg_tpu.analysis --json /tmp/dragglint_findings.json
+step "lint/shim" python tools/lint.py
 if python -c "import flake8" 2>/dev/null; then
   step "lint/flake8" python -m flake8 --max-line-length=100 \
     --extend-ignore=E203,E501,W503,E731,E741 \
