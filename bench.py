@@ -170,29 +170,20 @@ def mix_label(mix: dict[str, float] | None, pack: str | None) -> str:
     return f"{base}+pack:{pack}" if pack else base
 
 
-def build(n_homes: int, horizon_hours: int, admm_iters: int,
-          solver: str = "admm", band_kernel: str | None = None,
-          data_dir: str | None = None, semantics: str = "default",
-          bucketed: str = "auto", per_home_obs: str = "true",
-          communities: int = 1, mix: dict[str, float] | None = None,
-          pack: str | None = None, precision: str = "f32",
-          iter_kernel: str | None = None):
-    """Build THE benchmark community engine (population mix, sim window,
-    solver config).  This is the one definition of the measured community —
-    tools/bench_engine_kernels.py reuses it so kernel A/B verdicts are
-    measured on the same population as the headline bench.  ``data_dir``
-    points at real nsrdb.csv/waterdraw_profiles.csv assets (default:
-    synthetic — real January weather measures ~1.1 % more fallback steps
-    and ~26 % more wall, docs/perf_notes.md round 4).  ``communities > 1``
-    folds C independent communities of ``n_homes`` EACH into one fleet
-    batch (round 12 — same compiled pattern set, C·B_type homes per type
-    bucket)."""
-    import numpy as np
-
+def bench_config(n_homes: int, horizon_hours: int, admm_iters: int,
+                 solver: str = "admm", band_kernel: str | None = None,
+                 data_dir: str | None = None, semantics: str = "default",
+                 bucketed: str = "auto", per_home_obs: str = "true",
+                 communities: int = 1, mix: dict[str, float] | None = None,
+                 pack: str | None = None, precision: str = "f32",
+                 iter_kernel: str | None = None) -> dict:
+    """THE benchmark community config as a pure dict — shared by the
+    measured child's engine build below AND the jax-free ``--shards``
+    parent (which ships it to shard workers over the spool, so the
+    sharded measurement runs exactly the population the in-process bench
+    does).  Imports only config + scenarios; never initializes jax."""
     from dragg_tpu.config import default_config
-    from dragg_tpu.data import load_environment, load_waterdraw_profiles
-    from dragg_tpu.engine import make_engine
-    from dragg_tpu.homes import build_fleet_batch, create_fleet_homes
+    from dragg_tpu.scenarios import MIX_KEYS, apply_scenarios
 
     cfg = default_config()
     cfg["community"]["total_number_homes"] = n_homes
@@ -201,8 +192,6 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
     # battery, 10% pv_battery); --mix swaps in any six-type composition
     # and --pack layers a scenario pack (whose [mix] fractions override
     # these counts — apply_scenarios below).
-    from dragg_tpu.scenarios import MIX_KEYS, apply_scenarios
-
     for t, key in MIX_KEYS.items():
         frac = (mix if mix is not None else LEGACY_MIX).get(t, 0.0)
         cfg["community"][key] = int(frac * n_homes)
@@ -232,6 +221,38 @@ def build(n_homes: int, horizon_hours: int, admm_iters: int,
         # A/Bs and cross-round comparisons (rounds <=4 measured the
         # relaxation) can pin either side.
         cfg["tpu"]["integer_first_action"] = semantics == "integer"
+    return cfg
+
+
+def build(n_homes: int, horizon_hours: int, admm_iters: int,
+          solver: str = "admm", band_kernel: str | None = None,
+          data_dir: str | None = None, semantics: str = "default",
+          bucketed: str = "auto", per_home_obs: str = "true",
+          communities: int = 1, mix: dict[str, float] | None = None,
+          pack: str | None = None, precision: str = "f32",
+          iter_kernel: str | None = None):
+    """Build THE benchmark community engine (population mix, sim window,
+    solver config).  This is the one definition of the measured community —
+    tools/bench_engine_kernels.py reuses it so kernel A/B verdicts are
+    measured on the same population as the headline bench.  ``data_dir``
+    points at real nsrdb.csv/waterdraw_profiles.csv assets (default:
+    synthetic — real January weather measures ~1.1 % more fallback steps
+    and ~26 % more wall, docs/perf_notes.md round 4).  ``communities > 1``
+    folds C independent communities of ``n_homes`` EACH into one fleet
+    batch (round 12 — same compiled pattern set, C·B_type homes per type
+    bucket)."""
+    import numpy as np
+
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_fleet_batch, create_fleet_homes
+
+    cfg = bench_config(n_homes, horizon_hours, admm_iters, solver=solver,
+                       band_kernel=band_kernel, data_dir=data_dir,
+                       semantics=semantics, bucketed=bucketed,
+                       per_home_obs=per_home_obs, communities=communities,
+                       mix=mix, pack=pack, precision=precision,
+                       iter_kernel=iter_kernel)
 
     # Stage logs: the round-4 live window showed a 10k-home TPU attempt
     # hanging somewhere between "building engine" and the first step with
@@ -701,6 +722,13 @@ def run_measured(args) -> dict:
         # trend series and never gate against single-community history.
         "communities": args.communities,
         "homes_total": args.homes * args.communities,
+        # Cross-process sharding (architecture.md §19): the in-process
+        # bench is always one process; the --shards parent branch emits
+        # its own record with shards = N.  tools/bench_trend.py treats
+        # ``shards`` as a HARD series key (era default 1) — N-shard rows
+        # form their own trend series and never gate against in-process
+        # history.
+        "shards": 1,
         # Population composition + scenario pack (ROADMAP item 4):
         # tools/bench_trend.py treats ``mix`` as a HARD series key — a
         # scenario-pack / mix row is a different workload and never gates
@@ -826,6 +854,84 @@ def child_argv(args, platform: str, attempt: int,
     return cmd
 
 
+def run_sharded_bench(args) -> dict:
+    """The ``--shards N`` measurement: the SAME bench population
+    (bench_config), run by the shard coordinator across N supervised
+    worker processes, each chunk ``--steps`` long × ``--chunks`` chunks.
+    This parent stays jax-free (the workers own the backends).
+
+    The headline ``value`` is the steady-state rate — per-chunk device
+    seconds EXCLUDING each worker generation's first chunk (its
+    compile), mirroring the in-process bench's warmup exclusion;
+    ``wall_ts_per_s`` keeps the compile-inclusive number honest.
+    ``shards`` is a HARD bench_trend series key (era default 1)."""
+    import tempfile
+
+    from dragg_tpu.resilience.supervisor import assert_parent_has_no_jax
+    from dragg_tpu.shard.coordinator import run_sharded
+
+    assert_parent_has_no_jax()
+    mix = parse_mix(args.mix)
+    data_dir = args.data_dir
+    cfg = bench_config(args.homes, args.horizon_hours, args.admm_iters,
+                       solver=args.solver if args.solver != "auto"
+                       else "ipm",
+                       data_dir=data_dir, semantics=args.semantics,
+                       bucketed=args.bucketed,
+                       per_home_obs=args.per_home_obs,
+                       communities=args.communities, mix=mix,
+                       pack=args.pack, precision=args.precision)
+    steps = args.steps * args.chunks
+    run_dir = os.environ.get("DRAGG_SHARD_RUN_DIR") or tempfile.mkdtemp(
+        prefix="bench_shards_")
+    t0 = time.perf_counter()
+    res = run_sharded(cfg, run_dir=run_dir, steps=steps,
+                      workers=args.shards, chunk_steps=args.steps,
+                      platform=args.platform, data_dir=data_dir, log=_log)
+    elapsed = time.perf_counter() - t0
+    homes_total = args.homes * args.communities
+    steady = res.get("steady_home_steps_per_s")
+    wall_rate = steps / max(elapsed, 1e-9)
+    value = (steady / homes_total) if steady else wall_rate
+    from dragg_tpu.data import bundled_data_dir
+
+    if data_dir == "":
+        data_label = "synthetic"
+    elif data_dir is not None:
+        data_label = data_dir
+    else:
+        data_label = "bundled" if bundled_data_dir() else "synthetic"
+    return {
+        "metric": f"sim_timesteps_per_s_{args.homes}homes_"
+                  f"{args.horizon_hours}h_horizon",
+        "value": round(value, 3),
+        "unit": "timesteps/s",
+        "vs_baseline": round(value / TARGET_TS_PER_S, 3),
+        "rate_basis": ("steady_device" if steady else "wall"),
+        "wall_ts_per_s": round(wall_rate, 3),
+        "platform": "+".join(res["platforms"]) or "?",
+        "n_homes": args.homes,
+        "communities": args.communities,
+        "homes_total": homes_total,
+        "shards": args.shards,
+        "shard_ranges": res["ranges"],
+        "home_steps_per_s": res["home_steps_per_s"],
+        "steady_home_steps_per_s": steady,
+        "restarts": res["restarts"],
+        "mix": mix_label(mix, args.pack),
+        "pack": args.pack,
+        "solver": args.solver if args.solver != "auto" else "ipm",
+        "semantics": ("integer" if cfg["tpu"].get("integer_first_action",
+                                                  True) else "relaxation"),
+        "precision": args.precision,
+        "rl": "none",
+        "data": data_label,
+        "solve_rate": res["solve_rate"],
+        "compile_s": None,
+        "run_dir": run_dir,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     # Defaults = the BASELINE target config (BASELINE.md row "10k-home
@@ -833,6 +939,13 @@ def main() -> None:
     ap.add_argument("--homes", type=int, default=10_000,
                     help="homes PER COMMUNITY (fleet total = homes × "
                          "--communities)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="shard worker processes N (architecture.md §19): "
+                         "N > 1 runs the measurement through the jax-free "
+                         "shard coordinator — fleet.communities split into "
+                         "N contiguous ranges, one supervised worker "
+                         "process (own mesh/backend) each; JSON gains "
+                         "shards as a HARD bench_trend series key")
     ap.add_argument("--communities", type=int, default=1,
                     help="fleet size C (round 12): fold C independent "
                          "communities of --homes each into one batched "
@@ -933,6 +1046,13 @@ def main() -> None:
     if args._child or args.smoke:
         result = run_measured(args)
         print(json.dumps(result))
+        return
+
+    if args.shards > 1:
+        # Sharded measurement (architecture.md §19): THIS jax-free parent
+        # runs the shard coordinator directly — the workers are its
+        # supervised children, so no extra supervision wrapper applies.
+        print(json.dumps(run_sharded_bench(args)))
         return
 
     # Parent mode: the supervised ladder (dragg_tpu/resilience) — this

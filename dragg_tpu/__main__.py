@@ -68,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "persistent-cache verdict) in a hard-timeouted "
                           "subprocess — the observatory's compile-path "
                           "self-test")
+    doc.add_argument("--shard-check", action="store_true",
+                     help="additionally self-test the shard coordinator's "
+                          "crash-safety substrate (dragg_tpu/shard): "
+                          "journal torn-tail truncation at every byte "
+                          "boundary + duplicate-epoch refusal, mirroring "
+                          "the serve_journal check")
 
     srv = sub.add_parser(
         "serve",
@@ -272,7 +278,8 @@ def main(argv=None) -> int:
 
         return run_doctor(outputs_dir=args.outputs_dir,
                           backend_timeout=args.backend_timeout,
-                          compile_check=args.compile_check)
+                          compile_check=args.compile_check,
+                          shard_check=args.shard_check)
     if args.cmd == "sweep":
         return run_sweep(args)
     if args.cmd == "dashboard":

@@ -120,10 +120,15 @@ class Aggregator:
         # C > 1 folds C independent communities (own seeds / weather
         # offsets) into one batched engine; community.total_number_homes
         # stays PER COMMUNITY.
-        from dragg_tpu.homes import fleet_config
+        from dragg_tpu.homes import fleet_community_base, fleet_config
 
         (self.n_communities, self._fleet_seed_stride,
          self._fleet_weather_off_h) = fleet_config(self.config)
+        # Shard workers (architecture.md §19) run a community RANGE of a
+        # larger fleet: community_base shifts seeds/names/weather to the
+        # global identities, so coverage must extend past the LAST global
+        # community's offset, not the local count's.
+        self._fleet_comm_base = fleet_community_base(self.config)
 
         # Simulation window (dragg/aggregator.py:111-127).
         self.start_dt = parse_dt(self.config["simulation"]["start_datetime"])
@@ -141,7 +146,8 @@ class Aggregator:
         self.env.check_coverage(
             self.start_dt, self.end_dt,
             horizon_hours
-            + (self.n_communities - 1) * self._fleet_weather_off_h)
+            + (self._fleet_comm_base + self.n_communities - 1)
+            * self._fleet_weather_off_h)
         self.start_index = self.env.start_index(self.start_dt)
 
         self.all_homes: list[dict] | None = None

@@ -679,7 +679,7 @@ class JournalFsyncRule(Rule):
     id = "DT015"
     name = "journal-fsync"
     scope = ("dragg_tpu/serve/journal.py", "dragg_tpu/serve/spool.py",
-             "dragg_tpu/checkpoint.py")
+             "dragg_tpu/checkpoint.py", "dragg_tpu/shard/journal.py")
     node_types = (ast.Call,)
     _WRITERS = {"write", "writelines", "savez", "savez_compressed"}
 

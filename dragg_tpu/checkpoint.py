@@ -239,6 +239,67 @@ def load_pytree_local(path: str, template, expect_timestep: int | None = None):
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
+# ------------------------------------------------- versioned checkpoint dirs
+#
+# The aggregator's proven atomic-checkpoint shape (save_checkpoint),
+# factored for other chunk-checkpointing hosts (the shard workers,
+# dragg_tpu/shard/worker.py; the reshard tool rewrites these trees):
+# each checkpoint is a self-contained ``ckpt_t<t>`` directory
+# (state.npz + progress.json) staged under a ``.tmp`` name and renamed
+# into place, after which the ``LATEST`` pointer is atomically replaced.
+# A kill at any instant leaves either the previous complete checkpoint
+# or the new complete one — never a torn mix.
+
+
+def save_checkpoint_dir(root: str, timestep: int, tree,
+                        progress: dict) -> str:
+    """Write one versioned checkpoint directory and publish it via
+    ``LATEST``.  ``progress`` must carry every host-side field resume
+    needs (the caller's run-shape guard included); ``timestep`` is added
+    to it and names the directory.  Superseded checkpoints are pruned.
+    Returns the published directory path."""
+    import shutil
+
+    os.makedirs(root, exist_ok=True)
+    name = f"ckpt_t{timestep:08d}"
+    tmp = os.path.join(root, name + ".tmp")
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    save_pytree(os.path.join(tmp, "state.npz"), tree)
+    save_progress(os.path.join(tmp, "progress.json"),
+                  {**progress, "timestep": int(timestep)})
+    final = os.path.join(root, name)
+    # A previous run killed between this rename and the LATEST replace
+    # leaves a complete dir at `final` while LATEST points at the older
+    # checkpoint; the resumed run reaches this timestep again and
+    # os.rename onto a non-empty dir raises.  Clear it first.
+    shutil.rmtree(final, ignore_errors=True)
+    os.rename(tmp, final)
+    latest_tmp = os.path.join(root, f"LATEST.tmp{os.getpid()}")
+    with open(latest_tmp, "w") as f:
+        f.write(name)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(latest_tmp, os.path.join(root, "LATEST"))
+    for entry in os.listdir(root):
+        if entry.startswith("ckpt_") and entry != name:
+            shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
+    return final
+
+
+def latest_checkpoint_dir(root: str) -> str | None:
+    """The directory ``LATEST`` points at, or None when absent/torn."""
+    pointer = os.path.join(root, "LATEST")
+    try:
+        with open(pointer) as f:
+            name = f.read().strip()
+    except OSError:
+        return None
+    d = os.path.join(root, name)
+    return d if os.path.isdir(d) else None
+
+
 def save_progress(path: str, progress: dict) -> None:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:

@@ -395,6 +395,15 @@ _DEFAULT: dict[str, Any] = {
                             # patterns — compile cost flat in C)
         "seed_stride": 1,   # community c's population seed =
                             # random_seed + c * seed_stride
+        "community_base": 0,  # GLOBAL index of this engine's first
+                              # community (cross-process sharding,
+                              # architecture.md §19): a shard worker
+                              # running communities [base, base+C) of a
+                              # larger fleet keeps every community's
+                              # global seed / name prefix / weather
+                              # offset, so its per-community outputs are
+                              # bit-identical to the in-process fleet's.
+                              # 0 = the whole fleet in one engine
         "weather_offset_hours": 0,  # community c's environment windows are
                                     # shifted by c * this many hours
                                     # (decorrelates fleet weather; 0 keeps
@@ -404,6 +413,36 @@ _DEFAULT: dict[str, Any] = {
                             # collect/observatory/checkpoint/telemetry run
                             # while the device solves; false restores the
                             # synchronous loop (for overlap A/Bs)
+    },
+    # Cross-process fleet sharding (dragg_tpu/shard — ROADMAP item 4,
+    # architecture.md §19; no reference analog: the reference's
+    # pathos+Redis fan-out died with its central store).  A jax-free
+    # COORDINATOR partitions fleet.communities into shard.workers
+    # contiguous community ranges, each run by its own supervised worker
+    # process (own mesh/backend, own chunk-boundary checkpoints); only
+    # per-chunk per-community aggregate series cross process boundaries.
+    "shard": {
+        "workers": 1,       # shard worker processes N (1 = the in-process
+                            # fleet engine, byte-identical legacy path);
+                            # communities split into N contiguous ranges
+        "chunk_steps": 8,   # sim timesteps per shard chunk — the unit of
+                            # outbox exchange, checkpointing, and crash
+                            # re-work (a killed shard replays at most one)
+        "deadline_s": 0.0,  # PROGRESS deadline per shard — re-armed on
+                            # every merged chunk and on relaunch, so it
+                            # bounds time WITHOUT progress, not a whole
+                            # multi-hour run (0 = resilience.deadline_s)
+        "stall_s": 0.0,     # kill a worker whose heartbeat goes older
+                            # than this (0 = disabled — a big CPU chunk
+                            # legitimately computes longer than any beat
+                            # cadence; set ~900 for on-chip runs)
+        "restarts": 3,      # relaunches per shard before the run fails
+        "degrade_after": 1,  # consecutive failures of one shard before
+                             # it degrades TPU→CPU INDEPENDENTLY of the
+                             # others (resilience.degrade_to_cpu gates;
+                             # transition journaled with the taxonomy
+                             # kind)
+        "poll_s": 0.05,     # coordinator spool/liveness poll cadence
     },
     # Unified run telemetry (dragg_tpu/telemetry — round-7 tentpole).
     "telemetry": {

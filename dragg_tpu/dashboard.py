@@ -147,6 +147,12 @@ class Dashboard:
         pattern = os.path.join(self.ref.outputs_dir, "**",
                                telemetry.EVENTS_FILE)
         for path in glob.glob(pattern, recursive=True):
+            # Per-shard sub-streams (shard<k>/events.jsonl) are merged
+            # into their coordinator run's tail, not listed as runs of
+            # their own.
+            parent = os.path.basename(os.path.dirname(path))
+            if parent.startswith("shard") and parent[len("shard"):].isdigit():
+                continue
             try:
                 mtime = os.path.getmtime(path)
             except OSError:
@@ -179,7 +185,14 @@ class Dashboard:
         delegates to the shared bounded tailer (telemetry.tail_events),
         which the serving daemon's ``/events.jsonl`` endpoint uses too;
         serve run directories therefore show up in ``/live`` like any
-        other stream."""
+        other stream.  A shard coordinator run (per-shard sub-streams
+        under ``shard<k>/`` — dragg_tpu/shard/slots.py) is tailed
+        MERGED: the shared multi-stream tailer interleaves every
+        sub-stream by wall time and stamps each record's ``_stream``
+        source."""
+        if len(telemetry.stream_paths(events_path)) > 1:
+            return telemetry.tail_events_dir(events_path, limit=limit,
+                                             tail_bytes=tail_bytes)
         return telemetry.tail_events(events_path, limit=limit,
                                      tail_bytes=tail_bytes)
 

@@ -172,6 +172,73 @@ def _check_serve_journal() -> dict:
         return {"status": FAIL, "error": repr(e)}
 
 
+def _check_shard_journal() -> dict:
+    """Shard-coordinator crash-safety selftest (``--shard-check``),
+    mirroring the round-11 serve_journal check for the round-18 shard
+    substrate (dragg_tpu/shard/journal.py): the epoch → plan → chunk
+    lifecycle round-trips, a DUPLICATE epoch token is refused (reusing a
+    dead coordinator's token would re-admit the orphan workers the spool
+    EPOCH fence exists to stop), and replay survives truncation at EVERY
+    byte boundary — the frontier only ever walks backward to a prefix,
+    never corrupts.  Pure stdlib; never launches a worker."""
+    import tempfile
+
+    try:
+        from dragg_tpu.shard.journal import Journal, replay
+
+        with tempfile.TemporaryDirectory(prefix="dragg_shard_") as d:
+            path = os.path.join(d, "shard_journal.jsonl")
+            j = Journal(path)
+            j.epoch("probe-epoch")
+            j.plan(4, 2, [(0, 2), (2, 4)], steps=8, chunk_steps=2)
+            j.launch(0, 1, "cpu", 0, 2)
+            j.chunk(0, 0, 0, 2)
+            j.chunk(0, 1, 2, 4)
+            j.chunk(1, 0, 0, 2)
+            ok = True
+            try:
+                j.epoch("probe-epoch")  # duplicate must be refused
+                ok = False
+            except ValueError:
+                pass
+            j.close()
+            # A successor instance must refuse the duplicate too (the
+            # claim set survives via replay, not process memory).
+            j2 = Journal(path)
+            try:
+                j2.epoch("probe-epoch")
+                ok = False
+            except ValueError:
+                pass
+            j2.epoch("probe-epoch-2")
+            j2.close()
+            rep_full = replay(path)
+            ok &= rep_full.frontier == {0: 2, 1: 1}
+            ok &= rep_full.epochs == ["probe-epoch", "probe-epoch-2"]
+            # Torn-tail truncation at every byte boundary: replay never
+            # raises, the frontier is monotone non-increasing toward the
+            # head, and a torn final line drops silently (serve journal
+            # property-test precedent).
+            with open(path, "rb") as f:
+                raw = f.read()
+            prev_total = None
+            for cut in range(len(raw), -1, -1):
+                with open(path, "wb") as f:
+                    f.write(raw[:cut])
+                rep = replay(path)
+                total = sum(rep.frontier.values())
+                ok &= rep.dropped_lines <= 1
+                if prev_total is not None:
+                    ok &= total <= prev_total
+                prev_total = total
+        return {"status": OK if ok else FAIL,
+                "note": f"torn-tail sweep over {len(raw) + 1} boundaries",
+                **({} if ok else {"error": "shard journal selftest "
+                                           "mismatch"})}
+    except Exception as e:
+        return {"status": FAIL, "error": repr(e)}
+
+
 def _check_outputs(outputs_dir: str) -> dict:
     try:
         os.makedirs(outputs_dir, exist_ok=True)
@@ -214,7 +281,8 @@ def run_classify(backend_timeout: float = 60.0, stream=None) -> int:
 
 
 def run_doctor(outputs_dir: str = "outputs", backend_timeout: float = 60.0,
-               stream=None, compile_check: bool = False) -> int:
+               stream=None, compile_check: bool = False,
+               shard_check: bool = False) -> int:
     stream = stream or sys.stdout
     config_res, cfg = _check_config()
     backend_res = _check_backend(backend_timeout)
@@ -234,6 +302,8 @@ def run_doctor(outputs_dir: str = "outputs", backend_timeout: float = 60.0,
     if compile_check:
         checks["staged_compile"] = _check_staged_compile(
             max(backend_timeout, 300.0))
+    if shard_check:
+        checks["shard_journal"] = _check_shard_journal()
     # Pallas only matters when a TPU backend is up — and its self-test
     # compiles a kernel, so it runs in a SUBPROCESS with the same hard
     # timeout as the backend probe (a tunnel can wedge between probes).

@@ -326,6 +326,22 @@ def fleet_config(config: dict) -> tuple[int, int, int]:
     return (c, int(f.get("seed_stride", 1)), off)
 
 
+def fleet_community_base(config: dict) -> int:
+    """``fleet.community_base`` — the GLOBAL index of this engine's first
+    community (cross-process sharding, architecture.md §19): a shard
+    worker running communities ``[base, base + C)`` of a larger fleet
+    sets it so every community keeps its global identity — population
+    seed ``random_seed + (base + c) * seed_stride``, name prefix
+    ``c<base+c>-``, weather offset ``(base + c) * weather_offset_hours``
+    — and the shard's per-community outputs are bit-identical to the
+    same communities inside the in-process fleet.  Default 0 (the whole
+    fleet in one engine; every legacy path unchanged)."""
+    base = int(config.get("fleet", {}).get("community_base", 0))
+    if base < 0:
+        raise ValueError(f"fleet.community_base must be >= 0, got {base}")
+    return base
+
+
 def create_fleet_homes(config: dict, num_timesteps: int, dt: int,
                        waterdraw_df: pd.DataFrame) -> list[dict[str, Any]]:
     """Synthesize C independent communities (``fleet.communities``), each
@@ -335,7 +351,8 @@ def create_fleet_homes(config: dict, num_timesteps: int, dt: int,
     names are prefixed ``c<k>-`` so a 100k-home fleet cannot collide in
     the results.json / home_logs namespaces."""
     n_comm, stride, _off = fleet_config(config)
-    if n_comm == 1:
+    base = fleet_community_base(config)
+    if n_comm == 1 and base == 0:
         return create_homes(config, num_timesteps, dt, waterdraw_df)
     import copy as _copy
 
@@ -343,10 +360,10 @@ def create_fleet_homes(config: dict, num_timesteps: int, dt: int,
     all_homes: list[dict[str, Any]] = []
     for c in range(n_comm):
         cfg_c = _copy.deepcopy(config)
-        cfg_c["simulation"]["random_seed"] = base_seed + c * stride
+        cfg_c["simulation"]["random_seed"] = base_seed + (base + c) * stride
         homes_c = create_homes(cfg_c, num_timesteps, dt, waterdraw_df)
         for h in homes_c:
-            h["name"] = f"c{c}-{h['name']}"
+            h["name"] = f"c{base + c}-{h['name']}"
         all_homes.extend(homes_c)
     return all_homes
 
@@ -361,7 +378,8 @@ def fleet_spec_for(all_homes: list[dict], config: dict) -> FleetSpec | None:
     Raises when the list is not C equal blocks each grouped by type —
     the slicing the type-bucketed fleet engine depends on."""
     n_comm, stride, off_hours = fleet_config(config)
-    if n_comm == 1:
+    base = fleet_community_base(config)
+    if n_comm == 1 and base == 0:
         return None
     n_total = len(all_homes)
     if n_total % n_comm:
@@ -389,14 +407,17 @@ def fleet_spec_for(all_homes: list[dict], config: dict) -> FleetSpec | None:
         for (_t, a, b) in ranges0 for c in range(n_comm)])
     community = order // B
     local_idx = order % B
+    # ``community`` stays SHARD-LOCAL (0-based — the index the engine's
+    # fold/segment arrays use); the global identity rides the seeds, the
+    # env offsets, and the c<global>- name prefixes.
     return FleetSpec(
         n_communities=n_comm,
         homes_per_community=B,
-        seeds=tuple(base_seed + c * stride for c in range(n_comm)),
+        seeds=tuple(base_seed + (base + c) * stride for c in range(n_comm)),
         community=community.astype(np.int32),
         global_idx=order.astype(np.int32),
         local_idx=local_idx.astype(np.int32),
-        env_offset=(community * off_hours * dt).astype(np.int32),
+        env_offset=((base + community) * off_hours * dt).astype(np.int32),
     )
 
 

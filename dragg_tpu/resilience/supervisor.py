@@ -153,6 +153,7 @@ def run_supervised(argv: list[str], deadline_s: float, *,
                    tail_bytes: int = 4000,
                    stdout_path: str | None = None,
                    stderr_path: str | None = None,
+                   telemetry_dir: str | None = None,
                    log=None) -> SupervisedResult:
     """Run ``argv`` in a supervised child process.
 
@@ -179,7 +180,13 @@ def run_supervised(argv: list[str], deadline_s: float, *,
     # child joins it: its events (heartbeats, engine chunks, bench
     # results) land in the SAME events.jsonl as the supervisor's own
     # lifecycle records — one correlated forensic file per run.
-    if telemetry.run_dir():
+    # ``telemetry_dir`` overrides the destination for parents running
+    # CONCURRENT children (the shard coordinator gives each worker
+    # ``<stream>/shard<k>`` so N shards never interleave into one bus
+    # file; telemetry.tail_events_dir merges the sub-streams back).
+    if telemetry_dir:
+        child_env[telemetry.ENV_DIR] = telemetry_dir
+    elif telemetry.run_dir():
         child_env.setdefault(telemetry.ENV_DIR, telemetry.run_dir())
     out_f = (open(stdout_path, "wb") if stdout_path else
              tempfile.NamedTemporaryFile(prefix="dragg_sup_out_", delete=False))

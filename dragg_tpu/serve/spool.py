@@ -106,6 +106,68 @@ def read_json(path: str) -> dict | None:
         return None
 
 
+# ------------------------------------------------------- shard spool layout
+#
+# The shard coordinator (dragg_tpu/shard — architecture.md §19) reuses
+# this module's atomic-rename discipline and EPOCH fencing with its own
+# per-shard directories: ``<spool>/s<k>/`` holds shard k's spec, outbox
+# chunk files, per-generation logs, and checkpoint tree.  Chunk files
+# are RETAINED until the run completes (unlike serve batches) — they are
+# the payload a restarted coordinator re-merges behind the journal's
+# acked frontier.
+
+
+def shard_dir(spool: str, shard: int) -> str:
+    return os.path.join(spool, f"s{shard}")
+
+
+def shard_outbox_dir(spool: str, shard: int) -> str:
+    return os.path.join(shard_dir(spool, shard), "outbox")
+
+
+def shard_spec_path(spool: str, shard: int) -> str:
+    return os.path.join(shard_dir(spool, shard), "spec.json")
+
+
+def shard_ckpt_root(spool: str, shard: int) -> str:
+    return os.path.join(shard_dir(spool, shard), "checkpoint")
+
+
+def ensure_shard_dirs(spool: str, shard: int) -> None:
+    os.makedirs(shard_outbox_dir(spool, shard), exist_ok=True)
+
+
+def chunk_name(seq: int) -> str:
+    return f"chunk-{seq}.json"
+
+
+def chunk_seq(name: str) -> int | None:
+    if not (name.startswith("chunk-") and name.endswith(".json")):
+        return None
+    try:
+        return int(name[len("chunk-"):-len(".json")])
+    except ValueError:
+        return None
+
+
+def chunk_path(spool: str, shard: int, seq: int) -> str:
+    return os.path.join(shard_outbox_dir(spool, shard), chunk_name(seq))
+
+
+def list_chunks(directory: str) -> list[tuple[int, str]]:
+    """(seq, path) pairs of shard chunk files, oldest seq first."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        seq = chunk_seq(name)
+        if seq is not None:
+            out.append((seq, os.path.join(directory, name)))
+    return sorted(out)
+
+
 def batch_name(seq: int) -> str:
     return f"batch-{seq}.json"
 

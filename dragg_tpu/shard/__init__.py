@@ -1,0 +1,27 @@
+"""Cross-process fleet sharding (ROADMAP item 4, architecture.md §19).
+
+A jax-free COORDINATOR process partitions ``fleet.communities`` into
+``shard.workers`` contiguous community ranges and runs each range in its
+own supervised worker process.  Workers own their own mesh/backend and
+exchange only per-chunk per-community aggregate series over the spool —
+never raw state — so the coordinator's merge is ``real_home_pairs``-
+ordered and bit-identical to the in-process fleet (tests/test_shard.py).
+
+Layers (each its own module, parent side strictly jax-free):
+
+* :mod:`partition` — community-range math, shard configs, and the ONE
+  per-community fold both sides of every parity comparison share;
+* :mod:`journal`   — the coordinator's fsync'd crash-safety record
+  (chunk-frontier replay, duplicate-epoch refusal);
+* :mod:`worker`    — the jax child (``python -m dragg_tpu.shard.worker``);
+* :mod:`slots`     — non-blocking per-shard supervision handles;
+* :mod:`coordinator` — the run loop: launch, merge, requeue, degrade,
+  resume.
+"""
+
+from dragg_tpu.shard.partition import (  # noqa: F401
+    fold_community_series,
+    merge_shard_series,
+    shard_config,
+    shard_ranges,
+)

@@ -1,0 +1,63 @@
+"""Coordinator CLI: ``python -m dragg_tpu.shard --run-dir D --steps T``.
+
+Runs (or RESUMES — the run dir is the durable state) a sharded fleet
+baseline and prints the merged result as one JSON line.  This parent is
+jax-free by contract; all device work happens in the supervised shard
+workers.  Kill it with -9 and run the same command again: the journal
+replays to the exact chunk frontier (tests/test_shard.py pins it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="dragg_tpu.shard")
+    ap.add_argument("--config", default=None,
+                    help="TOML config path (default: defaults + flags)")
+    ap.add_argument("--run-dir", required=True,
+                    help="journal + spool directory (durable; calling "
+                         "again resumes)")
+    ap.add_argument("--steps", type=int, required=True)
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="shard.chunk_steps override")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="shard.workers override")
+    ap.add_argument("--communities", type=int, default=None,
+                    help="fleet.communities override")
+    ap.add_argument("--homes", type=int, default=None,
+                    help="community.total_number_homes override")
+    ap.add_argument("--stop-t", type=int, default=None,
+                    help="quiesce every shard at this chunk boundary "
+                         "(the reshard barrier); resume without it to "
+                         "finish")
+    ap.add_argument("--platform", choices=["auto", "tpu", "cpu"],
+                    default="auto")
+    ap.add_argument("--data-dir", default=None)
+    args = ap.parse_args(argv)
+
+    from dragg_tpu.config import load_config
+    from dragg_tpu.resilience.supervisor import assert_parent_has_no_jax
+    from dragg_tpu.shard.coordinator import run_sharded
+
+    assert_parent_has_no_jax()
+    config = load_config(args.config)
+    if args.communities is not None:
+        config.setdefault("fleet", {})["communities"] = args.communities
+    if args.homes is not None:
+        config["community"]["total_number_homes"] = args.homes
+    result = run_sharded(
+        config, run_dir=args.run_dir, steps=args.steps,
+        workers=args.workers, chunk_steps=args.chunk,
+        platform=args.platform, data_dir=args.data_dir,
+        stop_t=args.stop_t,
+        log=lambda m: print(f"[shard] {m}", file=sys.stderr, flush=True))
+    print(json.dumps(result))
+    return 0 if result["ok"] or result["stopped_early"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,407 @@
+"""The shard coordinator — jax-free, crash-safe, restartable.
+
+``run_sharded`` partitions ``fleet.communities`` into ``shard.workers``
+contiguous ranges, runs each range in a supervised worker process
+(shard/slots.py), and merges the per-chunk per-community aggregate
+series the workers ship over the spool.  The parent NEVER initializes a
+jax backend (resilience.supervisor contract — a wedged tunnel must not
+hang the one process that classifies and survives it).
+
+Durability model (the round-11/16 serving machinery, applied to chunk
+ownership):
+
+* the **spool** (serve/spool.py) holds the wire state: per-shard specs,
+  outbox chunk files (atomic renames, RETAINED until the run completes),
+  per-generation logs/checkpoints, and the EPOCH ownership token that
+  fences orphan workers of a killed coordinator;
+* the **journal** (shard/journal.py, fsync'd) holds the decisions: the
+  run plan, every launch/exit/transition, and one ``chunk`` ack per
+  merged chunk — a restarted coordinator replays it to the exact
+  per-shard chunk frontier, re-reads the acked chunks' retained spool
+  files, and resumes the loop; nothing is re-solved behind the frontier
+  and at most ONE chunk per shard is recomputed ahead of it (the
+  worker's outbox-then-checkpoint ordering);
+* each shard **degrades TPU→CPU independently**: after
+  ``shard.degrade_after`` consecutive failures (and
+  ``resilience.degrade_to_cpu``) the relaunch pins the wedge-proof CPU
+  environment, with the taxonomy kind journaled and the transition on
+  the telemetry stream — the other shards keep their platform.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+import uuid
+
+import numpy as np
+
+from dragg_tpu import telemetry
+from dragg_tpu.serve import spool as sp
+from dragg_tpu.shard import journal as sj
+from dragg_tpu.shard.partition import merge_shard_series, shard_ranges
+from dragg_tpu.shard.slots import ShardSlot
+
+JOURNAL_FILE = "shard_journal.jsonl"
+MERGED_FILE = "merged.json"
+
+
+def shard_settings(config: dict) -> dict:
+    """The ``[shard]`` config section with defaults applied."""
+    from dragg_tpu.config import default_config
+
+    merged = dict(default_config()["shard"])
+    merged.update((config or {}).get("shard", {}))
+    return merged
+
+
+class _Shard:
+    """Coordinator-side state for one shard: slot + frontier + merge."""
+
+    def __init__(self, slot: ShardSlot, c0: int, c1: int):
+        self.slot = slot
+        self.c0, self.c1 = c0, c1
+        self.frontier = 0          # next unacked chunk seq
+        self.payloads: dict = {}   # seq -> merged chunk payload
+        self.failures = 0          # consecutive failures since last ack
+        self.restarts = 0
+        # Progress clock for the deadline: re-armed on every chunk ack,
+        # so ``shard.deadline_s`` bounds the time WITHOUT progress, not
+        # a whole (legitimately multi-hour) shard run.
+        self.progress_at = time.monotonic()
+        self.done_journaled = False
+
+    def stalled_for(self) -> float:
+        return time.monotonic() - max(
+            self.progress_at,
+            self.slot.launched_at if self.slot.launched_at is not None
+            else self.progress_at)
+
+
+def run_sharded(config: dict, *, run_dir: str, steps: int,
+                workers: int | None = None, chunk_steps: int | None = None,
+                platform: str = "auto", data_dir: str | None = None,
+                stop_t: int | None = None, start_index: int = 0,
+                log=None) -> dict:
+    """Run ``steps`` baseline timesteps of the config's fleet across
+    shard worker processes; return the merged result dict (also written
+    to ``<run_dir>/merged.json``).
+
+    ``run_dir`` is the durable state (journal + spool): calling again
+    with the same directory RESUMES — after a coordinator kill, a
+    partial ``stop_t`` run, or a checkpoint reshard
+    (tools/reshard_checkpoint.py) — refusing a changed plan loudly.
+    ``stop_t`` stops every shard exactly at that chunk boundary (the
+    reshard quiesce barrier); resume with ``stop_t=None`` to finish.
+    ``platform`` "cpu" pins every worker to the wedge-proof CPU env;
+    "auto"/"tpu" inherit the caller's backend resolution, degrading
+    per shard on classified failures.
+    """
+    from dragg_tpu.homes import fleet_config
+
+    scfg = shard_settings(config)
+    from dragg_tpu.resilience.runner import resilience_config
+
+    rcfg = resilience_config(config)
+    n_workers = int(workers if workers is not None else scfg["workers"])
+    k_chunk = int(chunk_steps if chunk_steps is not None
+                  else scfg["chunk_steps"])
+    if k_chunk < 1:
+        raise ValueError(f"shard.chunk_steps must be >= 1, got {k_chunk}")
+    # ``deadline_s`` is a PROGRESS deadline: the clock re-arms on every
+    # merged chunk (and on relaunch), so a healthy shard acking chunks
+    # for hours is never killed — only one that stops producing.
+    deadline_s = float(scfg["deadline_s"]) or float(rcfg["deadline_s"])
+    stall_s = float(scfg["stall_s"]) or None
+    max_restarts = int(scfg["restarts"])
+    degrade_after = int(scfg["degrade_after"])
+    poll_s = float(scfg["poll_s"])
+    degrade_to_cpu = bool(rcfg.get("degrade_to_cpu", True))
+
+    C = fleet_config(config)[0]
+    ranges = shard_ranges(C, n_workers)
+    target_t = steps if stop_t is None else min(int(stop_t), steps)
+    if target_t % k_chunk and target_t != steps:
+        raise ValueError(
+            f"stop_t={target_t} is not a chunk boundary (chunk_steps="
+            f"{k_chunk}) — shards must quiesce at equal frontiers")
+    n_chunks_target = math.ceil(target_t / k_chunk)
+
+    os.makedirs(run_dir, exist_ok=True)
+    spool_dir = os.path.join(run_dir, "spool")
+    opened_bus = False
+    if (config.get("telemetry", {}).get("enabled", True)
+            and not telemetry.active()):
+        telemetry.init_run(run_dir)
+        opened_bus = True
+    journal = sj.Journal(os.path.join(run_dir, JOURNAL_FILE))
+    shards: dict[int, _Shard] = {}
+    t_run0 = time.monotonic()
+    try:
+        rep = sj.replay(journal.path)
+        plan = {"communities": C, "workers": n_workers,
+                "ranges": [[a, b] for a, b in ranges], "steps": int(steps),
+                "chunk_steps": k_chunk}
+        if rep.plan is not None:
+            got = {k: rep.plan.get(k) for k in plan}
+            if got != plan:
+                raise ValueError(
+                    f"shard run {run_dir} was journaled for plan {got}, "
+                    f"asked to run {plan} — reshard the checkpoints "
+                    f"(tools/reshard_checkpoint.py) instead of mutating a "
+                    f"run in place")
+        else:
+            journal.plan(C, n_workers, ranges, int(steps), k_chunk)
+        # Fresh ownership token: orphan workers of a dead predecessor
+        # exit at their next chunk boundary (spool EPOCH fence).
+        token = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        journal.epoch(token)
+        sp.write_epoch(spool_dir, token)
+        telemetry.emit("shard.plan", communities=C, workers=n_workers,
+                       ranges=[[a, b] for a, b in ranges], steps=steps,
+                       chunk_steps=k_chunk, target_t=target_t,
+                       resumed=rep.plan is not None)
+        if log:
+            log(f"plan: {C} communities over {n_workers} shards "
+                f"{ranges}, steps={steps}, chunk={k_chunk}"
+                + (f", resume frontier {dict(rep.frontier)}"
+                   if rep.plan is not None else ""))
+
+        for k, (c0, c1) in enumerate(ranges):
+            sh = _Shard(ShardSlot(spool_dir, k, epoch=token, log=log),
+                        c0, c1)
+            sh.restarts = rep.restarts.get(k, 0)
+            shards[k] = sh
+            sp.atomic_write_json(
+                sp.shard_spec_path(spool_dir, k),
+                {"config": config, "data_dir": data_dir, "c0": c0,
+                 "c1": c1, "steps": int(steps), "chunk_steps": k_chunk,
+                 "stop_t": target_t if target_t < steps else None,
+                 "start_index": start_index})
+            # A successor CONTINUES the generation numbering so per-gen
+            # logs and payload ``gen`` tags stay distinct across
+            # coordinator restarts (the steady-rate filter in _merge
+            # treats each generation's first chunk as its compile).
+            sh.slot.gen = rep.gens.get(k, 0)
+            # Replay the journaled frontier from the retained spool files
+            # — the payloads of record for every acked chunk.  Capped at
+            # THIS run's target: a resume with a smaller stop_t must not
+            # merge (or emit) chunks past the quiesce barrier.
+            for seq in range(min(rep.frontier.get(k, 0), n_chunks_target)):
+                payload = sp.read_json(sp.chunk_path(spool_dir, k, seq))
+                if payload is None:
+                    raise ValueError(
+                        f"journal acks shard {k} chunk {seq} but its spool "
+                        f"file is missing/torn — the run dir is corrupt")
+                sh.payloads[seq] = payload
+                sh.frontier = seq + 1
+
+        # Launch platform: "cpu" pins the wedge-proof CPU env, "tpu" and
+        # "auto" inherit the caller's backend resolution ("inherit" in
+        # the journal/logs).  A shard the journal says already degraded
+        # stays on its degraded platform (provenance respected across
+        # coordinator restarts).
+        base_platform = "cpu" if platform == "cpu" else (
+            "tpu" if platform == "tpu" else "inherit")
+        for k, sh in shards.items():
+            if sh.frontier >= n_chunks_target:
+                continue
+            p = rep.platforms.get(k, base_platform)
+            sh.slot.launch("cpu" if p == "cpu" else base_platform)
+            journal.launch(k, sh.slot.gen, sh.slot.platform, sh.c0, sh.c1)
+
+        def _drain(sh: _Shard, k: int) -> None:
+            """Merge every consecutive ready chunk at the frontier."""
+            while sh.frontier < n_chunks_target:
+                seq = sh.frontier
+                payload = sp.read_json(sp.chunk_path(spool_dir, k, seq))
+                if payload is None or int(payload.get("seq", -1)) != seq:
+                    return
+                sh.payloads[seq] = payload
+                sh.frontier = seq + 1
+                sh.failures = 0
+                sh.progress_at = time.monotonic()  # re-arm the deadline
+                journal.chunk(k, seq, int(payload["t0"]),
+                              int(payload["t1"]))
+                telemetry.emit("shard.chunk", shard=k, seq=seq,
+                               t0=payload["t0"], t1=payload["t1"],
+                               solve_rate=payload.get("solve_rate"),
+                               device_s=payload.get("device_s"))
+                if payload.get("device_s") is not None:
+                    telemetry.observe("shard.chunk_s",
+                                      float(payload["device_s"]))
+
+        while True:
+            for k, sh in shards.items():
+                _drain(sh, k)
+                if sh.frontier >= n_chunks_target:
+                    if not sh.done_journaled:
+                        sh.done_journaled = True
+                        journal.done(k, sh.frontier)
+                        telemetry.emit("shard.done", shard=k,
+                                       chunks=sh.frontier)
+                        if log:
+                            log(f"shard s{k} complete "
+                                f"({sh.frontier} chunks)")
+                    if sh.slot.alive() and sh.slot.elapsed() > 30.0:
+                        sh.slot.kill()  # lingering past its natural exit
+                    continue
+                if not sh.slot.alive() and sh.slot.proc is not None:
+                    # Late outbox harvest first: the worker may have died
+                    # AFTER writing its final chunk.
+                    _drain(sh, k)
+                    if sh.frontier >= n_chunks_target:
+                        continue
+                    kind = sh.slot.verdict()
+                    _record_failure(journal, sh, k, kind)
+                    _relaunch(journal, sh, k, base_platform,
+                              degrade_after, degrade_to_cpu,
+                              max_restarts, log)
+                    continue
+                if sh.slot.alive():
+                    killed = None
+                    age = sh.slot.heartbeat_age()
+                    if stall_s is not None and age is not None \
+                            and age > stall_s:
+                        killed = dict(stalled=True)
+                    elif sh.stalled_for() > deadline_s:
+                        killed = dict(timed_out=True)
+                    if killed:
+                        sh.slot.kill()
+                        kind = sh.slot.verdict(**killed)
+                        _record_failure(journal, sh, k, kind)
+                        _relaunch(journal, sh, k, base_platform,
+                                  degrade_after, degrade_to_cpu,
+                                  max_restarts, log)
+            if all(sh.frontier >= n_chunks_target
+                   for sh in shards.values()):
+                break
+            time.sleep(poll_s)
+
+        result = _merge(shards, ranges, config, C, k_chunk, target_t,
+                        steps, time.monotonic() - t_run0)
+        sp.atomic_write_json(os.path.join(run_dir, MERGED_FILE), result)
+        telemetry.emit("shard.merge", communities=C, workers=n_workers,
+                       steps=target_t, solve_rate=result["solve_rate"],
+                       restarts=result["restarts"],
+                       elapsed_s=result["elapsed_s"])
+        return result
+    finally:
+        for sh in shards.values():
+            sh.slot.kill(grace_s=2.0)
+        journal.close()
+        if opened_bus:
+            telemetry.close_run(write_metrics=True)
+
+
+def _record_failure(journal: sj.Journal, sh: _Shard, k: int,
+                    kind: str) -> None:
+    rc = sh.slot.proc.poll() if sh.slot.proc is not None else None
+    sh.failures += 1
+    journal.exit(k, sh.slot.gen, rc, kind)
+    telemetry.emit("shard.exit", shard=k, gen=sh.slot.gen, rc=rc,
+                   failure=kind)
+    telemetry.emit("failure." + kind,  # dragg: disable=DT007, kind from taxonomy.FAILURE_KINDS, each registered literally
+                   source="shard", label=f"s{k}", rc=rc)
+
+
+def _relaunch(journal: sj.Journal, sh: _Shard, k: int, base_platform: str,
+              degrade_after: int, degrade_to_cpu: bool, max_restarts: int,
+              log) -> None:
+    if sh.restarts >= max_restarts:
+        raise RuntimeError(
+            f"shard {k} failed {sh.restarts + 1} times (restart budget "
+            f"{max_restarts}) — giving up; the journal and checkpoints "
+            f"hold the frontier for a later resume")
+    sh.restarts += 1
+    platform = sh.slot.platform or base_platform
+    if (degrade_to_cpu and platform != "cpu"
+            and sh.failures >= degrade_after):
+        journal.transition(k, platform, "cpu", None)
+        telemetry.emit("shard.transition", shard=k, from_platform=platform,
+                       to_platform="cpu")
+        telemetry.emit("degrade.transition", from_platform=platform,
+                       to_platform="cpu", failure=None)
+        platform = "cpu"
+        if log:
+            log(f"shard s{k} degrading to cpu after {sh.failures} "
+                f"consecutive failures")
+    sh.slot.launch(platform)
+    journal.launch(k, sh.slot.gen, sh.slot.platform, sh.c0, sh.c1)
+
+
+def _merge(shards: dict[int, _Shard], ranges, config: dict, C: int,
+           k_chunk: int, target_t: int, steps: int,
+           elapsed_s: float) -> dict:
+    """Assemble the merged result: per-community (T, C) series in
+    community-major (``real_home_pairs``) order, fleet totals, and the
+    run provenance."""
+    series_names = sorted(next(iter(shards[0].payloads.values()))
+                          ["series"]) if shards[0].payloads else []
+    series: dict[str, np.ndarray] = {}
+    for name in series_names:
+        per_shard = {}
+        for k, sh in shards.items():
+            blocks = [np.asarray(sh.payloads[seq]["series"][name],
+                                 dtype=np.float64)
+                      for seq in range(sh.frontier)]
+            per_shard[k] = (np.concatenate(blocks, axis=0) if blocks
+                            else np.zeros((0, sh.c1 - sh.c0)))
+        series[name] = merge_shard_series(per_shard, ranges)
+    B = int(config["community"]["total_number_homes"])
+    solved = series.get("solved")
+    T = solved.shape[0] if solved is not None else 0
+    solve_rate = (float(solved.sum()) / max(T * C * B, 1)
+                  if solved is not None else None)
+    viol_max = max((sh.payloads[seq].get("viol_max", 0.0)
+                    for sh in shards.values()
+                    for seq in range(sh.frontier)), default=0.0)
+    band_tol = max((sh.payloads[seq].get("band_tol", 0.05)
+                    for sh in shards.values()
+                    for seq in range(sh.frontier)), default=0.05)
+    platforms = sorted({sh.payloads[seq].get("platform", "?")
+                        for sh in shards.values()
+                        for seq in range(sh.frontier)})
+    # Steady-state device rate: per-chunk device seconds EXCLUDING each
+    # generation's first chunk (it carries the compile) — the honest
+    # home-steps/s the N-shard vs in-process A/B compares
+    # (docs/perf_notes.md).
+    steady_s, steady_steps = 0.0, 0
+    for sh in shards.values():
+        seen_gen = set()
+        for seq in range(sh.frontier):
+            p = sh.payloads[seq]
+            gen = p.get("gen", 1)
+            if gen not in seen_gen:
+                seen_gen.add(gen)  # first chunk of this gen = compile
+                continue
+            if p.get("device_s") is None:
+                continue  # resharded history carries no device wall
+            steady_s += float(p["device_s"])
+            steady_steps += int(p["t1"]) - int(p["t0"])
+    return {
+        "ok": bool(viol_max <= band_tol),
+        "communities": C,
+        "homes_per_community": B,
+        "homes_total": C * B,
+        "workers": len(shards),
+        "ranges": [[a, b] for a, b in ranges],
+        "steps": target_t,
+        "stopped_early": target_t < steps,
+        "chunk_steps": k_chunk,
+        "series": {k: v.tolist() for k, v in series.items()},
+        "totals": {k: v.sum(axis=1).tolist() for k, v in series.items()},
+        "solve_rate": (round(solve_rate, 4)
+                       if solve_rate is not None else None),
+        "viol_max": round(float(viol_max), 5),
+        "platforms": platforms,
+        "restarts": {k: sh.restarts for k, sh in shards.items()
+                     if sh.restarts},
+        "elapsed_s": round(elapsed_s, 2),
+        "home_steps_per_s": round(C * B * target_t / max(elapsed_s, 1e-9),
+                                  1),
+        "steady_home_steps_per_s": (
+            round(C * B * steady_steps / steady_s, 1)
+            if steady_s > 0 and steady_steps > 0 else None),
+    }

@@ -1,0 +1,219 @@
+"""The coordinator's crash-safe shard journal — stdlib only, fsync'd.
+
+The round-11 serve journal's durability contract, applied to chunk
+ownership: every record the coordinator relies on after a restart is one
+fsync'd JSONL line, so a kill -9 at ANY instruction leaves a replayable
+file.  A restarted coordinator folds the journal to the exact per-shard
+chunk frontier (acked chunks are merged from their retained spool files,
+everything after the frontier is recomputed by the resumed workers —
+re-work bounded by one chunk per the worker's outbox-then-checkpoint
+ordering).
+
+Record grammar (one JSON object per line, ``state`` discriminates)::
+
+    {"state": "epoch", "token": ...}                  ownership claim
+    {"state": "plan", "communities": C, "workers": N,
+     "ranges": [[c0, c1], ...], "steps": T,
+     "chunk_steps": k}                                run geometry
+    {"state": "launch", "shard": k, "gen": g,
+     "platform": p, "c0": ..., "c1": ...}             worker generation
+    {"state": "chunk", "shard": k, "seq": n,
+     "t0": ..., "t1": ...}                            merge ack (frontier)
+    {"state": "exit", "shard": k, "gen": g, "rc": ...,
+     "failure": kind}                                 classified death
+    {"state": "transition", "shard": k, "from": p,
+     "to": p2, "failure": kind}                       degradation mark
+    {"state": "done", "shard": k, "chunks": n}        shard completed
+
+Crash consistency is by construction (serve/journal.py precedent): a
+torn final line parses as garbage and is dropped by :func:`replay` —
+the write that tore never returned, so nothing observable is lost.
+
+Duplicate-epoch refusal: an epoch token may be claimed ONCE per journal.
+The token is what fences orphan workers out of the spool
+(serve/spool.py EPOCH file); a successor that re-used a dead
+coordinator's token would re-admit exactly the orphans the fence exists
+to stop, so :meth:`Journal.epoch` raises instead of appending.
+``python -m dragg_tpu doctor --shard-check`` self-tests both properties
+(torn-tail truncation at every byte boundary, duplicate refusal).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import NamedTuple
+
+EPOCH = "epoch"
+PLAN = "plan"
+LAUNCH = "launch"
+CHUNK = "chunk"
+EXIT = "exit"
+TRANSITION = "transition"
+DONE = "done"
+
+
+class ReplayState(NamedTuple):
+    """The fold of one shard journal.
+
+    ``epochs``     — claimed ownership tokens, oldest first;
+    ``plan``       — the run-geometry record (None before the first run);
+    ``frontier``   — shard -> next unacked chunk seq (0 = nothing acked);
+    ``acked``      — shard -> sorted list of acked chunk seqs;
+    ``platforms``  — shard -> platform of the newest launch/transition;
+    ``gens``       — shard -> highest launched generation (a successor
+                     coordinator CONTINUES the numbering, so per-gen
+                     logs/payloads stay distinct across restarts);
+    ``restarts``   — shard -> launches beyond the first generation
+                     (across every coordinator lifetime);
+    ``done``       — shards whose completion was journaled;
+    ``dropped_lines`` — unparseable lines skipped (a torn tail is 0 or
+                     1; more means outside interference — surfaced, not
+                     fatal).
+    """
+
+    epochs: list
+    plan: dict | None
+    frontier: dict
+    acked: dict
+    platforms: dict
+    gens: dict
+    restarts: dict
+    done: set
+    dropped_lines: int
+
+
+class Journal:
+    """Append side.  One instance owns the file handle; every append is
+    fsync'd before returning."""
+
+    def __init__(self, path: str, fsync: bool = True):
+        self.path = path
+        self._fsync = fsync
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        rep = replay(path)
+        self._epochs = set(rep.epochs)
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def _append(self, rec: dict) -> None:
+        self._fh.write(json.dumps(rec, separators=(",", ":"),
+                                  default=str) + "\n")
+        self._fh.flush()
+        if self._fsync:
+            os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+    # ----------------------------------------------------------- lifecycle
+    def epoch(self, token: str) -> None:
+        """Claim the run for one coordinator instance.  Raises on a
+        duplicate token — reusing a dead coordinator's token would
+        re-admit the orphan workers the spool EPOCH fence exists to
+        stop."""
+        if token in self._epochs:
+            raise ValueError(
+                f"epoch token {token!r} already claimed in {self.path} — "
+                f"a successor coordinator must mint a fresh token")
+        self._epochs.add(token)
+        self._append({"state": EPOCH, "token": token})
+
+    def plan(self, communities: int, workers: int,
+             ranges: list[tuple[int, int]], steps: int,
+             chunk_steps: int) -> None:
+        self._append({"state": PLAN, "communities": communities,
+                      "workers": workers,
+                      "ranges": [[int(a), int(b)] for a, b in ranges],
+                      "steps": steps, "chunk_steps": chunk_steps})
+
+    def launch(self, shard: int, gen: int, platform: str,
+               c0: int, c1: int) -> None:
+        self._append({"state": LAUNCH, "shard": shard, "gen": gen,
+                      "platform": platform, "c0": c0, "c1": c1})
+
+    def chunk(self, shard: int, seq: int, t0: int, t1: int) -> None:
+        """Ack one merged chunk — the durable frontier record.  The chunk
+        PAYLOAD stays in the retained spool outbox file; the ack is what
+        tells a restarted coordinator the file is merged-and-owned."""
+        self._append({"state": CHUNK, "shard": shard, "seq": seq,
+                      "t0": t0, "t1": t1})
+
+    def exit(self, shard: int, gen: int, rc: int | None,
+             failure: str | None) -> None:
+        self._append({"state": EXIT, "shard": shard, "gen": gen,
+                      "rc": rc, "failure": failure})
+
+    def transition(self, shard: int, from_platform: str, to_platform: str,
+                   failure: str | None) -> None:
+        self._append({"state": TRANSITION, "shard": shard,
+                      "from": from_platform, "to": to_platform,
+                      "failure": failure})
+
+    def done(self, shard: int, chunks: int) -> None:
+        self._append({"state": DONE, "shard": shard, "chunks": chunks})
+
+
+def replay(path: str) -> ReplayState:
+    """Fold a journal file into :class:`ReplayState`.  Never raises on
+    file content: torn/garbage lines are counted and skipped, unknown
+    states ignored (forward compatibility)."""
+    epochs: list = []
+    plan: dict | None = None
+    acked: dict = {}
+    platforms: dict = {}
+    gens: dict = {}
+    restarts: dict = {}
+    done: set = set()
+    dropped = 0
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            lines = f.read().split("\n")
+    except OSError:
+        return ReplayState([], None, {}, {}, {}, {}, {}, set(), 0)
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            dropped += 1
+            continue
+        if not isinstance(rec, dict):
+            dropped += 1
+            continue
+        state = rec.get("state")
+        if state == EPOCH and "token" in rec:
+            if rec["token"] not in epochs:
+                epochs.append(rec["token"])
+        elif state == PLAN:
+            plan = rec  # newest wins (there should only ever be one)
+        elif state == CHUNK and "shard" in rec:
+            acked.setdefault(int(rec["shard"]), set()).add(int(rec["seq"]))
+        elif state == LAUNCH and "shard" in rec:
+            k = int(rec["shard"])
+            platforms[k] = rec.get("platform")
+            gen = int(rec.get("gen", 1))
+            gens[k] = max(gens.get(k, 0), gen)
+            if gen > 1:
+                restarts[k] = restarts.get(k, 0) + 1
+        elif state == TRANSITION and "shard" in rec:
+            platforms[int(rec["shard"])] = rec.get("to")
+        elif state == DONE and "shard" in rec:
+            done.add(int(rec["shard"]))
+    # The frontier is the first GAP in each shard's acked seqs: acks past
+    # a gap (out-of-order merge after a restart race) are re-merged from
+    # their retained spool files rather than trusted blindly.
+    frontier = {}
+    sorted_acks = {}
+    for k, seqs in acked.items():
+        n = 0
+        while n in seqs:
+            n += 1
+        frontier[k] = n
+        sorted_acks[k] = sorted(seqs)
+    return ReplayState(epochs, plan, frontier, sorted_acks, platforms,
+                       gens, restarts, done, dropped)

@@ -1,0 +1,145 @@
+"""Community-range partition math + the shared per-community fold —
+stdlib + numpy only (the coordinator imports this and must stay
+jax-free).
+
+The cross-shard coupling is deliberately LOW-DIMENSIONAL (the
+heterogeneous-aggregation template, PAPERS.md arxiv 2605.30763): a shard
+worker ships per-chunk per-community aggregate series — the
+``community_fold_arrays()`` reduction of its per-home outputs — and
+nothing else.  Bit-identity of the merged result with the in-process
+fleet then rests on two facts this module pins:
+
+* every community's per-home trajectory is composition-invariant (the
+  fleet parity contract, tests/test_fleet.py): a shard engine running
+  communities ``[c0, c0+k)`` with ``fleet.community_base = c0``
+  reproduces those communities' rows of the full fleet exactly;
+* both sides of every comparison fold per-home values through ONE
+  implementation — :func:`fold_community_series`, summing each
+  community's homes in community-major (``real_home_pairs``) order with
+  float64 accumulation, so the reduction order is identical no matter
+  which process ran the homes.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+# The per-home StepOutputs fields a shard worker folds per community and
+# ships over the spool (out-field name -> merged-series name).  The fold
+# of ``p_grid`` is each community's ``agg_load``-style sum; ``cost`` its
+# aggregate cost; ``correct_solve`` its solved-home count.
+FOLD_FIELDS = {
+    "p_grid": "agg_load",
+    "cost": "agg_cost",
+    "correct_solve": "solved",
+}
+
+
+def shard_ranges(communities: int, workers: int) -> list[tuple[int, int]]:
+    """Balanced CONTIGUOUS community ranges ``[(c0, c1), ...]`` — the
+    first ``communities % workers`` shards carry one extra community.
+    Contiguity is load-bearing: it keeps every shard a plain
+    ``community_base`` + count fleet config, and checkpoint resharding a
+    pure community-column regrouping."""
+    if workers < 1:
+        raise ValueError(f"shard.workers must be >= 1, got {workers}")
+    if communities < workers:
+        raise ValueError(
+            f"cannot split {communities} communities over {workers} shard "
+            f"workers — every shard needs at least one community")
+    base, extra = divmod(communities, workers)
+    ranges, c0 = [], 0
+    for k in range(workers):
+        n = base + (1 if k < extra else 0)
+        ranges.append((c0, c0 + n))
+        c0 += n
+    return ranges
+
+
+def shard_config(config: dict, c0: int, c1: int) -> dict:
+    """The shard worker's config for global communities ``[c0, c1)``:
+    ``fleet.communities`` becomes the range size and
+    ``fleet.community_base`` the range start (on top of any base the
+    parent config already carried), so seeds / name prefixes / weather
+    offsets keep their GLOBAL identities (homes.fleet_community_base).
+
+    Scenario event targeting is remapped too: an event naming explicit
+    global ``communities`` keeps only this shard's members, re-indexed
+    shard-local (the timeline builder sizes its (C, T) series by the
+    engine's local community count); events without the key apply
+    everywhere and pass through unchanged.  An event whose targets all
+    live on other shards is dropped here — it still fires there."""
+    cfg = copy.deepcopy(config)
+    fleet = cfg.setdefault("fleet", {})
+    parent_base = int(fleet.get("community_base", 0))
+    fleet["communities"] = int(c1 - c0)
+    fleet["community_base"] = parent_base + int(c0)
+    events = cfg.get("scenarios", {}).get("events", [])
+    if events:
+        kept = []
+        for ev in events:
+            ev = copy.deepcopy(ev)
+            targets = ev.get("communities")
+            if targets is not None:
+                local = [int(c) - c0 for c in targets if c0 <= int(c) < c1]
+                if not local:
+                    continue
+                ev["communities"] = local
+            kept.append(ev)
+        cfg["scenarios"]["events"] = kept
+    return cfg
+
+
+def fold_community_series(values: np.ndarray, pairs: np.ndarray,
+                          n_communities: int) -> np.ndarray:
+    """(T, C) float64 per-community sums of one per-home (T, cols) array.
+
+    ``pairs`` is ``engine.real_home_pairs`` — ``(community, output
+    column)`` per home in community-major order.  Each community's homes
+    are summed as one contiguous float64 block in that order (numpy's
+    pairwise reduction over an identically-shaped, identically-ordered
+    block), so a shard folding its local range and the in-process fleet
+    folding the same communities produce BIT-identical values — the
+    ground the merged-output parity tests stand on."""
+    pairs = np.asarray(pairs)
+    values = np.asarray(values, dtype=np.float64)
+    out = np.zeros((values.shape[0], n_communities), dtype=np.float64)
+    for c in range(n_communities):
+        cols = pairs[pairs[:, 0] == c, 1]
+        if cols.size:
+            out[:, c] = values[:, cols].sum(axis=1)
+    return out
+
+
+def fold_outputs(outs, pairs: np.ndarray, n_communities: int,
+                 fields: dict | None = None) -> dict[str, np.ndarray]:
+    """Fold one chunk's StepOutputs into the shipped per-community
+    series — the worker's wire payload AND the in-process reference the
+    parity tests compare against (one fold, two callers, zero drift)."""
+    out = {}
+    for field, name in (fields or FOLD_FIELDS).items():
+        out[name] = fold_community_series(
+            np.asarray(getattr(outs, field)), pairs, n_communities)
+    return out
+
+
+def merge_shard_series(per_shard: dict[int, np.ndarray],
+                       ranges: list[tuple[int, int]]) -> np.ndarray:
+    """Assemble per-shard (T, C_shard) blocks into the (T, C) fleet
+    series, community-major (shard k owns columns ``ranges[k]``)."""
+    T = next(iter(per_shard.values())).shape[0]
+    C = ranges[-1][1]
+    out = np.zeros((T, C), dtype=np.float64)
+    for k, (c0, c1) in enumerate(ranges):
+        out[:, c0:c1] = per_shard[k]
+    return out
+
+
+def series_to_lists(series: dict[str, np.ndarray]) -> dict[str, list]:
+    """JSON-safe nested lists.  Python floats are doubles and
+    ``json.dumps`` emits ``repr`` round-trippable values, so the
+    spool/merge path preserves every bit of the float64 fold."""
+    return {k: np.asarray(v, dtype=np.float64).tolist()
+            for k, v in series.items()}

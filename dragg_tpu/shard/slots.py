@@ -1,0 +1,136 @@
+"""Parent-side shard slots — stdlib only, never imports jax.
+
+A :class:`ShardSlot` owns one long-lived shard worker child
+(shard/worker.py) and the supervision state the coordinator's loop
+reads every tick: process liveness, heartbeat age (the round-4 stall
+detector), and the classified post-mortem verdict — the non-blocking
+shape of serve/pool.WorkerSlot, with one shard-specific addition:
+
+**per-shard telemetry streams.**  ``resilience/supervisor.py`` exports
+ONE ``$DRAGG_TELEMETRY_DIR`` to every child, which is right for a
+single supervised child but interleaves N concurrent shard workers'
+events into one bus file.  Each slot therefore exports
+``<stream>/shard<k>`` to its child — its own ``events.jsonl`` —
+and ``telemetry.tail_events_dir`` / the dashboard's ``/live`` merge the
+sub-streams back into one ordered view (docs/telemetry.md).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+from dragg_tpu import telemetry
+from dragg_tpu.resilience import heartbeat as hb
+from dragg_tpu.resilience.supervisor import kill_group, read_tail
+from dragg_tpu.resilience.taxonomy import classify_child
+from dragg_tpu.serve import spool
+
+
+def shard_stream_dir(base_dir: str, shard: int) -> str:
+    """Shard ``k``'s telemetry sub-stream directory under the
+    coordinator's stream dir — the ONE naming rule the slot export, the
+    merged tailer, and the dashboard all share."""
+    return os.path.join(base_dir, f"shard{shard}")
+
+
+class ShardSlot:
+    """One shard: launch/poll/kill a generation-counted worker child."""
+
+    def __init__(self, spool_dir: str, shard: int, *, epoch: str = "",
+                 log=None):
+        self.spool_dir = spool_dir
+        self.shard = shard
+        self.epoch = epoch
+        self.log = log
+        self.gen = 0
+        self.proc: subprocess.Popen | None = None
+        self.platform: str | None = None
+        self.hb_path: str | None = None
+        self.err_path: str | None = None
+        self.out_path: str | None = None
+        self.launched_at: float | None = None
+        spool.ensure_shard_dirs(spool_dir, shard)
+
+    def launch(self, platform: str, env_base: dict | None = None) -> None:
+        """Start generation ``gen+1``.  ``platform`` "cpu" pins the CPU
+        backend AND drops the axon plugin registration (runner.cpu_env —
+        the wedge-proof child environment); anything else inherits the
+        caller's backend resolution."""
+        from dragg_tpu.resilience.runner import cpu_env
+
+        assert self.proc is None or self.proc.poll() is not None
+        self.gen += 1
+        self.platform = platform
+        sdir = spool.shard_dir(self.spool_dir, self.shard)
+        fd, self.hb_path = tempfile.mkstemp(prefix=f"hb-{self.gen}-",
+                                            dir=sdir)
+        os.close(fd)
+        import json
+
+        with open(self.hb_path, "w") as f:
+            json.dump({"t": time.time()}, f)  # dragg: disable=DT014, heartbeat seed — the stall-kill protocol is wall-clock
+        env = cpu_env(env_base) if platform == "cpu" else dict(
+            os.environ if env_base is None else env_base)
+        env[hb.ENV] = self.hb_path
+        # The child runs ``-m dragg_tpu.shard.worker`` from whatever cwd
+        # the coordinator has — make the package importable even when
+        # the parent found it via sys.path (tools/ entry points).
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        # Per-shard telemetry sub-stream (module docstring): N concurrent
+        # children must not interleave into the coordinator's bus file.
+        stream = telemetry.run_dir() or env.get(telemetry.ENV_DIR)
+        if stream:
+            env[telemetry.ENV_DIR] = shard_stream_dir(stream, self.shard)
+        argv = [sys.executable, "-m", "dragg_tpu.shard.worker",
+                "--spool", self.spool_dir, "--shard", str(self.shard),
+                "--gen", str(self.gen)]
+        if self.epoch:
+            argv += ["--epoch", self.epoch]
+        self.out_path = os.path.join(sdir, f"out-{self.gen}.log")
+        self.err_path = os.path.join(sdir, f"err-{self.gen}.log")
+        with open(self.out_path, "wb") as out_f, \
+                open(self.err_path, "wb") as err_f:
+            self.proc = subprocess.Popen(argv, env=env, stdout=out_f,
+                                         stderr=err_f,
+                                         start_new_session=True)
+        self.launched_at = time.monotonic()
+        telemetry.emit("shard.launch", shard=self.shard, gen=self.gen,
+                       pid=self.proc.pid, platform=platform)
+        telemetry.inc("shard.restarts", 1 if self.gen > 1 else 0)
+        if self.log:
+            self.log(f"shard s{self.shard} gen={self.gen} "
+                     f"pid={self.proc.pid} platform={platform}")
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def heartbeat_age(self) -> float | None:
+        if self.hb_path is None:
+            return None
+        age, _ = hb.read(self.hb_path)
+        return age
+
+    def elapsed(self) -> float:
+        return (time.monotonic() - self.launched_at
+                if self.launched_at is not None else 0.0)
+
+    def kill(self, grace_s: float = 5.0) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            kill_group(self.proc, grace_s)
+
+    def verdict(self, *, timed_out: bool = False,
+                stalled: bool = False) -> str:
+        """Taxonomy kind for the (dead) current generation."""
+        rc = self.proc.poll() if self.proc is not None else None
+        tail = read_tail(self.err_path, 4000) if self.err_path else ""
+        kind = classify_child(rc, timed_out, stalled, tail)
+        return kind or "CHILD_CRASH"
+
+    def stderr_tail(self, limit: int = 2000) -> str:
+        return read_tail(self.err_path, limit) if self.err_path else ""

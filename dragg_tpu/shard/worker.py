@@ -1,0 +1,242 @@
+"""Shard worker — the jax child owning one contiguous community range.
+
+``python -m dragg_tpu.shard.worker --spool S --shard K --gen G --epoch T``
+
+Reads its range spec from ``<spool>/s<K>/spec.json`` (written by the
+coordinator), builds a fleet engine for global communities
+``[c0, c1)`` via ``fleet.community_base`` (homes.fleet_community_base —
+global seeds / names / weather offsets, so this shard's per-community
+trajectories are bit-identical to the in-process fleet's), and runs the
+chunk loop:
+
+1. **epoch fence** — read the spool EPOCH file; a mismatch means a
+   successor coordinator owns the run and this process is an orphan of a
+   killed one: exit between chunks (serve/spool.py precedent);
+2. ``fault_hook("shard_chunk")`` — the chaos suite's per-shard site
+   (``shard_build`` guards the engine build);
+3. run one device chunk, fold the per-home outputs into per-community
+   aggregate series (shard/partition.fold_outputs — the ONE fold parity
+   comparisons share) and write the outbox chunk file ATOMICALLY;
+4. checkpoint the scan carry (checkpoint.save_checkpoint_dir).
+
+The outbox-THEN-checkpoint order bounds crash re-work at one chunk: a
+kill between the two resumes at the previous frontier and recomputes a
+chunk whose (deterministic, bit-identical) outbox file it simply
+rewrites; a kill before the outbox write recomputes the same chunk.  A
+relaunched generation resumes from ``LATEST`` after validating the
+run-shape guard (aggregator._run_shape precedent — a reshard or config
+edit must start the shard fresh, not mis-assemble).
+
+``stop_t`` in the spec is the elastic-reshard quiesce barrier: every
+shard exits exactly at that chunk boundary, leaving equal-frontier
+checkpoints ``tools/reshard_checkpoint.py`` can regroup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _run_shape(spec: dict, cfg: dict, engine) -> dict:
+    """What a shard checkpoint is only valid for (the aggregator's
+    run-shape guard, scoped to one shard): community range + geometry +
+    every config dimension that sizes or re-interprets a carry leaf."""
+    return {
+        "c0": int(spec["c0"]), "c1": int(spec["c1"]),
+        "homes_per_community": int(cfg["community"]["total_number_homes"]),
+        "steps": int(spec["steps"]),
+        "chunk_steps": int(spec["chunk_steps"]),
+        "horizon": int(cfg["home"]["hems"]["prediction_horizon"]),
+        "solver": engine.params.solver,
+        "precision": engine.params.precision,
+        "warm_cols": engine.warm_cols,
+        "buckets": ([[b["name"], b["n_slots"]] for b in engine.bucket_info()]
+                    if engine.bucketed else None),
+        "n_home_slots": engine.n_homes,
+        "state_rev": 2,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spool", required=True)
+    ap.add_argument("--shard", type=int, required=True)
+    ap.add_argument("--gen", type=int, default=1)
+    ap.add_argument("--epoch", default="")
+    args = ap.parse_args()
+
+    from dragg_tpu.serve import spool as sp
+
+    spec = sp.read_json(sp.shard_spec_path(args.spool, args.shard))
+    if spec is None:
+        print(f"shard {args.shard}: no spec at "
+              f"{sp.shard_spec_path(args.spool, args.shard)}",
+              file=sys.stderr)
+        sys.exit(2)
+
+    import jax
+    import numpy as np
+
+    from dragg_tpu import telemetry
+    from dragg_tpu.checkpoint import (latest_checkpoint_dir, load_progress,
+                                      load_pytree, save_checkpoint_dir)
+    from dragg_tpu.data import (load_environment, load_waterdraw_profiles,
+                                waterdraw_path)
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_fleet_batch, create_fleet_homes
+    from dragg_tpu.resilience.faults import fault_hook
+    from dragg_tpu.resilience.heartbeat import beat
+    from dragg_tpu.shard.partition import (fold_outputs, series_to_lists,
+                                           shard_config)
+
+    c0, c1 = int(spec["c0"]), int(spec["c1"])
+    steps = int(spec["steps"])
+    chunk_steps = int(spec["chunk_steps"])
+    stop_t = spec.get("stop_t")
+    stop_t = steps if stop_t is None else min(int(stop_t), steps)
+
+    cfg = shard_config(spec["config"], c0, c1)
+    data_dir = spec.get("data_dir")
+
+    beat({"stage": "shard_build", "shard": args.shard})
+    fault_hook("shard_build")
+    env = load_environment(cfg, data_dir=data_dir)
+    dt = int(cfg["agg"]["subhourly_steps"])
+    # The waterdraw profile pool is seeded by the BASE simulation seed
+    # (shared by every community of the fleet — aggregator.get_homes);
+    # per-community identity rides fleet.community_base inside
+    # create_fleet_homes.
+    wd = load_waterdraw_profiles(
+        waterdraw_path(cfg, data_dir),
+        seed=int(cfg["simulation"]["random_seed"]))
+    homes = create_fleet_homes(cfg, steps, dt, wd)
+    hems = cfg["home"]["hems"]
+    horizon = max(1, int(hems["prediction_horizon"]) * dt)
+    batch, fleet = build_fleet_batch(homes, cfg, horizon, dt,
+                                     int(hems["sub_subhourly_steps"]))
+    # ``tpu.sharded`` resolves exactly like the aggregator's engine
+    # build: "auto" shards this shard's home axis when the worker sees
+    # >1 device (each worker owns its OWN mesh — that is the point of
+    # the process split), true/false force either path.  NOTE: sharded
+    # checkpoints carry slot-padded leaves; reshard them only at the
+    # same resolution (the run-shape guard refuses a mismatch loudly).
+    sharded = cfg.get("tpu", {}).get("sharded", "auto")
+    if sharded == "auto":
+        from dragg_tpu.resilience.devices import device_count
+
+        use_sharded = device_count() > 1
+    else:
+        use_sharded = bool(sharded)
+    start_index = int(spec.get("start_index", 0))
+    if use_sharded:
+        from dragg_tpu.parallel import make_sharded_engine
+
+        engine = make_sharded_engine(batch, env, cfg, start_index,
+                                     fleet=fleet, data_dir=data_dir)
+    else:
+        engine = make_engine(batch, env, cfg, start_index, fleet=fleet,
+                             data_dir=data_dir)
+    C_local = c1 - c0
+    pairs = np.asarray(engine.real_home_pairs)
+    cols = np.asarray(engine.real_home_cols)
+    platform = jax.devices()[0].platform  # dragg: disable=DT004, supervised shard child — committed to its backend
+
+    # Comfort-band bounds in community-major order (validate_scale
+    # convention), with the scenario relaxation headroom.
+    order = (np.argsort(np.asarray(fleet.global_idx)) if fleet is not None
+             else np.arange(batch.n_homes))
+    tin_min = np.asarray(batch.temp_in_min)[order]
+    tin_max = np.asarray(batch.temp_in_max)[order]
+    twh_min = np.asarray(batch.temp_wh_min)[order]
+    twh_max = np.asarray(batch.temp_wh_max)[order]
+    band_tol = 0.05
+    evts = getattr(engine, "_events", None)
+    if evts is not None:
+        band_tol += float(np.max(evts.relax))
+
+    # Resume from the latest complete checkpoint whose run shape matches.
+    ckpt_root = sp.shard_ckpt_root(args.spool, args.shard)
+    shape = _run_shape(spec, cfg, engine)
+    state, t = engine.init_state(), 0
+    d = latest_checkpoint_dir(ckpt_root)
+    if d is not None:
+        try:
+            prog = load_progress(os.path.join(d, "progress.json"))
+        except (OSError, ValueError):
+            prog = None
+        if prog is not None and prog.get("run_shape") == shape:
+            state = load_pytree(os.path.join(d, "state.npz"), state)
+            t = int(prog["timestep"])
+            print(f"shard {args.shard}: resuming from t={t} ({d})",
+                  file=sys.stderr, flush=True)
+        elif prog is not None:
+            print(f"shard {args.shard}: checkpoint {d} run shape mismatch; "
+                  f"starting fresh", file=sys.stderr, flush=True)
+
+    sp.atomic_write_json(
+        os.path.join(sp.shard_dir(args.spool, args.shard),
+                     f"ready-{args.gen}.json"),
+        {"shard": args.shard, "gen": args.gen, "platform": platform,
+         "t_resume": t, "communities": [c0, c1]})
+    beat({"stage": "shard_ready", "timestep": t})
+
+    H = engine.params.horizon
+    while t < stop_t:
+        if args.epoch and sp.read_epoch(args.spool) != args.epoch:
+            # A successor coordinator fenced this generation out.
+            print(f"shard {args.shard}: epoch token changed — exiting "
+                  f"(orphan fence)", file=sys.stderr, flush=True)
+            sys.exit(0)
+        fault_hook("shard_chunk")
+        k = min(chunk_steps, stop_t - t)
+        rps = np.zeros((k, H), dtype=np.float32)
+        t0 = time.perf_counter()
+        state, outs = engine.run_chunk(state, t, rps)
+        jax.block_until_ready(outs.agg_load)
+        device_s = time.perf_counter() - t0
+        series = fold_outputs(outs, pairs, C_local)
+        solved = np.asarray(outs.correct_solve)[:, cols]
+        tin = np.asarray(outs.temp_in)[:, cols]
+        twh = np.asarray(outs.temp_wh)[:, cols]
+        vi = np.where(solved > 0,
+                      np.maximum(tin_min[None] - tin, tin - tin_max[None]),
+                      -1.0)
+        vw = np.where(solved > 0,
+                      np.maximum(twh_min[None] - twh, twh - twh_max[None]),
+                      -1.0)
+        seq = t // chunk_steps
+        payload = {
+            "shard": args.shard, "gen": args.gen, "seq": seq,
+            "t0": t, "t1": t + k, "platform": platform,
+            "series": series_to_lists(series),
+            "solve_rate": float(solved.mean()),
+            "viol_max": float(max(vi.max(), vw.max())),
+            "band_tol": band_tol,
+            "device_s": round(device_s, 4),
+        }
+        # Outbox BEFORE checkpoint (module docstring): a crash between
+        # the two re-computes one deterministic chunk, never loses one.
+        # FIRST WRITE WINS: a relaunched generation re-covering the
+        # ≤1-chunk re-work window must not overwrite a retained file the
+        # coordinator may already have acked — after a cross-platform
+        # degrade the recompute is only tolerance-equal, and a later
+        # coordinator restart re-merges the FILE, which must stay the
+        # payload of record.  (Torn files read as None and are rewritten.)
+        out_path = sp.chunk_path(args.spool, args.shard, seq)
+        if sp.read_json(out_path) is None:
+            sp.atomic_write_json(out_path, payload)
+        t += k
+        save_checkpoint_dir(ckpt_root, t, state, {"run_shape": shape})
+        beat({"timestep": t})
+        telemetry.emit("chunk.done", t0=t - k, t1=t, n_steps=k,
+                       solve_rate=round(payload["solve_rate"], 4),
+                       device_s=round(device_s, 3),
+                       steps_per_s=round(k / max(device_s, 1e-9), 3))
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,7 +41,9 @@ from dragg_tpu.telemetry.bus import (
     set_gauge,
     snapshot,
     span,
+    stream_paths,
     tail_events,
+    tail_events_dir,
     write_snapshot,
 )
 from dragg_tpu.telemetry.registry import EVENTS, METRICS
@@ -51,5 +53,5 @@ __all__ = [
     "EventFollower",
     "active", "close_run", "emit", "events_path", "inc", "init_run",
     "observe", "run_dir", "selftest", "set_gauge", "snapshot", "span",
-    "tail_events", "write_snapshot",
+    "stream_paths", "tail_events", "tail_events_dir", "write_snapshot",
 ]

@@ -310,6 +310,53 @@ def tail_events(events_path: str, limit: int = 50,
     return list(reversed(out))
 
 
+def stream_paths(events_path: str) -> list[str]:
+    """The events.jsonl streams of one run: the main file plus any
+    per-shard sub-streams (``shard<k>/events.jsonl`` — the shard slots
+    export one per worker child so concurrent shards never interleave
+    into one bus file; dragg_tpu/shard/slots.py).  Ordered main-first,
+    then shards by index."""
+    paths = [events_path]
+    run_dir = os.path.dirname(events_path)
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        return paths
+    shards = []
+    for name in names:
+        if name.startswith("shard"):
+            try:
+                idx = int(name[len("shard"):])
+            except ValueError:
+                continue
+            p = os.path.join(run_dir, name, EVENTS_FILE)
+            if os.path.isfile(p):
+                shards.append((idx, p))
+    paths.extend(p for _i, p in sorted(shards))
+    return paths
+
+
+def tail_events_dir(events_path: str, limit: int = 50,
+                    tail_bytes: int = 262_144) -> list[dict]:
+    """Merged tail across one run's streams (:func:`stream_paths`):
+    the newest ``limit`` records across the main stream AND every shard
+    sub-stream, ordered by wall time (``t``; per-stream seq breaks
+    ties).  Each record carries a ``_stream`` key naming its source
+    (``"main"`` or ``"shard<k>"``) so a merged view stays attributable.
+    A run with no sub-streams reduces to :func:`tail_events` plus the
+    ``_stream`` annotation."""
+    merged: list[tuple] = []
+    for path in stream_paths(events_path):
+        label = os.path.basename(os.path.dirname(path))
+        if path == events_path:
+            label = "main"
+        for rec in tail_events(path, limit=limit, tail_bytes=tail_bytes):
+            merged.append((rec.get("t", 0.0), rec.get("seq", 0),
+                           {**rec, "_stream": label}))
+    merged.sort(key=lambda r: (r[0], r[1]))
+    return [rec for _t, _s, rec in merged[-limit:]]
+
+
 class EventFollower:
     """Incremental reader of one events.jsonl stream — the counterpart
     of :func:`tail_events` for consumers that poll repeatedly (the

@@ -100,6 +100,24 @@ EVENTS: dict[str, str] = {
     "serve.drain": "graceful drain began (queue = outstanding requests)",
     "serve.error": "serving dispatch loop survived an internal error "
                    "(error)",
+    # Cross-process fleet sharding (dragg_tpu/shard — architecture.md
+    # §19).  The coordinator's lifecycle mirrors the shard journal
+    # states (shard/journal.py), so the event stream and the fsync'd
+    # journal tell one story; worker-side engine events land on
+    # per-shard sub-streams (shard<k>/events.jsonl — slots.py).
+    "shard.plan": "shard run planned/resumed (communities, workers, "
+                  "ranges, steps, chunk_steps, target_t, resumed)",
+    "shard.launch": "shard worker generation launched (shard, gen, pid, "
+                    "platform)",
+    "shard.chunk": "one shard chunk merged + journal-acked (shard, seq, "
+                   "t0, t1, solve_rate, device_s)",
+    "shard.exit": "a shard worker generation died (shard, gen, rc, "
+                  "failure = taxonomy kind)",
+    "shard.transition": "one shard degraded platforms independently "
+                        "(shard, from_platform, to_platform)",
+    "shard.done": "a shard reached the target frontier (shard, chunks)",
+    "shard.merge": "the merged fleet result assembled (communities, "
+                   "workers, steps, solve_rate, restarts, elapsed_s)",
     # The resilience failure taxonomy as event types (one per kind in
     # taxonomy.FAILURE_KINDS; ``source`` says which layer classified it:
     # "probe" or "supervisor", ``detail``/``label`` locate it).
@@ -272,6 +290,14 @@ METRICS: dict[str, tuple[str, str]] = {
     "serve.patterns_active": ("gauge",
                               "pattern lanes currently holding worker "
                               "slots (default + configured + spill)"),
+    # Cross-process fleet sharding (dragg_tpu/shard — architecture.md
+    # §19).
+    "shard.restarts": ("counter",
+                       "shard worker relaunches beyond each shard's "
+                       "first generation"),
+    "shard.chunk_s": ("histogram",
+                      "worker-reported device seconds per merged shard "
+                      "chunk"),
 }
 
 

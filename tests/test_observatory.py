@@ -387,6 +387,30 @@ def test_bench_trend_communities_hard_key(tmp_path):
     assert rc == 1 and trend["n_regressions"] == 1
 
 
+def test_bench_trend_shards_hard_key(tmp_path):
+    """Cross-process shard rows (ISSUE 15): ``shards`` is a HARD series
+    key — an N-shard coordinator artifact (bench.py --shards: wall
+    includes process supervision + spool exchange, per-shard engines
+    compile at C/N·B_type shapes) never pairs with in-process history at
+    the same total, while same-N rows pair and gate normally.  Era
+    default: artifacts that predate the field read shards=1."""
+    arts = [
+        _bench_line(2.0, 0.50, 1),                   # pre-shard era → N=1
+        _bench_line(0.9, 0.50, 2, shards=4),         # shard row: no pair
+        _bench_line(0.88, 0.51, 3, shards=4),        # shard vs shard: pairs
+    ]
+    rc, trend = _trend(tmp_path, arts, extra=("--gate",))
+    assert rc == 0, trend
+    assert len(trend["rows"]) == 1
+    row = trend["rows"][0]
+    assert row["key"]["shards"] == 4
+    assert row["rate_verdict"] == "stable"
+    # A genuine shard-series regression still gates.
+    arts.append(_bench_line(0.4, 0.51, 4, shards=4))
+    rc, trend = _trend(tmp_path, arts, extra=("--gate",))
+    assert rc == 1 and trend["n_regressions"] == 1
+
+
 def test_bench_trend_mix_hard_key(tmp_path):
     """Scenario-pack rows (ISSUE 10): ``mix`` is a HARD series key — a
     bench row measured on an EV/heat-pump mix (or under a scenario pack's

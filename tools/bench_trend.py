@@ -10,7 +10,7 @@ Comparability rules (CLAUDE.md "Round-5 semantic defaults"):
 
 * entries are compared ONLY within an identical hard key
   ``(metric, platform, solver, semantics, data, communities, mix,
-  precision, rl, serve)`` — a semantics flip
+  precision, rl, serve, shards)`` — a semantics flip
   (relaxation vs integer) or environment flip (synthetic vs bundled)
   changes the measured workload, so rate deltas across them are not
   perf signals;
@@ -52,7 +52,7 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 HARD_KEY = ("metric", "platform", "solver", "semantics", "data",
-            "communities", "mix", "precision", "rl", "serve")
+            "communities", "mix", "precision", "rl", "serve", "shards")
 
 
 def _round_ordinal(path: str, fallback: int) -> int:
@@ -120,7 +120,7 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         return dict(source=source, ordinal=ordinal,
                     metric="metrics_snapshot", platform="?", solver="?",
                     semantics="?", data="?", communities=1, mix="?",
-                    precision="?", rl="none", serve="none",
+                    precision="?", rl="none", serve="none", shards=1,
                     bucketed=False,
                     fallback=False, degraded=None,
                     value=float(gauges.get("bench.rate_ts_per_s", 0.0)),
@@ -170,6 +170,14 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         # never gate against engine-throughput history.  Era default:
         # every pre-field artifact measured engines, not the pool.
         serve=str(rec.get("serve", "none")),
+        # Cross-process shard count is a HARD key (round 18): an N-shard
+        # coordinator rate (bench.py --shards — wall includes process
+        # supervision + spool exchange; per-shard engines compile at
+        # C/N·B_type shapes) is a different workload than the in-process
+        # fleet at the same total, so N-shard rows form their own series
+        # and never gate against in-process history.  Era default: every
+        # pre-field artifact measured one process.
+        shards=int(rec.get("shards", 1)),
         bucketed=bool(rec.get("bucketed", False)),
         fallback=bool(rec.get("fallback", False)),
         degraded=rec.get("degraded"),

@@ -1,0 +1,330 @@
+"""Elastic checkpoint resharding: rewrite a sharded fleet run's
+community partition so a 10×10k run resumes as 20×5k (or back).
+
+    python tools/reshard_checkpoint.py --run-dir OLD --out-dir NEW \
+        --workers M
+
+Reads a QUIESCED shard run directory (every shard checkpointed at the
+SAME chunk boundary — the coordinator's ``stop_t`` barrier produces
+exactly that; unequal frontiers are refused loudly), regroups the
+per-community state rows and the merged chunk history into ``M`` new
+contiguous community ranges, and writes a fresh run directory the
+coordinator resumes from unchanged (``python -m dragg_tpu.shard
+--run-dir NEW ...``).
+
+What moves where:
+
+* **carry state** — each community's per-home rows are extracted from
+  the old shard engines' type-major order and re-laid into the new
+  shard engines' order (bucket layouts may legitimately differ between
+  partitions: ``tpu.bucketed=auto`` thresholds see different per-shard
+  totals; the mapping is per GLOBAL home, so any old→new layout pair
+  round-trips).  Values are copied bit-for-bit, never recomputed;
+* **chunk history** — the already-merged per-community aggregate series
+  are regrouped by community columns into the new shards' outbox files,
+  and a fresh journal plans the new partition with every historical
+  chunk acked, so the resumed coordinator's merge covers ``[0, t)``
+  without re-solving anything;
+* **validation** — community-by-community: every community's carry rows
+  are read BACK from the new checkpoint files on disk and compared
+  bit-exact against the old (per-community verdicts in the JSON line).
+
+Offline by construction: engines are built only as state TEMPLATES on
+the pinned CPU backend — the tool never touches the TPU and never runs
+a solve.  Mesh-sharded worker checkpoints (``tpu.sharded`` true/auto on
+a multi-device worker) carry slot-padded leaves this tool's unsharded
+templates refuse loudly (load_pytree leaf-shape check) — reshard those
+on a single-device resolution, or quiesce and reshard with
+``tpu.sharded = false`` workers.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_shard(spool_dir, k, cfg, spec, build):
+    """(engine, state, t) for one existing shard checkpoint."""
+    from dragg_tpu.checkpoint import (latest_checkpoint_dir, load_progress,
+                                      load_pytree)
+    from dragg_tpu.serve import spool as sp
+
+    root = sp.shard_ckpt_root(spool_dir, k)
+    d = latest_checkpoint_dir(root)
+    if d is None:
+        raise SystemExit(f"shard {k}: no checkpoint under {root} — run the "
+                         f"coordinator to a stop_t barrier first")
+    prog = load_progress(os.path.join(d, "progress.json"))
+    eng = build(cfg, spec["c0"], spec["c1"])
+    state = load_pytree(os.path.join(d, "state.npz"), eng.init_state())
+    return eng, state, int(prog["timestep"])
+
+
+def _bucket_states(state):
+    """Normalize a carry to its per-bucket list.  Bucketed engines carry
+    a PLAIN tuple of CommunityState, unbucketed a single CommunityState
+    — itself a NamedTuple, so the discriminator is ``_fields``, not
+    ``isinstance(..., tuple)``."""
+    return [state] if hasattr(state, "_fields") else list(state)
+
+
+def _row_maps(engine, c0, B):
+    """Per-bucket arrays of GLOBAL community-major home indices for each
+    state row (-1 = pad slot).  Global home ``g`` of local community-
+    major index ``j`` is ``c0*B + j`` — contiguous ranges make the shard
+    offset a plain stride."""
+    import numpy as np
+
+    fr = np.asarray(engine._fleet_rows["home_idx"])
+    true_n = getattr(engine, "true_n_homes", None) or engine.n_homes
+    if engine.bucketed:
+        out = []
+        for b in engine.bucket_info():
+            rows = np.full(b["n_slots"], -1, np.int64)
+            rows[:b["n_real"]] = (c0 * B
+                                  + fr[b["comm_start"]:
+                                       b["comm_start"] + b["n_real"]])
+            out.append(rows)
+        return out
+    rows = np.full(engine.n_homes, -1, np.int64)
+    rows[:true_n] = c0 * B + fr[:true_n]
+    return [rows]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run-dir", required=True,
+                    help="existing (quiesced) shard run directory")
+    ap.add_argument("--out-dir", required=True,
+                    help="fresh run directory for the new partition "
+                         "(refused if it already has a journal)")
+    ap.add_argument("--workers", type=int, required=True,
+                    help="new shard count M")
+    args = ap.parse_args()
+
+    # Offline rewrite: pin the CPU backend BEFORE any jax op (CLAUDE.md —
+    # a wedged tunnel hangs backend init; this tool must never need one).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from dragg_tpu.checkpoint import save_checkpoint_dir
+    from dragg_tpu.data import load_environment, load_waterdraw_profiles, waterdraw_path
+    from dragg_tpu.engine import make_engine
+    from dragg_tpu.homes import build_fleet_batch, create_fleet_homes
+    from dragg_tpu.serve import spool as sp
+    from dragg_tpu.shard import journal as sj
+    from dragg_tpu.shard.coordinator import JOURNAL_FILE
+    from dragg_tpu.shard.partition import (merge_shard_series, shard_config,
+                                           shard_ranges)
+    from dragg_tpu.shard.worker import _run_shape
+
+    old_spool = os.path.join(args.run_dir, "spool")
+    rep = sj.replay(os.path.join(args.run_dir, JOURNAL_FILE))
+    if rep.plan is None:
+        raise SystemExit(f"{args.run_dir}: no journaled plan — not a shard "
+                         f"run directory")
+    C = int(rep.plan["communities"])
+    steps = int(rep.plan["steps"])
+    k_chunk = int(rep.plan["chunk_steps"])
+    old_ranges = [tuple(r) for r in rep.plan["ranges"]]
+    new_ranges = shard_ranges(C, args.workers)
+    if os.path.exists(os.path.join(args.out_dir, JOURNAL_FILE)):
+        raise SystemExit(f"{args.out_dir} already holds a shard journal — "
+                         f"refusing to overwrite a run in place")
+
+    spec0 = sp.read_json(sp.shard_spec_path(old_spool, 0))
+    if spec0 is None:
+        raise SystemExit(f"{old_spool}/s0/spec.json missing/torn")
+    cfg = spec0["config"]
+    data_dir = spec0.get("data_dir")
+    B = int(cfg["community"]["total_number_homes"])
+    env_cache = {}
+
+    def build(cfg_global, c0, c1):
+        scfg = shard_config(cfg_global, c0, c1)
+        if "env" not in env_cache:
+            env_cache["env"] = load_environment(scfg, data_dir=data_dir)
+        env = env_cache["env"]
+        dt = int(scfg["agg"]["subhourly_steps"])
+        wd = load_waterdraw_profiles(
+            waterdraw_path(scfg, data_dir),
+            seed=int(scfg["simulation"]["random_seed"]))
+        homes = create_fleet_homes(scfg, steps, dt, wd)
+        hems = scfg["home"]["hems"]
+        horizon = max(1, int(hems["prediction_horizon"]) * dt)
+        batch, fleet = build_fleet_batch(
+            homes, scfg, horizon, dt, int(hems["sub_subhourly_steps"]))
+        return make_engine(batch, env, scfg, int(spec0.get("start_index", 0)),
+                           fleet=fleet, data_dir=data_dir)
+
+    # ---------------------------------------------------- load old shards
+    old = []
+    for k, (c0, c1) in enumerate(old_ranges):
+        spec = sp.read_json(sp.shard_spec_path(old_spool, k))
+        eng, state, t = _load_shard(old_spool, k, cfg, spec, build)
+        old.append(dict(k=k, c0=c0, c1=c1, eng=eng,
+                        states=_bucket_states(state), t=t))
+    ts = sorted({o["t"] for o in old})
+    if len(ts) != 1:
+        raise SystemExit(f"shard frontiers unequal ({ts}) — quiesce the run "
+                         f"at a stop_t barrier before resharding")
+    t_bar = ts[0]
+    if t_bar % k_chunk and t_bar != steps:
+        raise SystemExit(f"frontier t={t_bar} is not a chunk boundary")
+
+    # global home -> (old shard, bucket, row); + field-named old leaves
+    lookup = np.full((C * B, 3), -1, np.int64)
+    for o in old:
+        for bi, rows in enumerate(_row_maps(o["eng"], o["c0"], B)):
+            for r, g in enumerate(rows):
+                if g >= 0:
+                    lookup[g] = (o["k"], bi, r)
+    if np.any(lookup[:, 0] < 0):
+        missing = int(np.sum(lookup[:, 0] < 0))
+        raise SystemExit(f"{missing} homes unmapped in the old checkpoints "
+                         f"— corrupt run dir?")
+
+    # Old chunk payload history, merged to (T, C) per series then
+    # regrouped per new shard below.
+    n_hist = t_bar // k_chunk + (1 if t_bar % k_chunk else 0)
+    payloads = {}   # seq -> per-old-shard payload dict
+    for seq in range(n_hist):
+        per = {}
+        for o in old:
+            p = sp.read_json(sp.chunk_path(old_spool, o["k"], seq))
+            if p is None:
+                raise SystemExit(f"old shard {o['k']} chunk {seq} "
+                                 f"missing/torn in the spool")
+            per[o["k"]] = p
+        payloads[seq] = per
+
+    # ----------------------------------------------------- write new run
+    os.makedirs(args.out_dir, exist_ok=True)
+    new_spool = os.path.join(args.out_dir, "spool")
+    journal = sj.Journal(os.path.join(args.out_dir, JOURNAL_FILE))
+    journal.plan(C, args.workers, new_ranges, steps, k_chunk)
+    verdicts = {}
+    key_field = "key"  # the one non-home-axis CommunityState leaf
+    for j, (a, b) in enumerate(new_ranges):
+        sp.ensure_shard_dirs(new_spool, j)
+        spec_j = {"config": cfg, "data_dir": data_dir, "c0": a, "c1": b,
+                  "steps": steps, "chunk_steps": k_chunk, "stop_t": None,
+                  "start_index": int(spec0.get("start_index", 0))}
+        sp.atomic_write_json(sp.shard_spec_path(new_spool, j), spec_j)
+        eng_j = build(cfg, a, b)
+        template = eng_j.init_state()
+        tpl_states = _bucket_states(template)
+        new_states = []
+        for bi, (tpl, rows) in enumerate(zip(tpl_states,
+                                             _row_maps(eng_j, a, B))):
+            fields = {}
+            for f in tpl._fields:
+                leaf = np.array(np.asarray(getattr(tpl, f)))
+                if f == key_field:
+                    # The PRNG-key leaf is a legacy scalar carry, equal
+                    # across shards by construction (params.seed is the
+                    # shared base seed) — verified, then copied.
+                    vals = [np.asarray(getattr(st, f))
+                            for o in old for st in o["states"]]
+                    for v in vals[1:]:
+                        if not np.array_equal(vals[0], v):
+                            raise SystemExit(
+                                "PRNG-key carry differs across old shards "
+                                "— refusing to guess")
+                    leaf = vals[0]
+                else:
+                    for r, g in enumerate(rows):
+                        if g < 0:
+                            continue
+                        ok_, ob, orow = lookup[g]
+                        src = np.asarray(getattr(old[ok_]["states"][ob], f))
+                        leaf[r] = src[orow]
+                fields[f] = leaf
+            new_states.append(type(tpl)(**fields))
+        new_state = (new_states[0] if hasattr(template, "_fields")
+                     else tuple(new_states))
+        scfg_j = shard_config(cfg, a, b)
+        save_checkpoint_dir(
+            sp.shard_ckpt_root(new_spool, j), t_bar, new_state,
+            {"run_shape": _run_shape(spec_j, scfg_j, eng_j),
+             "resharded_from": os.path.abspath(args.run_dir)})
+        # Regrouped chunk history: merged (T_chunk, C) slabs sliced to
+        # this shard's community columns, acked in the fresh journal.
+        for seq in range(n_hist):
+            per = payloads[seq]
+            merged = {}
+            any_p = per[0]
+            for name in any_p["series"]:
+                slab = merge_shard_series(
+                    {o["k"]: np.asarray(per[o["k"]]["series"][name],
+                                        dtype=np.float64)
+                     for o in old},
+                    old_ranges)
+                merged[name] = slab[:, a:b].tolist()
+            n_steps = int(any_p["t1"]) - int(any_p["t0"])
+            solved = np.asarray(merged["solved"], dtype=np.float64)
+            sp.atomic_write_json(
+                sp.chunk_path(new_spool, j, seq),
+                {"shard": j, "gen": 0, "seq": seq,
+                 "t0": any_p["t0"], "t1": any_p["t1"],
+                 "platform": "reshard",
+                 "series": merged,
+                 "solve_rate": float(solved.sum()
+                                     / max(n_steps * (b - a) * B, 1)),
+                 "viol_max": max(float(per[o["k"]].get("viol_max", 0.0))
+                                 for o in old),
+                 "band_tol": max(float(per[o["k"]].get("band_tol", 0.05))
+                                 for o in old),
+                 "device_s": None})
+            journal.chunk(j, seq, int(any_p["t0"]), int(any_p["t1"]))
+        # ---------------- community-by-community read-back validation
+        from dragg_tpu.checkpoint import (latest_checkpoint_dir,
+                                          load_pytree)
+
+        d = latest_checkpoint_dir(sp.shard_ckpt_root(new_spool, j))
+        back = _bucket_states(
+            load_pytree(os.path.join(d, "state.npz"), eng_j.init_state()))
+        rows_j = _row_maps(eng_j, a, B)
+        for c in range(a, b):
+            ok = True
+            for bi, rows in enumerate(rows_j):
+                for r, g in enumerate(rows):
+                    if g < 0 or not (c * B <= g < (c + 1) * B):
+                        continue
+                    ok_, ob, orow = lookup[g]
+                    for f in back[bi]._fields:
+                        if f == key_field:
+                            continue
+                        nv = np.asarray(getattr(back[bi], f))[r]
+                        ov = np.asarray(
+                            getattr(old[ok_]["states"][ob], f))[orow]
+                        if not np.array_equal(nv, ov):
+                            ok = False
+            verdicts[c] = ok
+    journal.close()
+    result = {
+        "ok": all(verdicts.values()),
+        "communities": C,
+        "t": t_bar,
+        "steps": steps,
+        "chunk_steps": k_chunk,
+        "old_workers": len(old_ranges),
+        "new_workers": args.workers,
+        "old_ranges": [list(r) for r in old_ranges],
+        "new_ranges": [list(r) for r in new_ranges],
+        "chunks_carried": n_hist,
+        "validated_per_community": {str(c): bool(v)
+                                    for c, v in sorted(verdicts.items())},
+        "out_dir": os.path.abspath(args.out_dir),
+    }
+    print(json.dumps(result))
+    sys.exit(0 if result["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -103,6 +103,20 @@ step "test/scenario-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              | tee /tmp/scenario_smoke.json &&
            python -c "import json; r=json.load(open(\"/tmp/scenario_smoke.json\")); assert r[\"ok\"] and r[\"events\"][\"events\"] and r[\"bucket_patterns\"]>=5, r"'
 
+# --- job: shard smoke (ISSUE 15): cross-process fleet sharding — 4
+#     communities split over 2 supervised worker processes through the
+#     jax-free coordinator, merged per-community outputs asserted
+#     AGAINST the in-process fleet (--shard-parity: exact solvedness +
+#     fp-tolerance aggregates), plus the doctor's shard-journal
+#     crash-safety selftest (torn-tail sweep + duplicate-epoch refusal)
+step "test/shard-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  bash -c 'python tools/validate_scale.py --communities 4 --homes 16 \
+             --horizon-hours 2 --days 1 --chunk 6 --steps 12 --solver ipm \
+             --shards 2 --shard-parity --min-solve-rate 0.8 \
+             | tee /tmp/shard_smoke.json &&
+           python -c "import json; r=json.load(open(\"/tmp/shard_smoke.json\")); assert r[\"ok\"] and r[\"shards\"]==2 and r[\"shard_parity\"][\"ok\"], r" &&
+           python -m dragg_tpu doctor --shard-check --backend-timeout 60 | grep -q "shard_journal *\[ok"'
+
 # --- job: bench-trend gate (round 9): the committed BENCH_r*.json series
 #     must show no like-for-like regression (comparability rules per
 #     CLAUDE.md; tools/bench_trend.py docstring)

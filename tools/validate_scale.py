@@ -23,6 +23,135 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def build_cfg(args):
+    """(config, mix fractions) for the validated fleet — jax-free
+    imports only, shared by the measured child and the ``--shards``
+    coordinator parent so both validate EXACTLY the same population.
+    Exits 2 with a JSON error line on malformed --mix (the established
+    contract)."""
+    from dragg_tpu.config import default_config
+
+    cfg = default_config()
+    n = args.homes
+    cfg["community"]["total_number_homes"] = n
+    cfg["fleet"]["communities"] = args.communities
+    cfg["fleet"]["weather_offset_hours"] = args.weather_offset_hours
+    try:
+        fracs = ((0.4, 0.1, 0.1) if args.mix is None
+                 else tuple(float(v) for v in args.mix.split(",")))
+        if len(fracs) == 3:
+            fracs = fracs + (0.0, 0.0)
+        f_pv, f_bat, f_pvb, f_ev, f_hp = fracs
+    except ValueError:
+        print(json.dumps({"ok": False,
+                          "error": f"--mix must be 3 or 5 comma fractions, "
+                                   f"got {args.mix!r}"}))
+        sys.exit(2)
+    if any(f < 0 for f in fracs) or sum(fracs) > 1.0 + 1e-9:
+        print(json.dumps({"ok": False,
+                          "error": f"--mix fractions must be >= 0 and sum "
+                                   f"<= 1, got {list(fracs)}"}))
+        sys.exit(2)
+    cfg["community"]["homes_pv"] = int(f_pv * n)
+    cfg["community"]["homes_battery"] = int(f_bat * n)
+    cfg["community"]["homes_pv_battery"] = int(f_pvb * n)
+    cfg["community"]["homes_ev"] = int(f_ev * n)
+    cfg["community"]["homes_heat_pump"] = int(f_hp * n)
+    cfg["home"]["hems"]["prediction_horizon"] = args.horizon_hours
+    cfg["home"]["hems"]["solver"] = args.solver
+    cfg["tpu"]["bucketed"] = args.bucketed
+    if args.pack:
+        # Scenario pack: [mix] overrides the counts above, [[events]]
+        # become the engine's event timeline (dragg_tpu/scenarios).
+        from dragg_tpu.scenarios import apply_scenarios
+
+        cfg["scenarios"]["pack"] = args.pack
+        cfg = apply_scenarios(cfg, args.data_dir or None)
+    return cfg, fracs
+
+
+def run_shards(args):
+    """The ``--shards N`` path: THIS jax-free parent runs the shard
+    coordinator (tools are its supervised children — no extra wrapper),
+    prints one JSON line in the validate_scale schema + shard fields,
+    and with ``--shard-parity`` re-runs the SAME fleet as one in-process
+    worker and asserts the merged per-community series match (exact
+    solvedness; fp-tolerance aggregates across the differing bucket
+    shapes — the tests/test_fleet.py tolerance class)."""
+    import tempfile
+
+    import numpy as np
+
+    from dragg_tpu.resilience.supervisor import assert_parent_has_no_jax
+    from dragg_tpu.shard.coordinator import run_sharded
+
+    assert_parent_has_no_jax()
+    cfg, fracs = build_cfg(args)
+    if args.sharded:
+        cfg["tpu"]["sharded"] = True
+    if args.deadline:
+        cfg.setdefault("shard", {})["deadline_s"] = args.deadline
+    if args.stall:
+        cfg.setdefault("shard", {})["stall_s"] = args.stall
+    dt = int(cfg["agg"]["subhourly_steps"])
+    num_ts = args.steps or args.days * 24 * dt
+    run_dir = args.shard_run_dir or tempfile.mkdtemp(
+        prefix="validate_shards_")
+    t0 = time.perf_counter()
+    res = run_sharded(
+        cfg, run_dir=run_dir, steps=num_ts, workers=args.shards,
+        chunk_steps=args.chunk, data_dir=args.data_dir,
+        log=lambda m: print(f"[shard] {m}", file=sys.stderr, flush=True))
+    total_s = time.perf_counter() - t0
+    n_total = args.homes * args.communities
+    parity = None
+    if args.shard_parity:
+        ref = run_sharded(
+            cfg, run_dir=os.path.join(run_dir, "parity_ref"), steps=num_ts,
+            workers=1, chunk_steps=args.chunk, data_dir=args.data_dir,
+            log=lambda m: print(f"[parity] {m}", file=sys.stderr,
+                                flush=True))
+        solved_eq = res["series"]["solved"] == ref["series"]["solved"]
+        diffs = {}
+        for name in ("agg_load", "agg_cost"):
+            a = np.asarray(res["series"][name])
+            b = np.asarray(ref["series"][name])
+            diffs[name] = float(np.max(np.abs(a - b)
+                                       / np.maximum(np.abs(b), 1e-6)))
+        parity = {
+            "solved_equal": bool(solved_eq),
+            "max_rel_diff": diffs,
+            "ok": bool(solved_eq and all(v <= 1e-3
+                                         for v in diffs.values())),
+        }
+    result = {
+        "homes": args.homes, "communities": args.communities,
+        "homes_total": n_total, "shards": args.shards,
+        "shard_ranges": res["ranges"],
+        # The workers' tpu.sharded resolution (each shards its OWN home
+        # axis over its own visible devices — shard/worker.py).
+        "sharded": cfg["tpu"].get("sharded", "auto"),
+        "horizon_h": args.horizon_hours, "days": args.days,
+        "steps": num_ts, "solver": args.solver,
+        "platform": "+".join(res["platforms"]) or "?",
+        "mix": list(fracs), "pack": args.pack,
+        "solve_rate": res["solve_rate"],
+        "comfort_violation_max": res["viol_max"],
+        "timesteps_per_s": round(num_ts / max(total_s, 1e-9), 3),
+        "home_steps_per_s": round(n_total * num_ts / max(total_s, 1e-9), 1),
+        "steady_home_steps_per_s": res["steady_home_steps_per_s"],
+        "restarts": res["restarts"],
+        "total_s": round(total_s, 1),
+        "shard_parity": parity,
+        "run_dir": run_dir,
+        "ok": bool(res["ok"]
+                   and res["solve_rate"] >= args.min_solve_rate
+                   and (parity is None or parity["ok"])),
+    }
+    print(json.dumps(result))
+    sys.exit(0 if result["ok"] else 1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--homes", type=int, default=10_000,
@@ -33,6 +162,21 @@ def main():
                          "communities folded into one batched fleet "
                          "engine (per-community seeds; type buckets hold "
                          "C·B_type homes under one compiled pattern set)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="shard worker processes N (architecture.md §19): "
+                         "N > 1 validates through the jax-free shard "
+                         "coordinator — communities split into N "
+                         "contiguous ranges, one supervised worker "
+                         "process each, merged per-community outputs")
+    ap.add_argument("--shard-parity", action="store_true",
+                    help="with --shards: ALSO run the same fleet as one "
+                         "in-process worker and assert the merged "
+                         "per-community series match (exact solvedness, "
+                         "fp-tolerance aggregates across the differing "
+                         "bucket shapes — tests/test_fleet.py class)")
+    ap.add_argument("--shard-run-dir", default=None,
+                    help="with --shards: durable journal+spool directory "
+                         "(default: a fresh temp dir; reuse to resume)")
     ap.add_argument("--weather-offset-hours", type=int, default=0,
                     help="fleet.weather_offset_hours: community c's "
                          "environment windows shift c× this many hours")
@@ -85,6 +229,11 @@ def main():
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
+    if args.shards > 1 and not args._child:
+        # Sharded validation: the coordinator supervises its own worker
+        # children, so this parent needs no extra supervision wrapper.
+        run_shards(args)
+
     if not args._child:
         # Supervised parent: jax-free, un-wedgeable.  The child is this
         # same script; its one JSON line is forwarded verbatim.
@@ -111,53 +260,18 @@ def main():
     import jax
     import numpy as np
 
-    from dragg_tpu.config import default_config
     from dragg_tpu.data import load_environment, load_waterdraw_profiles
     from dragg_tpu.engine import make_engine
     from dragg_tpu.homes import build_fleet_batch, create_fleet_homes
     from dragg_tpu.parallel.mesh import make_sharded_engine
     from dragg_tpu.scenarios import describe_timeline
 
-    cfg = default_config()
-    n = args.homes
-    cfg["community"]["total_number_homes"] = n
-    cfg["fleet"]["communities"] = args.communities
-    cfg["fleet"]["weather_offset_hours"] = args.weather_offset_hours
-    n_total = n * args.communities
     # Population mix: default is the bench mix; --mix exercises
     # bucket-heavy (0,0,0 = all base), superset-only (0,0,1), and — with
     # 5 fractions — the scenario types (ev, heat_pump; ISSUE 10).
-    try:
-        fracs = ((0.4, 0.1, 0.1) if args.mix is None
-                 else tuple(float(v) for v in args.mix.split(",")))
-        if len(fracs) == 3:
-            fracs = fracs + (0.0, 0.0)
-        f_pv, f_bat, f_pvb, f_ev, f_hp = fracs
-    except ValueError:
-        print(json.dumps({"ok": False,
-                          "error": f"--mix must be 3 or 5 comma fractions, "
-                                   f"got {args.mix!r}"}))
-        sys.exit(2)
-    if any(f < 0 for f in fracs) or sum(fracs) > 1.0 + 1e-9:
-        print(json.dumps({"ok": False,
-                          "error": f"--mix fractions must be >= 0 and sum "
-                                   f"<= 1, got {list(fracs)}"}))
-        sys.exit(2)
-    cfg["community"]["homes_pv"] = int(f_pv * n)
-    cfg["community"]["homes_battery"] = int(f_bat * n)
-    cfg["community"]["homes_pv_battery"] = int(f_pvb * n)
-    cfg["community"]["homes_ev"] = int(f_ev * n)
-    cfg["community"]["homes_heat_pump"] = int(f_hp * n)
-    cfg["home"]["hems"]["prediction_horizon"] = args.horizon_hours
-    cfg["home"]["hems"]["solver"] = args.solver
-    cfg["tpu"]["bucketed"] = args.bucketed
-    if args.pack:
-        # Scenario pack: [mix] overrides the counts above, [[events]]
-        # become the engine's event timeline (dragg_tpu/scenarios).
-        from dragg_tpu.scenarios import apply_scenarios
-
-        cfg["scenarios"]["pack"] = args.pack
-        cfg = apply_scenarios(cfg, args.data_dir or None)
+    cfg, fracs = build_cfg(args)
+    n = args.homes
+    n_total = n * args.communities
 
     from dragg_tpu.data import waterdraw_path
 
@@ -237,6 +351,7 @@ def main():
 
     result = {
         "homes": n, "communities": args.communities, "homes_total": n_total,
+        "shards": 1,
         "weather_offset_hours": args.weather_offset_hours,
         "horizon_h": args.horizon_hours, "days": args.days,
         "steps": num_ts,
