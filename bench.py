@@ -729,6 +729,10 @@ def run_measured(args) -> dict:
         # form their own trend series and never gate against in-process
         # history.
         "shards": 1,
+        # Chunk-exchange transport (architecture.md §20): meaningless
+        # in-process, recorded for self-describing artifacts — a SOFT
+        # bench_trend key (a flip annotates, never fragments or gates).
+        "transport": "spool",
         # Population composition + scenario pack (ROADMAP item 4):
         # tools/bench_trend.py treats ``mix`` as a HARD series key — a
         # scenario-pack / mix row is a different workload and never gates
@@ -882,6 +886,7 @@ def run_sharded_bench(args) -> dict:
                        communities=args.communities, mix=mix,
                        pack=args.pack, precision=args.precision)
     steps = args.steps * args.chunks
+    cfg.setdefault("shard", {})["transport"] = args.transport
     run_dir = os.environ.get("DRAGG_SHARD_RUN_DIR") or tempfile.mkdtemp(
         prefix="bench_shards_")
     t0 = time.perf_counter()
@@ -914,6 +919,7 @@ def run_sharded_bench(args) -> dict:
         "communities": args.communities,
         "homes_total": homes_total,
         "shards": args.shards,
+        "transport": args.transport,
         "shard_ranges": res["ranges"],
         "home_steps_per_s": res["home_steps_per_s"],
         "steady_home_steps_per_s": steady,
@@ -946,6 +952,14 @@ def main() -> None:
                          "N contiguous ranges, one supervised worker "
                          "process (own mesh/backend) each; JSON gains "
                          "shards as a HARD bench_trend series key")
+    ap.add_argument("--transport", choices=["spool", "tcp"],
+                    default="spool",
+                    help="shard chunk exchange (--shards > 1): 'spool' = "
+                         "shared-disk outbox files (round 18), 'tcp' = "
+                         "workers push checksummed frames to the "
+                         "coordinator's chunk-ingest server "
+                         "(architecture.md §20); SOFT bench_trend key — "
+                         "a flip annotates, never gates")
     ap.add_argument("--communities", type=int, default=1,
                     help="fleet size C (round 12): fold C independent "
                          "communities of --homes each into one batched "

@@ -8,7 +8,9 @@ DT003 whitespace       no tabs in indent / trailing ws / missing EOF \\n
 DT004 device-call      no bare jax.devices()/local_devices()/
                        default_backend() — a wedged axon tunnel hangs
                        backend init (CLAUDE.md gotchas, rounds 2-4)
-DT005 subprocess-deadline  subprocess.run/check_* need timeout=
+DT005 subprocess-deadline  subprocess.run/check_* need timeout=; raw
+                       sockets need a deadline in scope (settimeout /
+                       create_connection timeout= — round-19 shard wire)
 DT006 accept-loop      serve_forever() needs poll_interval=; raw
                        socket.accept() needs a suppression (ISSUE 7)
 DT007 telemetry-name   emits name central-registry literals (round 7)
@@ -123,17 +125,89 @@ class DeviceCallRule(Rule):
 
 
 class SubprocessDeadlineRule(Rule):
-    """DT005: subprocess.run/check_output/check_call/call without
-    timeout= — an un-deadlined child can hang forever, defeating the
-    supervision layer (CLAUDE.md; the round-4 wedge burned hours)."""
+    """DT005: deadline discipline on anything that can block forever —
+    subprocess.run/check_output/check_call/call without timeout=, and
+    (round 19, the shard wire) a raw socket created without a deadline
+    in scope: ``socket.socket(...)`` with no later ``.settimeout(...)``
+    on the bound name in the same function, or
+    ``socket.create_connection(...)`` without a timeout argument.  An
+    un-deadlined child or socket op can hang forever, defeating the
+    supervision layer (CLAUDE.md; the round-4 wedge burned hours).
+    ``resilience.net.connect_deadline`` is the sanctioned socket
+    helper."""
 
     id = "DT005"
     name = "subprocess-deadline"
     scope = FRAMEWORK
-    node_types = (ast.Call,)
+    node_types = (ast.Call, ast.Assign, ast.With)
     _FNS = {"run", "check_output", "check_call", "call"}
+    _MODULE = "<module>"
+
+    def begin_file(self, ctx: FileContext) -> None:
+        # (holder, varname) -> creation lineno for sockets still waiting
+        # for a settimeout in the same scope.
+        self._socks: dict[tuple[object, str], int] = {}
+        self._claimed: set[int] = set()   # creation Call node ids already
+        # handled via their Assign/With binding (the walk visits parents
+        # first, so the binding claims the inner Call before visit sees
+        # it bare).
+
+    @staticmethod
+    def _creation(call: ast.AST) -> str | None:
+        """"socket" | "create_connection" when ``call`` constructs a raw
+        socket via the socket module, else None."""
+        fn = call.func
+        if (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "socket"
+                and fn.attr in ("socket", "create_connection")):
+            return fn.attr
+        return None
+
+    @staticmethod
+    def _has_deadline(call: ast.AST, kind: str) -> bool:
+        if kind != "create_connection":
+            return False   # socket.socket() cannot take one at creation
+        return (len(call.args) >= 2
+                or any(kw.arg == "timeout" for kw in call.keywords))
+
+    def _holder(self, ctx: FileContext) -> object:
+        fns = ctx.enclosing_functions()
+        return fns[-1] if fns else self._MODULE
+
+    def _track_binding(self, call: ast.AST, name: str | None,
+                       ctx: FileContext) -> None:
+        kind = self._creation(call)
+        if kind is None:
+            return
+        self._claimed.add(id(call))
+        if self._has_deadline(call, kind):
+            return
+        if name is None:
+            ctx.report(self, call.lineno,
+                       f"socket.{kind}() without a deadline — every raw "
+                       f"socket op needs a timeout (settimeout/timeout=; "
+                       f"resilience.net.connect_deadline is the "
+                       f"sanctioned helper)")
+        else:
+            self._socks[(self._holder(ctx), name)] = call.lineno
 
     def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Call):
+                name = (node.targets[0].id
+                        if len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name) else None)
+                self._track_binding(node.value, name, ctx)
+            return
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    name = (item.optional_vars.id
+                            if isinstance(item.optional_vars, ast.Name)
+                            else None)
+                    self._track_binding(item.context_expr, name, ctx)
+            return
         fn = node.func
         if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
                 and fn.value.id == "subprocess" and fn.attr in self._FNS
@@ -142,6 +216,25 @@ class SubprocessDeadlineRule(Rule):
                        f"subprocess.{fn.attr}() without timeout= — an "
                        f"un-deadlined child can hang forever (use "
                        f"resilience.supervisor or pass a timeout)")
+            return
+        if (isinstance(fn, ast.Attribute) and fn.attr == "settimeout"
+                and isinstance(fn.value, ast.Name)):
+            self._socks.pop((self._holder(ctx), fn.value.id), None)
+            return
+        if id(node) not in self._claimed:
+            # A creation consumed inline (passed straight to a helper,
+            # returned, ...) — nothing to watch for a settimeout on.
+            self._track_binding(node, None, ctx)
+
+    def end_file(self, ctx: FileContext) -> None:
+        for (_holder, name), lineno in sorted(self._socks.items(),
+                                              key=lambda kv: kv[1]):
+            ctx.report(self, lineno,
+                       f"socket '{name}' created without a deadline in "
+                       f"scope — call {name}.settimeout(...) (or pass "
+                       f"timeout= to create_connection); "
+                       f"resilience.net.connect_deadline is the "
+                       f"sanctioned helper")
 
 
 class AcceptLoopRule(Rule):

@@ -443,6 +443,19 @@ _DEFAULT: dict[str, Any] = {
                              # transition journaled with the taxonomy
                              # kind)
         "poll_s": 0.05,     # coordinator spool/liveness poll cadence
+        "transport": "spool",  # chunk exchange: "spool" = shared-disk
+                               # outbox files (round 18, byte-identical);
+                               # "tcp" = workers push checksummed frames
+                               # to the coordinator's chunk-ingest server
+                               # (at-least-once, epoch-fenced, journal-
+                               # before-ack — architecture.md §20)
+        "transport_retry_s": 10.0,  # wire-down budget per chunk push
+                                    # before a tcp worker degrades
+                                    # (sticky) to the shared spool
+        "listen": "127.0.0.1:0",  # chunk-ingest bind address for
+                                  # transport="tcp" (port 0 = ephemeral;
+                                  # workers get the bound endpoint via
+                                  # their spec)
     },
     # Unified run telemetry (dragg_tpu/telemetry — round-7 tentpole).
     "telemetry": {

@@ -239,6 +239,102 @@ def _check_shard_journal() -> dict:
         return {"status": FAIL, "error": repr(e)}
 
 
+def _check_shard_wire() -> dict:
+    """Networked shard transport selftest (``--shard-check``), run
+    against a LIVE loopback chunk-ingest server (shard/transport.py):
+
+    * a pushed chunk frame is journal-acked BEFORE the 200 and its
+      retained spool file matches the payload byte-for-byte;
+    * a TORN frame at EVERY byte boundary is discarded whole (400,
+      no state change) — the wire analog of the journal torn-tail sweep;
+    * a duplicate ``(epoch, shard, chunk)`` token is refused (acked
+      without re-merge) ACROSS a transport restart — the dedup set is
+      re-seeded from the journal + spool, not process memory;
+    * a fenced (stale-epoch) push is refused with 409 naming the stale
+      token.
+
+    Pure stdlib + loopback TCP; never launches a worker."""
+    import tempfile
+
+    try:
+        from http.client import HTTPConnection
+
+        from dragg_tpu.serve import spool as sp
+        from dragg_tpu.shard import journal as sj
+        from dragg_tpu.shard import wire
+        from dragg_tpu.shard.transport import (ChunkIngestServer,
+                                               EpochFenced, WireClient)
+
+        with tempfile.TemporaryDirectory(prefix="dragg_wire_") as d:
+            spool_dir = os.path.join(d, "spool")
+            jpath = os.path.join(d, "shard_journal.jsonl")
+            journal = sj.Journal(jpath)
+            journal.epoch("probe-epoch")
+            sp.write_epoch(spool_dir, "probe-epoch")
+            payload = {"shard": 0, "gen": 1, "seq": 0, "t0": 0, "t1": 2,
+                       "series": {"agg_load": [[1.0], [2.0]]}}
+            srv = ChunkIngestServer(spool_dir, journal, "probe-epoch")
+            srv.start()
+            ok = True
+            try:
+                client = WireClient(srv.endpoint, "probe-epoch", 0,
+                                    spool_dir, retry_s=5.0)
+                ok &= client.push_chunk(0, payload) == "acked"
+                ok &= sj.replay(jpath).acked == {0: [0]}  # ack before 200
+                ok &= sp.read_json(
+                    sp.chunk_path(spool_dir, 0, 0)) == payload
+                ok &= client.push_chunk(0, payload) == "dup"
+                # Torn frame at EVERY byte boundary: 400, no state change.
+                frame = wire.encode_frame(
+                    {"kind": "chunk", "epoch": "probe-epoch", "shard": 0,
+                     "seq": 1, "payload": {**payload, "seq": 1}})
+                host, port = srv.endpoint.rsplit(":", 1)
+                for cut in range(len(frame)):
+                    conn = HTTPConnection(host, int(port), timeout=10.0)
+                    try:
+                        conn.request(
+                            "POST", "/chunk", body=frame[:cut],
+                            headers={"Content-Type":
+                                     "application/octet-stream"})
+                        r = conn.getresponse()
+                        r.read()
+                        ok &= r.status == 400
+                    finally:
+                        conn.close()
+                ok &= sp.read_json(
+                    sp.chunk_path(spool_dir, 0, 1)) is None
+                ok &= sj.replay(jpath).acked == {0: [0]}
+            finally:
+                srv.stop()
+            # Transport restart: dedup token survives (seeded from the
+            # journal + retained spool files, not process memory).
+            srv2 = ChunkIngestServer(spool_dir, journal, "probe-epoch")
+            srv2.start()
+            try:
+                client2 = WireClient(srv2.endpoint, "probe-epoch", 0,
+                                     spool_dir, retry_s=5.0)
+                ok &= client2.push_chunk(0, payload) == "dup"
+                ok &= sj.replay(jpath).acked == {0: [0]}  # no re-journal
+                # Fenced-epoch push: refused, stale token named.
+                stale = WireClient(srv2.endpoint, "dead-epoch", 0,
+                                   spool_dir, retry_s=5.0)
+                try:
+                    stale.push_chunk(2, {**payload, "seq": 2})
+                    ok = False
+                except EpochFenced as e:
+                    ok &= wire.chunk_token("dead-epoch", 0, 2) in str(e)
+            finally:
+                srv2.stop()
+            journal.close()
+        return {"status": OK if ok else FAIL,
+                "note": f"torn-frame sweep over {len(frame)} boundaries, "
+                        f"dedup across restart, fence named",
+                **({} if ok else {"error": "shard wire selftest "
+                                           "mismatch"})}
+    except Exception as e:
+        return {"status": FAIL, "error": repr(e)}
+
+
 def _check_outputs(outputs_dir: str) -> dict:
     try:
         os.makedirs(outputs_dir, exist_ok=True)
@@ -304,6 +400,7 @@ def run_doctor(outputs_dir: str = "outputs", backend_timeout: float = 60.0,
             max(backend_timeout, 300.0))
     if shard_check:
         checks["shard_journal"] = _check_shard_journal()
+        checks["shard_wire"] = _check_shard_wire()
     # Pallas only matters when a TPU backend is up — and its self-test
     # compiles a kernel, so it runs in a SUBPROCESS with the same hard
     # timeout as the backend probe (a tunnel can wedge between probes).

@@ -20,7 +20,10 @@ Every long-running entry point routes device work through here:
 * :mod:`faults` — deterministic fault injection (``$DRAGG_FAULT_INJECT``)
   so chaos tests exercise every recovery path on the CPU mesh in CI;
 * :mod:`heartbeat` — the child-side progress beats the supervisor's
-  stall detector reads.
+  stall detector reads;
+* :mod:`net` — socket deadline helpers (every raw socket op in the
+  framework carries an explicit timeout — dragglint DT005; the shard
+  wire's per-connection deadlines ride these).
 
 Import rule: nothing in this package imports jax at module level, and
 the parent-side paths (supervisor, liveness, runner, taxonomy, faults)
@@ -42,6 +45,11 @@ from dragg_tpu.resilience.liveness import (  # noqa: F401
     LivenessReport,
     backoff_delays,
     check_liveness,
+)
+from dragg_tpu.resilience.net import (  # noqa: F401
+    connect_deadline,
+    parse_endpoint,
+    recv_exact,
 )
 from dragg_tpu.resilience.supervisor import (  # noqa: F401
     SupervisedResult,

@@ -34,13 +34,28 @@ Actions for ``fault_hook(site)`` call sites:
     sigkill     SIGKILL our own process (abrupt device-loss analog)
     vmem_oom    raise RuntimeError with the scoped-VMEM OOM signature
     exit        sys.exit(17) (plain child failure)
+    torn        raise :class:`WireFault` ("torn") — the shard wire
+                client sends a deliberately TRUNCATED frame (the server
+                must discard it as torn and the retry must succeed)
+    drop        raise :class:`WireFault` ("drop") — the chunk-ingest
+                server drops the connection AFTER merge+journal, before
+                the 200 (ack lost after merge; the retry must dedup)
+    cut         raise :class:`WireFault` ("cut") — the wire client
+                severs the connection MID-FRAME (network partition
+                mid-chunk; at-least-once delivery must re-send)
+
+The wire actions are only meaningful at the ``wire_*`` sites
+(shard/transport.py interprets the raised :class:`WireFault`); generic
+actions (``sigkill@wire_send``, ...) still work everywhere.
 
 Sites are plain strings; the instrumented code names them
-(``sim_chunk``, ``bench_chunk``, ``bench_build``, ...).  Counters are
-per-process: a spec like ``sigkill@sim_chunk:3`` kills the child at its
-3rd chunk, and the RELAUNCHED child starts counting from zero — which
-is exactly what lets a resume test inject "die once, then succeed"
-without any shared state.
+(``sim_chunk``, ``bench_chunk``, ``bench_build``, ...).  Every site
+compiled into the repo is registered in :data:`SITES` (one catalog —
+docs/architecture.md §8 table; a test asserts both stay in sync).
+Counters are per-process: a spec like ``sigkill@sim_chunk:3`` kills the
+child at its 3rd chunk, and the RELAUNCHED child starts counting from
+zero — which is exactly what lets a resume test inject "die once, then
+succeed" without any shared state.
 """
 
 from __future__ import annotations
@@ -52,12 +67,52 @@ import time
 
 ENV = "DRAGG_FAULT_INJECT"
 
-_ACTIONS = ("hang", "sigkill", "vmem_oom", "exit")
+_ACTIONS = ("hang", "sigkill", "vmem_oom", "exit", "torn", "drop", "cut")
+_WIRE_ACTIONS = ("torn", "drop", "cut")
+
+# Every fault_hook site compiled into the repo, with where it lives —
+# THE catalog (docs/architecture.md §8 renders it as a table; a test
+# asserts every entry appears there and every fault_hook("...") literal
+# in the source is an entry here).  The staged-compile family is one
+# parameterized site per stage (telemetry/compile_obs.py).
+SITES = {
+    "sim_chunk": "aggregator baseline loop, before each device chunk",
+    "bench_build": "bench.py measured child, before the engine build",
+    "bench_chunk": "bench.py measured child, before each timed chunk",
+    "scale_chunk": "tools/validate_scale.py child, before each chunk",
+    "compile_lower": "staged compile (telemetry/compile_obs), before "
+                     "the jit lowering stage",
+    "compile_compile": "staged compile, before the AOT compile stage",
+    "compile_first_execute": "staged compile, before the first execution",
+    "serve_boot": "serve worker, before its engine build / warm report",
+    "serve_batch": "serve worker, before solving each batch",
+    "shard_build": "shard worker, before its fleet engine build",
+    "shard_chunk": "shard worker, before each chunk (the kill -9 "
+                   "≤1-chunk re-work site)",
+    "wire_send": "shard wire client, before pushing a chunk frame "
+                 "(torn = truncated frame on the wire)",
+    "wire_ack": "shard chunk-ingest server, AFTER merge+journal, before "
+                "the 200 (drop = ack lost after merge)",
+    "wire_partition": "shard wire client, mid-chunk push (cut = "
+                      "connection severed mid-frame)",
+}
 
 # The injected scoped-VMEM OOM must trip taxonomy.looks_like_vmem_oom —
 # same wording family as the real axon AOT compiler error (round 4).
 VMEM_OOM_MESSAGE = ("RESOURCE_EXHAUSTED: injected fault: scoped vmem limit "
                     "exceeded while allocating output (m, B) block")
+
+
+class WireFault(RuntimeError):
+    """An armed wire action fired at a ``wire_*`` site.  The shard
+    transport (shard/transport.py) catches this and performs the named
+    network misbehavior deterministically — a torn frame, a dropped ack,
+    a mid-frame partition — instead of dying."""
+
+    def __init__(self, action: str, site: str):
+        super().__init__(f"injected wire fault {action!r} at {site!r}")
+        self.action = action
+        self.site = site
 
 
 class FaultPlan:
@@ -116,6 +171,8 @@ class FaultPlan:
                     os.close(fd)
                 except FileExistsError:
                     continue
+            if action in _WIRE_ACTIONS:
+                raise WireFault(action, s)
             if action == "hang":
                 # Unbounded from the child's view; the supervisor's stall
                 # detector / deadline is what ends it.

@@ -1,0 +1,46 @@
+"""Socket deadline helpers — every raw socket op in the framework runs
+under an explicit deadline.
+
+The round-4 wedge taught that NOTHING may block unboundedly (CLAUDE.md
+gotchas), and the shard wire (shard/transport.py, architecture.md §20)
+extends that discipline to the network: dragglint DT005 rejects a
+socket created without a deadline in scope, and these helpers are the
+sanctioned way to open one — ``settimeout`` is applied at creation so
+every later ``connect``/``send``/``recv`` on the object inherits the
+per-operation deadline.  Stdlib only; never imports jax.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+def connect_deadline(host: str, port: int, deadline_s: float) -> socket.socket:
+    """A connected TCP socket whose EVERY operation (the connect itself
+    included) times out after ``deadline_s`` seconds."""
+    sock = socket.create_connection((host, port), timeout=deadline_s)
+    sock.settimeout(deadline_s)  # per-op deadline for later send/recv too
+    return sock
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Exactly ``n`` bytes from ``sock`` (whose deadline was set at
+    creation — :func:`connect_deadline`); ``ConnectionError`` when the
+    peer closes early, ``TimeoutError`` when an op exceeds the
+    deadline."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError(f"peer closed after {len(buf)}/{n} bytes")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def parse_endpoint(endpoint: str) -> tuple[str, int]:
+    """``"host:port"`` -> ``(host, port)``; raises ValueError loudly on
+    anything else (a mistyped listen address must not bind a surprise)."""
+    host, sep, port = endpoint.rpartition(":")
+    if not sep or not host:
+        raise ValueError(f"endpoint must be host:port, got {endpoint!r}")
+    return host, int(port)

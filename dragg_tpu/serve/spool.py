@@ -87,11 +87,29 @@ def ensure_slot_dirs(spool: str, slot: int) -> None:
     os.makedirs(outbox_dir(spool, slot), exist_ok=True)
 
 
+def dumps_doc(payload: dict) -> str:
+    """The ONE document codec both exchange surfaces share: spool files
+    on disk and wire frame bodies (shard/wire.py) serialize through this
+    exact call, so a chunk payload round-trips byte-identically whether
+    it travelled the shared-disk spool or the TCP transport (float64
+    repr round-trips exactly — shard/partition.series_to_lists)."""
+    return json.dumps(payload, default=str)
+
+
+def loads_doc(data: str | bytes) -> dict:
+    """Inverse of :func:`dumps_doc`; raises ValueError on torn input."""
+    doc = json.loads(data)
+    if not isinstance(doc, dict):
+        raise ValueError(f"spool/wire document must be an object, "
+                         f"got {type(doc).__name__}")
+    return doc
+
+
 def atomic_write_json(path: str, payload: dict) -> None:
     """Write-then-rename so readers only ever see complete documents."""
     tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(payload, f, default=str)
+        f.write(dumps_doc(payload))
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)

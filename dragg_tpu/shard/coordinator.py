@@ -130,6 +130,11 @@ def run_sharded(config: dict, *, run_dir: str, steps: int,
 
     os.makedirs(run_dir, exist_ok=True)
     spool_dir = os.path.join(run_dir, "spool")
+    transport = str(scfg.get("transport", "spool"))
+    if transport not in ("spool", "tcp"):
+        raise ValueError(f"shard.transport must be 'spool' or 'tcp', "
+                         f"got {transport!r}")
+    server = None
     opened_bus = False
     if (config.get("telemetry", {}).get("enabled", True)
             and not telemetry.active()):
@@ -158,6 +163,19 @@ def run_sharded(config: dict, *, run_dir: str, steps: int,
         token = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
         journal.epoch(token)
         sp.write_epoch(spool_dir, token)
+        if transport == "tcp":
+            # Chunk ingest over the wire (shard/transport.py,
+            # architecture.md §20): workers push checksummed frames to
+            # this server, which journal-acks BEFORE the 200.  The spool
+            # stays the durable store — the server persists into the
+            # same outbox files — so every resume/reshard/fence path
+            # below is transport-agnostic.
+            from dragg_tpu.shard.transport import ChunkIngestServer
+
+            server = ChunkIngestServer(
+                spool_dir, journal, token,
+                listen=str(scfg.get("listen", "127.0.0.1:0")), log=log)
+            server.start()
         telemetry.emit("shard.plan", communities=C, workers=n_workers,
                        ranges=[[a, b] for a, b in ranges], steps=steps,
                        chunk_steps=k_chunk, target_t=target_t,
@@ -173,12 +191,18 @@ def run_sharded(config: dict, *, run_dir: str, steps: int,
                         c0, c1)
             sh.restarts = rep.restarts.get(k, 0)
             shards[k] = sh
-            sp.atomic_write_json(
-                sp.shard_spec_path(spool_dir, k),
-                {"config": config, "data_dir": data_dir, "c0": c0,
-                 "c1": c1, "steps": int(steps), "chunk_steps": k_chunk,
-                 "stop_t": target_t if target_t < steps else None,
-                 "start_index": start_index})
+            spec = {"config": config, "data_dir": data_dir, "c0": c0,
+                    "c1": c1, "steps": int(steps), "chunk_steps": k_chunk,
+                    "stop_t": target_t if target_t < steps else None,
+                    "start_index": start_index}
+            if server is not None:
+                # tcp-only keys: the spool-mode spec stays byte-identical
+                # to round 18.
+                spec["transport"] = "tcp"
+                spec["endpoint"] = server.endpoint
+                spec["transport_retry_s"] = float(
+                    scfg.get("transport_retry_s", 10.0))
+            sp.atomic_write_json(sp.shard_spec_path(spool_dir, k), spec)
             # A successor CONTINUES the generation numbering so per-gen
             # logs and payload ``gen`` tags stay distinct across
             # coordinator restarts (the steady-rate filter in _merge
@@ -222,8 +246,14 @@ def run_sharded(config: dict, *, run_dir: str, steps: int,
                 sh.frontier = seq + 1
                 sh.failures = 0
                 sh.progress_at = time.monotonic()  # re-arm the deadline
-                journal.chunk(k, seq, int(payload["t0"]),
-                              int(payload["t1"]))
+                # A wire-ingested chunk was journal-acked BEFORE the 200
+                # (journal-before-ack) — re-journaling here would record
+                # a double merge.  Degraded-to-spool files (and every
+                # spool-transport chunk) still get their ack from this
+                # loop.
+                if not (server is not None and server.was_acked(k, seq)):
+                    journal.chunk(k, seq, int(payload["t0"]),
+                                  int(payload["t1"]))
                 telemetry.emit("shard.chunk", shard=k, seq=seq,
                                t0=payload["t0"], t1=payload["t1"],
                                solve_rate=payload.get("solve_rate"),
@@ -290,6 +320,8 @@ def run_sharded(config: dict, *, run_dir: str, steps: int,
     finally:
         for sh in shards.values():
             sh.slot.kill(grace_s=2.0)
+        if server is not None:
+            server.stop()
         journal.close()
         if opened_bus:
             telemetry.close_run(write_metrics=True)

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import NamedTuple
 
 EPOCH = "epoch"
@@ -94,13 +95,19 @@ class Journal:
         rep = replay(path)
         self._epochs = set(rep.epochs)
         self._fh = open(path, "a", encoding="utf-8")
+        # The tcp transport's chunk-ingest server journal-acks from its
+        # handler threads while the coordinator loop appends lifecycle
+        # records — appends must serialize (whole lines, fsync'd in
+        # order).  RLock: ``epoch`` holds it across its check-then-append.
+        self._lock = threading.RLock()
 
     def _append(self, rec: dict) -> None:
-        self._fh.write(json.dumps(rec, separators=(",", ":"),
-                                  default=str) + "\n")
-        self._fh.flush()
-        if self._fsync:
-            os.fsync(self._fh.fileno())
+        with self._lock:
+            self._fh.write(json.dumps(rec, separators=(",", ":"),
+                                      default=str) + "\n")
+            self._fh.flush()
+            if self._fsync:
+                os.fsync(self._fh.fileno())
 
     def close(self) -> None:
         try:
@@ -114,12 +121,13 @@ class Journal:
         duplicate token — reusing a dead coordinator's token would
         re-admit the orphan workers the spool EPOCH fence exists to
         stop."""
-        if token in self._epochs:
-            raise ValueError(
-                f"epoch token {token!r} already claimed in {self.path} — "
-                f"a successor coordinator must mint a fresh token")
-        self._epochs.add(token)
-        self._append({"state": EPOCH, "token": token})
+        with self._lock:
+            if token in self._epochs:
+                raise ValueError(
+                    f"epoch token {token!r} already claimed in {self.path} "
+                    f"— a successor coordinator must mint a fresh token")
+            self._epochs.add(token)
+            self._append({"state": EPOCH, "token": token})
 
     def plan(self, communities: int, workers: int,
              ranges: list[tuple[int, int]], steps: int,

@@ -1,0 +1,455 @@
+"""Networked shard transport: crash-safe chunk exchange over TCP.
+
+``shard.transport = "tcp"`` (docs/config.md ``[shard]``) replaces the
+shared-disk chunk exchange with a wire, keeping every durability
+invariant the spool already proves (architecture.md §20):
+
+* the coordinator runs :class:`ChunkIngestServer` — a jax-free HTTP
+  server whose ``POST /chunk`` handler persists the pushed payload to
+  the SAME retained spool outbox file the shared-disk path uses, then
+  fsync's the chunk ack into the coordinator's journal
+  (shard/journal.py) **before** the 200 — the serve daemon's
+  journal-before-ack discipline, so once a worker sees the ack the
+  payload of record is durable on the coordinator's disk and the worker
+  needs no local copy;
+* workers push length-prefixed, checksummed frames (shard/wire.py) with
+  **at-least-once delivery**: :class:`WireClient` retries through a
+  bounded exponential backoff (resilience.liveness.backoff_delays
+  schedule) with a per-operation deadline on every socket op
+  (resilience.net discipline), and the server dedups by the
+  ``(epoch, shard, chunk)`` token — a duplicate is acked without
+  re-merge or re-journal, so a lost ack never double-merges;
+* **epoch fencing over the wire**: a push carrying a stale epoch token
+  is refused with 409 naming the stale token (mirroring the round-18
+  spool EPOCH fence) — :class:`EpochFenced` makes the orphan worker
+  exit at the chunk boundary exactly like the file fence does;
+* **graceful degradation**: when both ends share a disk and the wire
+  stays down past ``shard.transport_retry_s``, the client falls back to
+  writing the spool outbox file directly (first-write-wins, exactly the
+  round-18 path) and stays degraded — the coordinator's drain loop
+  merges spool files and wire-ingested files identically;
+* **params flow the other way** on the same wire: ``GET /params`` is a
+  long-poll the worker drains at each chunk boundary
+  (:meth:`ChunkIngestServer.publish_params` → ``stop_t`` today; the
+  learner broadcast of ROADMAP item 3 rides this channel).
+
+Chaos sites (``$DRAGG_FAULT_INJECT`` — resilience/faults.py SITES):
+``wire_send`` (torn = truncated frame), ``wire_partition`` (cut =
+connection severed mid-frame), ``wire_ack`` (drop = ack lost after
+merge+journal).  All three are deterministic and covered by
+tests/test_shard.py; ``doctor --shard-check`` additionally sweeps a
+torn frame at every byte boundary against a live server.
+
+Stdlib only; never imports jax (the coordinator side runs inside the
+jax-free parent — resilience.supervisor contract).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.client import HTTPConnection, HTTPException
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from dragg_tpu import telemetry
+from dragg_tpu.resilience.faults import WireFault, fault_hook
+from dragg_tpu.resilience.liveness import backoff_delays
+from dragg_tpu.resilience.net import connect_deadline, parse_endpoint
+from dragg_tpu.serve import spool as sp
+from dragg_tpu.shard import wire
+
+# Per-connection deadline on every server-side socket op (the handler's
+# reads/writes inherit it — BaseHTTPRequestHandler.timeout).
+SERVER_OP_TIMEOUT_S = 30.0
+CLIENT_OP_TIMEOUT_S = 10.0
+
+
+class EpochFenced(RuntimeError):
+    """The server refused a push from a fenced (stale-epoch) orphan."""
+
+    def __init__(self, stale: str, current: str, shard: int, seq: int):
+        super().__init__(
+            f"chunk push fenced: stale epoch token "
+            f"{wire.chunk_token(stale, shard, seq)!r} — the run is owned "
+            f"by epoch {current!r} (orphan of a dead coordinator; exit at "
+            f"the chunk boundary, spool-fence semantics)")
+        self.stale = stale
+        self.current = current
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dragg-wire/1"
+    protocol_version = "HTTP/1.1"
+    timeout = SERVER_OP_TIMEOUT_S
+
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+        pass  # the telemetry stream is the log of record
+
+    def _reply(self, status: int, doc: dict) -> None:
+        body = json.dumps(doc).encode("utf-8")
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except OSError:
+            # The peer vanished mid-reply (a severed connection is a
+            # chaos-site behavior, not a server fault) — the client's
+            # at-least-once retry is the recovery path, not this write.
+            self.close_connection = True
+
+    # ------------------------------------------------------------ chunk push
+    def do_POST(self) -> None:
+        owner: ChunkIngestServer = self.server.owner
+        if self.path != "/chunk":
+            self._reply(404, {"error": f"no such endpoint {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            length = -1
+        if length <= 0 or length > wire.MAX_FRAME_BYTES:
+            self._reply(400, {"error": f"bad Content-Length {length}"})
+            return
+        try:
+            data = self.rfile.read(length)
+        except OSError:
+            # Partition mid-body: nothing decoded, nothing changed.
+            self.close_connection = True
+            return
+        try:
+            doc = wire.decode_frame(data)
+            shard = int(doc["shard"])
+            seq = int(doc["seq"])
+            epoch = str(doc["epoch"])
+            payload = doc["payload"]
+            if not isinstance(payload, dict) \
+                    or int(payload.get("seq", -1)) != seq:
+                raise wire.TornFrame("payload/seq mismatch")
+        except (wire.TornFrame, KeyError, TypeError, ValueError) as e:
+            # A torn/foreign frame is DISCARDED whole (the wire analog of
+            # the spool's atomic rename): no state changed, the client's
+            # at-least-once retry re-sends the complete frame.
+            telemetry.emit("wire.reject", reason=str(e), bytes=len(data))
+            self._reply(400, {"error": "torn frame", "detail": str(e)})
+            return
+        if epoch != owner.epoch:
+            telemetry.emit("wire.fence", shard=shard, seq=seq,
+                           got=epoch, want=owner.epoch)
+            self._reply(409, {
+                "error": "stale epoch",
+                "token": wire.chunk_token(epoch, shard, seq),
+                "got": epoch, "want": owner.epoch})
+            return
+        dup = owner.ingest(shard, seq, payload)
+        telemetry.emit("wire.ingest", shard=shard, seq=seq, dup=dup,
+                       bytes=length)
+        try:
+            fault_hook("wire_ack")
+        except WireFault:
+            # Ack lost AFTER merge+journal: sever without responding.
+            # The client's retry hits the dedup token and is acked
+            # without re-merge — the invariant this site exists to test.
+            self.close_connection = True
+            return
+        self._reply(200, {"ok": True, "dup": dup})
+
+    # --------------------------------------------------------- params pull
+    def do_GET(self) -> None:
+        owner: ChunkIngestServer = self.server.owner
+        url = urlparse(self.path)
+        if url.path == "/ping":
+            self._reply(200, {"ok": True, "epoch": owner.epoch})
+            return
+        if url.path != "/params":
+            self._reply(404, {"error": f"no such endpoint {url.path}"})
+            return
+        q = parse_qs(url.query)
+        try:
+            shard = int(q.get("shard", ["0"])[0])
+            have = int(q.get("have", ["0"])[0])
+            wait_s = min(float(q.get("wait", ["0"])[0]),
+                         SERVER_OP_TIMEOUT_S / 2)
+        except ValueError:
+            self._reply(400, {"error": "bad query"})
+            return
+        version, params = owner.wait_params(shard, have, wait_s)
+        self._reply(200, {"version": version, "params": params})
+
+
+class ChunkIngestServer:
+    """Coordinator-side chunk ingest + params broadcast (one per run).
+
+    Construction seeds the dedup token set from the journal's acked
+    frontier AND the retained spool chunk files, so the at-least-once
+    token survives a transport restart: a duplicate ``(epoch, shard,
+    chunk)`` push after the server process bounced is still acked as a
+    duplicate, never re-merged (``doctor --shard-check`` pins this)."""
+
+    def __init__(self, spool_dir: str, journal, epoch: str, *,
+                 listen: str = "127.0.0.1:0", log=None):
+        self.spool_dir = spool_dir
+        self.journal = journal
+        self.epoch = epoch
+        self.log = log
+        self._lock = threading.Lock()
+        self._params_cv = threading.Condition(self._lock)
+        self._params: dict[int, tuple[int, dict]] = {}
+        self._seen: set[tuple[int, int]] = set()   # payload durable
+        self._acked: set[tuple[int, int]] = set()  # journaled at ingest
+        # Transport-restart dedup seed: journal acks + retained files.
+        from dragg_tpu.shard import journal as sj
+
+        rep = sj.replay(journal.path)
+        for k, seqs in rep.acked.items():
+            self._seen.update((int(k), int(s)) for s in seqs)
+        for k, _dir in _shard_outboxes(spool_dir):
+            for seq, _path in sp.list_chunks(_dir):
+                self._seen.add((k, seq))
+        host, port = parse_endpoint(listen)
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.owner = self
+        self.endpoint = (f"{self._httpd.server_address[0]}"
+                         f":{self._httpd.server_address[1]}")
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.1),
+            name="dragg-wire-ingest", daemon=True)
+        self._thread.start()
+        if self.log:
+            self.log(f"wire: chunk-ingest server on {self.endpoint} "
+                     f"(epoch {self.epoch})")
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # -------------------------------------------------------------- ingest
+    def ingest(self, shard: int, seq: int, payload: dict) -> bool:
+        """Persist + journal-ack one pushed chunk; returns True when it
+        was a duplicate (acked without re-merge).  Journal-before-ack:
+        the spool file write (fsync'd atomic rename) and the journal
+        chunk ack both complete BEFORE the handler sends the 200."""
+        with self._lock:
+            if (shard, seq) in self._seen:
+                return True
+            sp.ensure_shard_dirs(self.spool_dir, shard)
+            path = sp.chunk_path(self.spool_dir, shard, seq)
+            # FIRST WRITE WINS (worker outbox contract): a degraded-path
+            # file that landed on the shared disk first stays the
+            # payload of record.
+            if sp.read_json(path) is None:
+                sp.atomic_write_json(path, payload)
+            self.journal.chunk(shard, seq, int(payload["t0"]),
+                               int(payload["t1"]))
+            self._seen.add((shard, seq))
+            self._acked.add((shard, seq))
+            return False
+
+    def was_acked(self, shard: int, seq: int) -> bool:
+        """True when THIS server journaled the ack at ingest — the
+        coordinator's drain loop skips re-journaling those."""
+        with self._lock:
+            return (shard, seq) in self._acked
+
+    # -------------------------------------------------------------- params
+    def publish_params(self, shard: int, params: dict) -> int:
+        """Broadcast a params document to one shard (long-poll wakeup);
+        returns the new version number."""
+        with self._params_cv:
+            version = self._params.get(shard, (0, None))[0] + 1
+            self._params[shard] = (version, params)
+            self._params_cv.notify_all()
+        return version
+
+    def wait_params(self, shard: int, have: int,
+                    wait_s: float) -> tuple[int, dict | None]:
+        """Current ``(version, params)`` for ``shard``, blocking up to
+        ``wait_s`` for a version newer than ``have`` (long-poll)."""
+        deadline = time.monotonic() + max(0.0, wait_s)
+        with self._params_cv:
+            while True:
+                version, params = self._params.get(shard, (0, None))
+                remaining = deadline - time.monotonic()
+                if version > have or remaining <= 0:
+                    return version, params
+                self._params_cv.wait(timeout=remaining)
+
+
+def _shard_outboxes(spool_dir: str):
+    """(shard, outbox_dir) pairs present on disk."""
+    import os
+
+    try:
+        names = os.listdir(spool_dir)
+    except OSError:
+        return
+    for name in sorted(names):
+        if name.startswith("s") and name[1:].isdigit():
+            yield int(name[1:]), sp.shard_outbox_dir(spool_dir,
+                                                     int(name[1:]))
+
+
+class WireClient:
+    """Worker-side push client: at-least-once chunk delivery with
+    bounded retry/backoff, per-op socket deadlines, and sticky
+    degradation to the shared spool past ``retry_s``."""
+
+    def __init__(self, endpoint: str, epoch: str, shard: int,
+                 spool_dir: str, *, retry_s: float = 10.0,
+                 op_timeout_s: float = CLIENT_OP_TIMEOUT_S, log=None):
+        self.host, self.port = parse_endpoint(endpoint)
+        self.epoch = epoch
+        self.shard = shard
+        self.spool_dir = spool_dir
+        self.retry_s = float(retry_s)
+        self.op_timeout_s = float(op_timeout_s)
+        self.log = log
+        self.degraded = False
+
+    # ------------------------------------------------------------- pushing
+    def push_chunk(self, seq: int, payload: dict) -> str:
+        """Deliver one chunk payload; returns ``"acked"`` (first
+        delivery), ``"dup"`` (the server already had it — a lost ack's
+        retry), or ``"spool"`` (wire down past the budget, payload
+        written to the shared spool instead).  Raises
+        :class:`EpochFenced` when a successor coordinator owns the run.
+        Only returns once the payload is DURABLE on the coordinator's
+        side (journal-before-ack) or on the shared disk — the caller's
+        outbox-before-checkpoint ordering stands either way."""
+        if self.degraded:
+            return self._spool_write(seq, payload)
+        frame = wire.encode_frame({
+            "kind": "chunk", "epoch": self.epoch, "shard": self.shard,
+            "seq": seq, "payload": payload})
+        t_start = time.monotonic()
+        attempts = 0
+        # Wire-scale backoff: the liveness layer's schedule shape
+        # (exponential, capped) at socket timescales.
+        delays = backoff_delays(64, base_s=0.05, cap_s=0.5)
+        while True:
+            attempts += 1
+            status, resp = self._attempt(frame)
+            if status == 200:
+                dup = bool((resp or {}).get("dup"))
+                telemetry.emit("wire.push", shard=self.shard, seq=seq,
+                               dup=dup, attempts=attempts)
+                telemetry.observe("wire.push_s",
+                                  time.monotonic() - t_start)
+                return "dup" if dup else "acked"
+            if status == 409:
+                raise EpochFenced(self.epoch,
+                                  str((resp or {}).get("want", "?")),
+                                  self.shard, seq)
+            telemetry.inc("wire.retries", 1)
+            if time.monotonic() - t_start >= self.retry_s:
+                return self._degrade(seq, payload, attempts, t_start)
+            time.sleep(delays[min(attempts - 1, len(delays) - 1)])
+
+    def _attempt(self, frame: bytes) -> tuple[int | None, dict | None]:
+        """One delivery attempt; (status, response doc) or (None, None)
+        on any transport-level failure (connect/send/recv error or an
+        injected wire fault)."""
+        try:
+            fault_hook("wire_send")
+            fault_hook("wire_partition")
+        except WireFault as wf:
+            self._corrupt_send(frame, wf.action)
+            return None, None
+        conn = HTTPConnection(self.host, self.port,
+                              timeout=self.op_timeout_s)
+        try:
+            conn.request("POST", "/chunk", body=frame,
+                         headers={"Content-Type":
+                                  "application/octet-stream"})
+            r = conn.getresponse()
+            body = r.read()
+            try:
+                doc = json.loads(body) if body else {}
+            except ValueError:
+                doc = {}
+            return r.status, doc
+        except (OSError, HTTPException):
+            return None, None
+        finally:
+            conn.close()
+
+    def _corrupt_send(self, frame: bytes, action: str) -> None:
+        """Deterministic network misbehavior for the chaos sites: a
+        ``torn`` frame (truncated body, honest Content-Length — the
+        server must discard it whole) or a ``cut`` connection (full
+        length claimed, half the body sent, then severed — partition
+        mid-chunk).  Either way this attempt fails and the at-least-once
+        retry delivers the complete frame."""
+        cut = max(1, len(frame) // 2)
+        claim = cut if action == "torn" else len(frame)
+        head = (f"POST /chunk HTTP/1.1\r\nHost: {self.host}\r\n"
+                f"Content-Type: application/octet-stream\r\n"
+                f"Content-Length: {claim}\r\nConnection: close\r\n\r\n"
+                ).encode("ascii")
+        try:
+            sock = connect_deadline(self.host, self.port,
+                                    self.op_timeout_s)
+            try:
+                sock.sendall(head + frame[:cut])
+            finally:
+                sock.close()  # sever before (torn) / instead of any ack
+        except OSError:
+            pass  # the wire being down IS the injected condition
+
+    def _degrade(self, seq: int, payload: dict, attempts: int,
+                 t_start: float) -> str:
+        """Sticky fallback to the shared-disk spool (round-18 path) once
+        the wire stayed down past the retry budget."""
+        self.degraded = True
+        after_s = time.monotonic() - t_start
+        telemetry.emit("wire.degrade", shard=self.shard,
+                       after_s=round(after_s, 3), attempts=attempts)
+        if self.log:
+            self.log(f"wire: degrading to spool after {attempts} "
+                     f"attempts ({after_s:.1f}s > retry budget "
+                     f"{self.retry_s:.1f}s)")
+        return self._spool_write(seq, payload)
+
+    def _spool_write(self, seq: int, payload: dict) -> str:
+        """The round-18 outbox write, verbatim (first write wins)."""
+        out_path = sp.chunk_path(self.spool_dir, self.shard, seq)
+        if sp.read_json(out_path) is None:
+            sp.atomic_write_json(out_path, payload)
+        return "spool"
+
+    # -------------------------------------------------------------- params
+    def poll_params(self, have: int = 0,
+                    wait_s: float = 0.0) -> tuple[int, dict] | None:
+        """One params pull (long-poll when ``wait_s`` > 0); ``(version,
+        params)`` when something newer than ``have`` is published, else
+        None.  Errors report None — params are advisory, never worth
+        stalling the chunk loop over."""
+        conn = HTTPConnection(self.host, self.port,
+                              timeout=max(self.op_timeout_s,
+                                          wait_s + 5.0))
+        try:
+            conn.request("GET", f"/params?shard={self.shard}&have={have}"
+                                f"&wait={wait_s}")
+            r = conn.getresponse()
+            body = r.read()
+            if r.status != 200:
+                return None
+            doc = json.loads(body)
+            version = int(doc.get("version", 0))
+            if version > have and doc.get("params") is not None:
+                return version, doc["params"]
+            return None
+        except (OSError, ValueError, HTTPException):
+            return None
+        finally:
+            conn.close()

@@ -1,0 +1,90 @@
+"""Wire frame codec for the networked shard transport — stdlib only.
+
+One chunk exchanged over TCP (shard/transport.py, architecture.md §20)
+is one **frame**: a fixed header followed by a JSON document body
+serialized through the SAME codec the spool files use
+(serve/spool.dumps_doc), so a payload round-trips byte-identically
+whether it travelled the shared disk or the wire.
+
+Frame layout (big-endian)::
+
+    MAGIC   4 bytes  b"DRGW"
+    VERSION 1 byte   0x01
+    LENGTH  4 bytes  u32 body length
+    CRC32   4 bytes  u32 zlib.crc32 of the body
+    BODY    LENGTH bytes of UTF-8 JSON (spool.dumps_doc)
+
+Every defect an unreliable wire can produce — truncation at ANY byte
+boundary, a flipped bit, a foreign protocol speaking to our port, an
+absurd length claim — decodes to :class:`TornFrame`, never to a partial
+document (the atomic-rename guarantee of the spool, re-proven for a
+byte stream).  ``doctor --shard-check`` sweeps truncation at every byte
+boundary against a live ingest server.
+
+Dedup identity: :func:`chunk_token` names one pushed chunk as
+``(epoch, shard, seq)`` — the at-least-once delivery token the ingest
+server acks duplicates by (and the name a fenced orphan's refusal
+quotes back).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+from dragg_tpu.serve.spool import dumps_doc, loads_doc
+
+MAGIC = b"DRGW"
+VERSION = 1
+_HEADER = struct.Struct(">4sBII")
+HEADER_BYTES = _HEADER.size
+
+# Refuse absurd length claims before allocating: the largest legitimate
+# frame is one chunk's per-community float64 series — megabytes at the
+# extreme fleet shapes, nowhere near this.
+MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+
+class TornFrame(ValueError):
+    """The bytes do not decode to exactly one complete, checksummed
+    frame — truncated, corrupted, or not ours."""
+
+
+def chunk_token(epoch: str, shard: int, seq: int) -> str:
+    """The ``(epoch, shard, chunk)`` delivery token, as one string."""
+    return f"{epoch}/s{shard}/c{seq}"
+
+
+def encode_frame(doc: dict) -> bytes:
+    """One document -> one complete frame."""
+    body = dumps_doc(doc).encode("utf-8")
+    if len(body) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame body {len(body)} bytes exceeds "
+                         f"MAX_FRAME_BYTES={MAX_FRAME_BYTES}")
+    return _HEADER.pack(MAGIC, VERSION, len(body),
+                        zlib.crc32(body) & 0xFFFFFFFF) + body
+
+
+def decode_frame(data: bytes) -> dict:
+    """Exactly one complete frame -> its document; :class:`TornFrame`
+    on anything else (short, long, bad magic/version/length/crc, body
+    that is not one JSON object)."""
+    if len(data) < HEADER_BYTES:
+        raise TornFrame(f"short frame: {len(data)} < header "
+                        f"{HEADER_BYTES} bytes")
+    magic, version, length, crc = _HEADER.unpack_from(data)
+    if magic != MAGIC:
+        raise TornFrame(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise TornFrame(f"unknown frame version {version}")
+    if length > MAX_FRAME_BYTES:
+        raise TornFrame(f"length claim {length} exceeds MAX_FRAME_BYTES")
+    body = data[HEADER_BYTES:]
+    if len(body) != length:
+        raise TornFrame(f"torn body: {len(body)} of {length} bytes")
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise TornFrame("crc mismatch")
+    try:
+        return loads_doc(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise TornFrame(f"body is not one JSON document: {e}") from e

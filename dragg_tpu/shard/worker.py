@@ -101,6 +101,20 @@ def main() -> None:
     cfg = shard_config(spec["config"], c0, c1)
     data_dir = spec.get("data_dir")
 
+    # Networked transport (shard/transport.py): push chunks over the
+    # wire with at-least-once delivery instead of writing the outbox
+    # file ourselves; params (stop_t today) flow back on the same wire.
+    # Spool mode (no transport key) keeps the round-18 path byte-for-
+    # byte.
+    client = None
+    if spec.get("transport") == "tcp" and spec.get("endpoint"):
+        from dragg_tpu.shard.transport import EpochFenced, WireClient
+
+        client = WireClient(
+            str(spec["endpoint"]), args.epoch, args.shard, args.spool,
+            retry_s=float(spec.get("transport_retry_s", 10.0)))
+    params_ver = 0
+
     beat({"stage": "shard_build", "shard": args.shard})
     fault_hook("shard_build")
     env = load_environment(cfg, data_dir=data_dir)
@@ -190,6 +204,15 @@ def main() -> None:
             print(f"shard {args.shard}: epoch token changed — exiting "
                   f"(orphan fence)", file=sys.stderr, flush=True)
             sys.exit(0)
+        if client is not None:
+            # Params pull on the wire (long-poll channel, drained
+            # non-blocking at each chunk boundary): a published stop_t
+            # tightens the quiesce barrier mid-run.
+            got = client.poll_params(have=params_ver)
+            if got is not None:
+                params_ver, params = got
+                if params.get("stop_t") is not None:
+                    stop_t = min(stop_t, max(t, int(params["stop_t"])))
         fault_hook("shard_chunk")
         k = min(chunk_steps, stop_t - t)
         rps = np.zeros((k, H), dtype=np.float32)
@@ -225,9 +248,22 @@ def main() -> None:
         # degrade the recompute is only tolerance-equal, and a later
         # coordinator restart re-merges the FILE, which must stay the
         # payload of record.  (Torn files read as None and are rewritten.)
-        out_path = sp.chunk_path(args.spool, args.shard, seq)
-        if sp.read_json(out_path) is None:
-            sp.atomic_write_json(out_path, payload)
+        if client is not None:
+            # Wire delivery: push_chunk only returns once the payload is
+            # durable on the coordinator's side (journal-before-ack) or
+            # on the shared spool (degraded path) — the outbox-before-
+            # checkpoint ordering stands either way.  No local copy is
+            # kept: the ack IS the durability receipt.
+            try:
+                client.push_chunk(seq, payload)
+            except EpochFenced as e:
+                print(f"shard {args.shard}: {e}", file=sys.stderr,
+                      flush=True)
+                sys.exit(0)
+        else:
+            out_path = sp.chunk_path(args.spool, args.shard, seq)
+            if sp.read_json(out_path) is None:
+                sp.atomic_write_json(out_path, payload)
         t += k
         save_checkpoint_dir(ckpt_root, t, state, {"run_shape": shape})
         beat({"timestep": t})

@@ -118,6 +118,19 @@ EVENTS: dict[str, str] = {
     "shard.done": "a shard reached the target frontier (shard, chunks)",
     "shard.merge": "the merged fleet result assembled (communities, "
                    "workers, steps, solve_rate, restarts, elapsed_s)",
+    # Networked shard transport (shard/transport.py — architecture.md
+    # §20).  Client-side events land on the worker's per-shard
+    # sub-stream; server-side events land on the coordinator's stream.
+    "wire.push": "wire client delivered one chunk frame (shard, seq, "
+                 "dup = server already had it, attempts)",
+    "wire.ingest": "chunk-ingest server accepted one frame (shard, seq, "
+                   "dup, bytes) — journal-acked BEFORE the 200",
+    "wire.fence": "chunk-ingest server refused a stale-epoch push "
+                  "(shard, seq, got, want)",
+    "wire.reject": "chunk-ingest server discarded a torn/invalid frame "
+                   "whole (reason, bytes)",
+    "wire.degrade": "wire client fell back (sticky) to the shared spool "
+                    "after the retry budget (shard, after_s, attempts)",
     # The resilience failure taxonomy as event types (one per kind in
     # taxonomy.FAILURE_KINDS; ``source`` says which layer classified it:
     # "probe" or "supervisor", ``detail``/``label`` locate it).
@@ -298,6 +311,12 @@ METRICS: dict[str, tuple[str, str]] = {
     "shard.chunk_s": ("histogram",
                       "worker-reported device seconds per merged shard "
                       "chunk"),
+    "wire.push_s": ("histogram",
+                    "wall seconds per chunk push, first attempt to "
+                    "durable ack (retries included)"),
+    "wire.retries": ("counter",
+                     "failed chunk-push attempts retried by the wire "
+                     "client (at-least-once delivery)"),
 }
 
 

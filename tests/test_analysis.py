@@ -85,6 +85,59 @@ def test_dt005_subprocess_deadline():
     assert not run_src(ok, "tools/x.py", "DT005")
 
 
+def test_dt005_socket_deadline():
+    """Round 19: the same deadline discipline on raw sockets — a socket
+    created without a timeout in scope is the wire analog of an
+    un-deadlined subprocess."""
+    # Bound socket.socket() with no settimeout in the same function.
+    bad = ("import socket\n"
+           "def dial(h, p):\n"
+           "    s = socket.socket()\n"
+           "    s.connect((h, p))\n"
+           "    return s\n")
+    got = run_src(bad, "dragg_tpu/x.py", "DT005")
+    assert len(got) == 1 and got[0].line == 3 and "'s'" in got[0].message
+    # settimeout on the bound name in the same function clears it.
+    ok = ("import socket\n"
+          "def dial(h, p):\n"
+          "    s = socket.socket()\n"
+          "    s.settimeout(5.0)\n"
+          "    s.connect((h, p))\n"
+          "    return s\n")
+    assert not run_src(ok, "dragg_tpu/x.py", "DT005")
+    # create_connection: the timeout argument IS the deadline (positional
+    # or keyword); without one it is tracked like a bare socket.
+    assert not run_src("import socket\n"
+                       "s = socket.create_connection(('h', 1), 5.0)\n",
+                       "dragg_tpu/x.py", "DT005")
+    assert not run_src("import socket\n"
+                       "s = socket.create_connection(('h', 1), timeout=5)\n",
+                       "dragg_tpu/x.py", "DT005")
+    assert run_src("import socket\n"
+                   "s = socket.create_connection(('h', 1))\n",
+                   "dragg_tpu/x.py", "DT005")
+    # An unbound creation (passed straight to a helper) reports inline.
+    got = run_src("import socket\nuse(socket.socket())\n",
+                  "dragg_tpu/x.py", "DT005")
+    assert len(got) == 1 and got[0].line == 2
+    # with-statement binding participates like an Assign.
+    with_ok = ("import socket\n"
+               "with socket.create_connection(('h', 1)) as s:\n"
+               "    s.settimeout(2.0)\n"
+               "    s.sendall(b'x')\n")
+    assert not run_src(with_ok, "dragg_tpu/x.py", "DT005")
+    # Same name in ANOTHER function does not satisfy the deadline.
+    cross = ("import socket\n"
+             "def a():\n"
+             "    s = socket.socket()\n"
+             "    return s\n"
+             "def b(s):\n"
+             "    s.settimeout(1.0)\n")
+    assert run_src(cross, "dragg_tpu/x.py", "DT005")
+    # Out of scope (tests/) stays exempt, like the subprocess leg.
+    assert not run_src(bad, "tests/x.py", "DT005")
+
+
 def test_dt006_accept_loop():
     src = ("httpd.serve_forever()\n"
            "httpd.serve_forever(poll_interval=0.2)\n"

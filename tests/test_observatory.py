@@ -362,6 +362,33 @@ def test_bench_trend_degraded_soft_key(tmp_path):
     assert trend["rows"][0]["rate_verdict"] == "stable"
 
 
+def test_bench_trend_transport_soft_key(tmp_path):
+    """Networked shard transport (ISSUE 16): ``transport`` is a SOFT
+    series key — spool vs tcp only moves chunk payloads between the
+    SAME device work, so a flip pairs within its hard-key series with
+    an annotation (the ``bucketed``/``degraded`` pattern), never
+    fragments it, and a genuine regression under either transport
+    still gates.  Era default: artifacts that predate the field read
+    transport="spool"."""
+    arts = [
+        _bench_line(2.0, 0.50, 1, shards=2),                  # era → spool
+        _bench_line(1.95, 0.51, 2, shards=2, transport="tcp"),
+    ]
+    rc, trend = _trend(tmp_path, arts, extra=("--gate",))
+    assert rc == 0, trend
+    assert len(trend["rows"]) == 1                # paired, not fragmented
+    row = trend["rows"][0]
+    assert "transport" not in row["key"]          # soft: not in the key
+    assert any("transport" in n for n in row["notes"]), row["notes"]
+    assert row["rate_verdict"] == "stable"
+    # A real tcp-era regression still gates (the flip never launders one).
+    arts.append(_bench_line(1.0, 0.9, 3, shards=2, transport="tcp"))
+    rc, trend = _trend(tmp_path, arts, extra=("--gate",))
+    assert rc == 1 and trend["n_regressions"] == 1
+    # Same-transport pairs carry no flip note.
+    assert not any("transport" in n for n in trend["rows"][1]["notes"])
+
+
 def test_bench_trend_communities_hard_key(tmp_path):
     """Fleet rows (ISSUE 8): ``communities`` is a HARD series key — a
     C-community artifact never pairs with single-community history (a

@@ -20,6 +20,7 @@ recorded in the output JSON.
 
 import json
 import os
+import re
 import subprocess
 import sys
 
@@ -96,6 +97,67 @@ def test_fault_plan_grammar():
     assert p.probe_seq == ["down", "down"] and p.probe_live
     with pytest.raises(ValueError):
         faults.FaultPlan("explode@x")
+
+
+def test_fault_site_catalog_in_sync():
+    """``faults.SITES`` is THE catalog (satellite 2, round 19): every
+    registered site appears in the architecture.md §8 table, every row
+    of the table is registered, and every ``fault_hook("...")`` literal
+    compiled into the framework is a registry entry.  The staged-compile
+    family is one parameterized f-string site (``compile_{stage}``) —
+    its concrete stages must each be registered."""
+    import ast
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = open(os.path.join(root, "docs", "architecture.md"),
+               encoding="utf-8").read()
+    # The §8 table rows: | `site` | where it lives |
+    table_sites = set(re.findall(r"^\| `([a-z0-9_]+)` \|", doc,
+                                 flags=re.MULTILINE))
+    # Other tables in the doc use the same shape; the catalog rows are
+    # exactly the registered sites plus nothing fault-shaped extra.
+    assert set(faults.SITES) <= table_sites, \
+        f"SITES entries missing from architecture.md §8 table: " \
+        f"{set(faults.SITES) - table_sites}"
+    for site, where in faults.SITES.items():
+        assert f"| `{site}` |" in doc, site
+
+    # Every fault_hook() call in the framework names a registered site.
+    paths = [os.path.join(root, "bench.py")]
+    for sub in ("dragg_tpu", "tools"):
+        for dirpath, _dirs, names in os.walk(os.path.join(root, sub)):
+            paths.extend(os.path.join(dirpath, n) for n in names
+                         if n.endswith(".py"))
+    dynamic = []
+    for path in paths:
+        try:
+            tree = ast.parse(open(path, encoding="utf-8").read())
+        except SyntaxError:  # pragma: no cover - DT001's job
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and ((isinstance(node.func, ast.Name)
+                          and node.func.id == "fault_hook")
+                         or (isinstance(node.func, ast.Attribute)
+                             and node.func.attr == "fault_hook"))
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                assert arg.value in faults.SITES, \
+                    f"{path}:{node.lineno} fault_hook({arg.value!r}) " \
+                    f"is not in faults.SITES"
+            elif isinstance(arg, ast.JoinedStr):
+                head = arg.values[0]
+                assert (isinstance(head, ast.Constant)
+                        and str(head.value).startswith("compile_")), \
+                    f"{path}:{node.lineno} dynamic fault_hook site " \
+                    f"outside the compile_ family"
+                dynamic.append(path)
+    # The parameterized family's concrete stages are registered.
+    assert {"compile_lower", "compile_compile",
+            "compile_first_execute"} <= set(faults.SITES)
+    assert dynamic, "the staged-compile fault_hook site disappeared"
 
 
 # ----------------------------------------------------------- supervisor

@@ -22,7 +22,10 @@ Comparability rules (CLAUDE.md "Round-5 semantic defaults"):
   mix, docs/perf_notes.md), so a flip does not break comparability —
   the verdict row is annotated with the flip instead, and readers
   wanting a solver-only A/B pin ``--bucketed false`` at measurement
-  time (CLAUDE.md).
+  time (CLAUDE.md);
+* ``transport`` is a SOFT key too (round 19): spool vs tcp only moves
+  chunk payloads between the same device work — a flip annotates the
+  row (era default "spool"), never fragments or gates the series.
 
 Verdicts: per consecutive comparable pair, the headline rate (higher is
 better) and the steady-state solve phase (lower is better) each read
@@ -121,7 +124,7 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
                     metric="metrics_snapshot", platform="?", solver="?",
                     semantics="?", data="?", communities=1, mix="?",
                     precision="?", rl="none", serve="none", shards=1,
-                    bucketed=False,
+                    transport="spool", bucketed=False,
                     fallback=False, degraded=None,
                     value=float(gauges.get("bench.rate_ts_per_s", 0.0)),
                     solve_rate=gauges.get("engine.solve_rate"),
@@ -178,6 +181,13 @@ def _normalize(rec: dict, source: str, ordinal: int) -> dict:
         # and never gate against in-process history.  Era default: every
         # pre-field artifact measured one process.
         shards=int(rec.get("shards", 1)),
+        # Shard transport is a SOFT key (round 19, the `degraded`
+        # pattern): a tcp-transport row measures the same device work as
+        # a spool row at the same shard geometry — the wire only moves
+        # chunk payloads — so a flip ANNOTATES the series instead of
+        # fragmenting it.  Era default: every pre-field artifact
+        # exchanged chunks over the shared-disk spool.
+        transport=str(rec.get("transport", "spool")),
         bucketed=bool(rec.get("bucketed", False)),
         fallback=bool(rec.get("fallback", False)),
         degraded=rec.get("degraded"),
@@ -262,6 +272,14 @@ def build_trend(entries: list[dict], threshold: float) -> dict:
                     f"{prev['bucketed']}→{cur['bucketed']} (engine default "
                     f"— round-8 shape specialization; pin --bucketed false "
                     f"for a solver-only A/B)")
+            if prev.get("transport", "spool") != cur.get("transport",
+                                                         "spool"):
+                notes.append(
+                    f"shard transport changed "
+                    f"{prev.get('transport', 'spool')}→"
+                    f"{cur.get('transport', 'spool')} (round-19 wire vs "
+                    f"shared-disk chunk exchange — annotating, not "
+                    f"gating; same device work either way)")
             rows.append(dict(
                 key={k: prev[k] for k in HARD_KEY},
                 from_source=os.path.basename(prev["source"]),

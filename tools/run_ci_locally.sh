@@ -115,7 +115,22 @@ step "test/shard-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              --shards 2 --shard-parity --min-solve-rate 0.8 \
              | tee /tmp/shard_smoke.json &&
            python -c "import json; r=json.load(open(\"/tmp/shard_smoke.json\")); assert r[\"ok\"] and r[\"shards\"]==2 and r[\"shard_parity\"][\"ok\"], r" &&
-           python -m dragg_tpu doctor --shard-check --backend-timeout 60 | grep -q "shard_journal *\[ok"'
+           python -m dragg_tpu doctor --shard-check --backend-timeout 60 | grep "shard_journal *\[ok" >/dev/null'
+
+# --- job: wire smoke (ISSUE 16): networked shard transport — the same
+#     2-shard split pushing chunks over TCP to the coordinator's
+#     chunk-ingest server (at-least-once, epoch-fenced, journal-before-
+#     ack), merged outputs asserted against the IN-PROCESS fleet
+#     (--shard-parity's reference leg always runs spool, so this is a
+#     cross-transport A/B), plus the doctor's loopback wire selftest
+#     (torn-frame sweep + dedup-across-restart + fence naming)
+step "test/wire-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  bash -c 'python tools/validate_scale.py --communities 4 --homes 16 \
+             --horizon-hours 2 --days 1 --chunk 6 --steps 12 --solver ipm \
+             --shards 2 --transport tcp --shard-parity --min-solve-rate 0.8 \
+             | tee /tmp/wire_smoke.json &&
+           python -c "import json; r=json.load(open(\"/tmp/wire_smoke.json\")); assert r[\"ok\"] and r[\"shards\"]==2 and r[\"transport\"]==\"tcp\" and r[\"shard_parity\"][\"ok\"], r" &&
+           python -m dragg_tpu doctor --shard-check --backend-timeout 60 | grep "shard_wire *\[ok" >/dev/null'
 
 # --- job: bench-trend gate (round 9): the committed BENCH_r*.json series
 #     must show no like-for-like regression (comparability rules per

@@ -93,6 +93,7 @@ def run_shards(args):
         cfg.setdefault("shard", {})["deadline_s"] = args.deadline
     if args.stall:
         cfg.setdefault("shard", {})["stall_s"] = args.stall
+    cfg.setdefault("shard", {})["transport"] = args.transport
     dt = int(cfg["agg"]["subhourly_steps"])
     num_ts = args.steps or args.days * 24 * dt
     run_dir = args.shard_run_dir or tempfile.mkdtemp(
@@ -106,8 +107,15 @@ def run_shards(args):
     n_total = args.homes * args.communities
     parity = None
     if args.shard_parity:
+        # The reference leg always runs the round-18 spool transport, so
+        # --transport tcp --shard-parity is a CROSS-transport A/B: the
+        # wire-delivered merge must be bit-identical to the shared-disk
+        # one.
+        ref_cfg = {**cfg, "shard": {**cfg.get("shard", {}),
+                                    "transport": "spool"}}
         ref = run_sharded(
-            cfg, run_dir=os.path.join(run_dir, "parity_ref"), steps=num_ts,
+            ref_cfg, run_dir=os.path.join(run_dir, "parity_ref"),
+            steps=num_ts,
             workers=1, chunk_steps=args.chunk, data_dir=args.data_dir,
             log=lambda m: print(f"[parity] {m}", file=sys.stderr,
                                 flush=True))
@@ -127,6 +135,7 @@ def run_shards(args):
     result = {
         "homes": args.homes, "communities": args.communities,
         "homes_total": n_total, "shards": args.shards,
+        "transport": args.transport,
         "shard_ranges": res["ranges"],
         # The workers' tpu.sharded resolution (each shards its OWN home
         # axis over its own visible devices — shard/worker.py).
@@ -168,6 +177,15 @@ def main():
                          "coordinator — communities split into N "
                          "contiguous ranges, one supervised worker "
                          "process each, merged per-community outputs")
+    ap.add_argument("--transport", choices=["spool", "tcp"],
+                    default="spool",
+                    help="with --shards: chunk exchange — 'spool' = "
+                         "shared-disk outbox files (round 18), 'tcp' = "
+                         "workers push checksummed frames to the "
+                         "coordinator's chunk-ingest server over "
+                         "shard.listen (architecture.md §20); the "
+                         "--shard-parity reference leg ALWAYS runs spool, "
+                         "making it a cross-transport A/B")
     ap.add_argument("--shard-parity", action="store_true",
                     help="with --shards: ALSO run the same fleet as one "
                          "in-process worker and assert the merged "
