@@ -74,6 +74,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "journal torn-tail truncation at every byte "
                           "boundary + duplicate-epoch refusal, mirroring "
                           "the serve_journal check")
+    doc.add_argument("--telemetry", action="store_true",
+                     help="additionally self-test the trace plane "
+                          "(dragg_tpu/telemetry): a traced run in a "
+                          "subprocess must assemble to one complete "
+                          "causal tree, live-flush metrics.json "
+                          "mid-run, and fold a rollup with Prometheus "
+                          "exposition")
 
     srv = sub.add_parser(
         "serve",
@@ -279,7 +286,8 @@ def main(argv=None) -> int:
         return run_doctor(outputs_dir=args.outputs_dir,
                           backend_timeout=args.backend_timeout,
                           compile_check=args.compile_check,
-                          shard_check=args.shard_check)
+                          shard_check=args.shard_check,
+                          telemetry_check=args.telemetry)
     if args.cmd == "sweep":
         return run_sweep(args)
     if args.cmd == "dashboard":

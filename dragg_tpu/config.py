@@ -477,6 +477,18 @@ _DEFAULT: dict[str, Any] = {
                              # <run_dir>/forensics/ (home config + chunk-
                              # start state — offline QP reconstruction
                              # without a full re-run)
+        # Trace plane (ISSUE 20 — docs/telemetry.md "Tracing").
+        "trace": False,    # causal trace context on every record (trace/
+                           # span/parent ids), propagated to supervised
+                           # children, serve requests, and shard chunk
+                           # pushes; false = no trace fields at all —
+                           # streams byte-identical to round 19
+        "flush_interval_s": 0.0,  # live metrics rollup cadence: >0
+                                  # flushes in-progress metric deltas to
+                                  # metrics.json every this-many seconds
+                                  # (crash no longer loses the snapshot);
+                                  # 0 = final-snapshot-only (round-19
+                                  # behavior)
     },
     # dragg_tpu-specific knobs (no reference analog).
     "tpu": {

@@ -434,6 +434,31 @@ def make_handler(dash: Dashboard):
                     return
                 self._send(200, "application/json", body)
                 return
+            if parsed.path in ("/rollup.json", "/metrics"):
+                # Fleet rollup (ISSUE 20 — telemetry/rollup.py): folded
+                # per-stream metrics + per-shard health scoreboard, as
+                # JSON or Prometheus text exposition.
+                try:
+                    runs = dash.live_runs()
+                    run = dash._select_run(runs, parsed.query)
+                    if run is None:
+                        self._send(404, "application/json",
+                                   b'{"error": "no telemetry stream"}')
+                        return
+                    roll = telemetry.rollup.fold_rollup(run["dir"])
+                    if parsed.path == "/rollup.json":
+                        body = json.dumps(roll, default=str).encode()
+                        ctype = "application/json"
+                    else:
+                        body = telemetry.rollup.prometheus_text(
+                            roll).encode()
+                        ctype = "text/plain; version=0.0.4"
+                except Exception as e:
+                    self._send(500, "text/plain",
+                               f"rollup failed: {e!r}".encode())
+                    return
+                self._send(200, ctype, body)
+                return
             if parsed.path.startswith("/fig/") and parsed.path.endswith(".svg"):
                 name = parsed.path[len("/fig/"):-len(".svg")]
                 home = urllib.parse.parse_qs(parsed.query).get("home", [None])[0]

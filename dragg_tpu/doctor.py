@@ -115,6 +115,67 @@ def _check_telemetry() -> dict:
         return {"status": FAIL, "error": repr(e)}
 
 
+def _check_trace_plane(timeout_s: float = 30.0) -> dict:
+    """Trace-plane selftest (``--telemetry``, ISSUE 20): a SUBPROCESS
+    runs a tiny traced bus session — root + child spans, a skew record,
+    a sub-second live-flush cadence — then this process asserts the
+    stream assembles to one COMPLETE causal tree (>=1 root, zero
+    orphans), that metrics.json existed BEFORE close (the crash-loss
+    fix), and that the rollup folds with Prometheus exposition.  A
+    subprocess so the probe never perturbs this process's own bus or
+    trace context."""
+    code = (
+        "import json, os, tempfile, time\n"
+        "from dragg_tpu import telemetry\n"
+        "from dragg_tpu.telemetry import trace\n"
+        "d = tempfile.mkdtemp(prefix='dragg_traceck_')\n"
+        "trace.enable()\n"
+        "telemetry.init_run(d, flush_s=0.05)\n"
+        "telemetry.emit('run.start', config_label='doctor', platform='cpu')\n"
+        "telemetry.inc('wire.dedup', 1)\n"
+        "telemetry.emit('chunk.done', t0=0, t1=2, device_s=0.01,\n"
+        "               **trace.child_fields())\n"
+        "telemetry.emit('trace.skew', shard=0, offset_s=0.0, rtt_s=0.001)\n"
+        "time.sleep(0.1)\n"
+        "telemetry.emit('run.end', ok=True)\n"
+        "live = os.path.exists(os.path.join(d, telemetry.METRICS_FILE))\n"
+        "telemetry.close_run(write_metrics=True)\n"
+        "print('TRACECK ' + json.dumps({'dir': d, 'live_flush': live}))\n")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        line = next((l for l in (proc.stdout or "").splitlines()
+                     if l.startswith("TRACECK ")), None)
+        if proc.returncode != 0 or line is None:
+            return {"status": FAIL, "error": (proc.stderr or "")[-300:]}
+        child = json.loads(line[len("TRACECK "):])
+        from dragg_tpu.telemetry import rollup, traces
+
+        rep = traces.trace_report(child["dir"])
+        roll = rollup.fold_rollup(child["dir"])
+        prom = rollup.prometheus_text(roll)
+        import shutil
+
+        shutil.rmtree(child["dir"], ignore_errors=True)
+        problems = traces.completeness_problems(rep)
+        if not child["live_flush"]:
+            problems.append("no metrics.json before close "
+                            "(live flush did not fire)")
+        if "dragg_" not in prom:
+            problems.append("prometheus exposition empty")
+        return {"status": OK if not problems else FAIL,
+                "traces": len(rep["traces"]),
+                "live_flush": child["live_flush"],
+                "rollup_streams": len(roll.get("streams", {})),
+                **({"problems": problems} if problems else {})}
+    except subprocess.TimeoutExpired:
+        return {"status": FAIL,
+                "error": f"trace selftest hung >{timeout_s:.0f}s"}
+    except Exception as e:
+        return {"status": FAIL, "error": repr(e)}
+
+
 def _check_staged_compile(timeout_s: float) -> dict:
     """Opt-in (``--compile-check``): a tiny engine's chunk compile run
     through the STAGED path (telemetry/compile_obs: lower → compile →
@@ -378,7 +439,8 @@ def run_classify(backend_timeout: float = 60.0, stream=None) -> int:
 
 def run_doctor(outputs_dir: str = "outputs", backend_timeout: float = 60.0,
                stream=None, compile_check: bool = False,
-               shard_check: bool = False) -> int:
+               shard_check: bool = False,
+               telemetry_check: bool = False) -> int:
     stream = stream or sys.stdout
     config_res, cfg = _check_config()
     backend_res = _check_backend(backend_timeout)
@@ -401,6 +463,8 @@ def run_doctor(outputs_dir: str = "outputs", backend_timeout: float = 60.0,
     if shard_check:
         checks["shard_journal"] = _check_shard_journal()
         checks["shard_wire"] = _check_shard_wire()
+    if telemetry_check:
+        checks["trace_plane"] = _check_trace_plane()
     # Pallas only matters when a TPU backend is up — and its self-test
     # compiles a kernel, so it runs in a SUBPROCESS with the same hard
     # timeout as the backend probe (a tunnel can wedge between probes).

@@ -188,6 +188,16 @@ def run_supervised(argv: list[str], deadline_s: float, *,
         child_env[telemetry.ENV_DIR] = telemetry_dir
     elif telemetry.run_dir():
         child_env.setdefault(telemetry.ENV_DIR, telemetry.run_dir())
+    # Same contract for the causal trace context (ISSUE 20): a tracing
+    # parent exports $DRAGG_TRACE_CTX so the child's records land in
+    # the same trace, its process root span parented on ours.  Nothing
+    # is exported when tracing is off.
+    trace_ctx = telemetry.trace.env_value()
+    if trace_ctx:
+        child_env.setdefault(telemetry.trace.ENV_CTX, trace_ctx)
+    flush_s = os.environ.get(telemetry.ENV_FLUSH)
+    if flush_s:
+        child_env.setdefault(telemetry.ENV_FLUSH, flush_s)
     out_f = (open(stdout_path, "wb") if stdout_path else
              tempfile.NamedTemporaryFile(prefix="dragg_sup_out_", delete=False))
     err_f = (open(stderr_path, "wb") if stderr_path else

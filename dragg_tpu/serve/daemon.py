@@ -163,9 +163,19 @@ class ServeDaemon:
             os.remove(spool.stop_path(self.spool_dir))
         except OSError:
             pass
+        # Trace plane (ISSUE 20): ``telemetry.trace = true`` makes the
+        # daemon the trace root — request spans parent on it, batch
+        # spans parent on requests, and the worker env export carries
+        # the context into serve.chunk records.  The live-flush cadence
+        # rides the env so worker children inherit it.
+        tcfg = self.config.get("telemetry", {})
+        flush_cfg = float(tcfg.get("flush_interval_s", 0.0) or 0.0)
+        if flush_cfg and not os.environ.get(telemetry.ENV_FLUSH):
+            os.environ[telemetry.ENV_FLUSH] = str(flush_cfg)
+        if tcfg.get("trace") and not telemetry.trace.enabled():
+            telemetry.trace.enable()
         self._owns_bus = False
-        if self.config.get("telemetry", {}).get("enabled", True) \
-                and not telemetry.active():
+        if tcfg.get("enabled", True) and not telemetry.active():
             telemetry.init_run(os.environ.get(telemetry.ENV_DIR) or serve_dir)
             self._owns_bus = True
 
@@ -496,6 +506,13 @@ class ServeDaemon:
             req, bad = self._normalize_request(req)
             if bad is not None:
                 return 400, {"error": bad}
+            # A traced CLIENT's X-Dragg-Parent rides in as a private key
+            # (the HTTP handler injects it); popped before the journal's
+            # durability point so the accepted record of record stays
+            # canonical.  It is recorded as an INFORMATIONAL field on
+            # serve.request — the request span parents on the daemon
+            # root, keeping every in-stream tree rooted.
+            client_parent = req.pop("_client_parent", None)
             rid = str(req.get("id") or uuid.uuid4().hex)
             known = self.results.get(rid)
             if known is not None:
@@ -543,10 +560,23 @@ class ServeDaemon:
             entry = self._entry(rid, req, time.monotonic())
             entry["lane"] = lane_name
             self.pending[rid] = entry
+            span = telemetry.trace.child_fields()
+            if span:
+                entry["span"] = span["span"]
+                if client_parent:
+                    span["client_parent"] = client_parent
             telemetry.emit("serve.request", id=rid,
-                           timestep=req.get("t", 0), home=req["home"])
+                           timestep=req.get("t", 0), home=req["home"],
+                           **span)
             telemetry.set_gauge("serve.queue_depth", depth + 1)
-            return 202, {"id": rid, "status": "accepted"}
+            body = {"id": rid, "status": "accepted"}
+            if span:
+                # The handler pops this into X-Dragg-Trace/X-Dragg-Span
+                # response headers — the client's join point.
+                body["_trace"] = {
+                    "trace": telemetry.trace.current()["trace"],
+                    "span": entry["span"]}
+            return 202, body
 
     def _result_body(self, rid: str, rec: dict) -> dict:
         if rec.get("state") == journal_mod.DONE:
@@ -840,9 +870,16 @@ class ServeDaemon:
                                                 "id": rid,
                                                 "response": record})
                     telemetry.inc("serve.requests_done", 1)
+                    # The terminal record re-uses the REQUEST span (same
+                    # id): its t extent closes the span, so per-request
+                    # wall time falls out of the assembled tree.
+                    done_span = (telemetry.trace.span_fields(entry["span"])
+                                 if entry is not None and entry.get("span")
+                                 else {})
                     telemetry.emit("serve.done", id=rid, batch=seq,
                                    platform=platform,
-                                   degraded=degraded is not None)
+                                   degraded=degraded is not None,
+                                   **done_span)
                     if entry is not None:
                         telemetry.observe("serve.request_latency_s",
                                           now - entry["accepted_mono"])
@@ -935,6 +972,7 @@ class ServeDaemon:
         seq = self.batch_seq
         ids: list[str] = []
         gpayload = []
+        parent_span = None
         for cslot, (rp, by_home) in enumerate(groups):
             reqs = []
             for entry in by_home.values():
@@ -942,8 +980,18 @@ class ServeDaemon:
                 ids.append(rid)
                 self.assigned[rid] = self.pending.pop(rid)
                 reqs.append(entry["req"])
+                if parent_span is None:
+                    parent_span = entry.get("span")
             gpayload.append({"cslot": cslot, "rp": rp, "requests": reqs})
         batch = {"batch": seq, "t": t, "steps": steps, "groups": gpayload}
+        # Batch span, parented on the first coalesced request's span; it
+        # rides the inbox payload so the worker's serve.chunk records
+        # parent on it (request -> batch -> chunk, one causal chain).
+        # Absent entirely when tracing is off — the inbox payload stays
+        # byte-identical to round 16.
+        bspan = telemetry.trace.child_fields(parent=parent_span)
+        if bspan:
+            batch["span"] = bspan["span"]
         spool.atomic_write_json(
             os.path.join(slot.inbox(), spool.batch_name(seq)), batch)
         self.journal.assigned(ids, seq, slot.slot, slot.gen,
@@ -957,7 +1005,7 @@ class ServeDaemon:
                        gen=slot.gen, n=len(ids), groups=len(gpayload),
                        occupancy=round(occupancy, 4), timestep=t,
                        steps=steps, pattern=lane.name,
-                       window_wait_s=round(window_wait, 4))
+                       window_wait_s=round(window_wait, 4), **bspan)
         telemetry.observe("serve.batch_occupancy", occupancy)
         telemetry.observe("serve.coalesced_requests", float(len(ids)))
         telemetry.observe("serve.batch_window_wait_s", max(0.0, window_wait))
@@ -1086,10 +1134,17 @@ def _make_handler(daemon: ServeDaemon):
 
         def _send(self, code: int, body: dict,
                   retry_after: float | None = None) -> None:
+            # Trace join point: a traced accept tucks {"trace","span"}
+            # under "_trace"; it leaves the body and answers as the
+            # X-Dragg-Trace/X-Dragg-Span response headers.
+            tr = body.pop("_trace", None) if isinstance(body, dict) else None
             data = json.dumps(body, default=str).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            if tr:
+                self.send_header("X-Dragg-Trace", str(tr["trace"]))
+                self.send_header("X-Dragg-Span", str(tr["span"]))
             if retry_after is not None:
                 self.send_header("Retry-After",
                                  str(max(1, int(round(retry_after)))))
@@ -1107,8 +1162,22 @@ def _make_handler(daemon: ServeDaemon):
             except (ValueError, OSError) as e:
                 self._send(400, {"error": f"bad request body: {e!r}"})
                 return
+            parent_hdr = self.headers.get("X-Dragg-Parent")
+            if parent_hdr:
+                # Client-side trace join (tools/serve_load.py): recorded
+                # as an informational field on serve.request, never as
+                # the span parent (in-stream trees stay rooted).
+                if isinstance(payload, dict):
+                    payload.setdefault("_client_parent", parent_hdr)
+                elif isinstance(payload, list):
+                    for r in payload:
+                        if isinstance(r, dict):
+                            r.setdefault("_client_parent", parent_hdr)
             if isinstance(payload, list):
                 replies = [daemon.accept(r) for r in payload]
+                for _, b in replies:
+                    if isinstance(b, dict):
+                        b.pop("_trace", None)
                 worst = max((code for code, _ in replies), default=200)
                 self._send(worst if worst >= 400 else 202,
                            {"results": [b for _, b in replies]},
@@ -1252,6 +1321,22 @@ def _make_handler(daemon: ServeDaemon):
                 events = (telemetry.tail_events(path, limit=limit)
                           if path else [])
                 self._send(200, {"events": events})
+            elif parsed.path in ("/rollup.json", "/metrics"):
+                run_dir = telemetry.run_dir()
+                if not run_dir:
+                    self._send(404, {"error": "no telemetry run dir"})
+                    return
+                roll = telemetry.rollup.fold_rollup(run_dir)
+                if parsed.path == "/rollup.json":
+                    self._send(200, roll)
+                    return
+                text = telemetry.rollup.prometheus_text(roll).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
             else:
                 self._send(404, {"error": "not found"})
 

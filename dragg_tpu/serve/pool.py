@@ -94,6 +94,15 @@ class WorkerSlot:
         env[hb.ENV] = self.hb_path
         if telemetry.run_dir():
             env.setdefault(telemetry.ENV_DIR, telemetry.run_dir())
+        # Trace context + flush cadence travel with the stream dir
+        # (ISSUE 20): exported only when the daemon traces/flushes, so
+        # untraced deployments launch byte-identical children.
+        trace_ctx = telemetry.trace.env_value()
+        if trace_ctx:
+            env.setdefault(telemetry.trace.ENV_CTX, trace_ctx)
+        flush_s = os.environ.get(telemetry.ENV_FLUSH)
+        if flush_s:
+            env.setdefault(telemetry.ENV_FLUSH, flush_s)
         argv = [sys.executable, "-m", "dragg_tpu.serve.worker",
                 "--spool", self.spool_dir, "--slot", str(self.slot),
                 "--gen", str(self.gen), "--poll-s", str(self.poll_s)]

@@ -100,7 +100,8 @@ class StubRunner:
             "correct_solve": 1.0,
         }
 
-    def solve(self, t: int, groups: list[dict], steps: int = 1) -> dict:
+    def solve(self, t: int, groups: list[dict], steps: int = 1,
+              span: str | None = None) -> dict:
         out = {}
         for g in groups:
             cslot = int(g.get("cslot", 0))
@@ -109,7 +110,9 @@ class StubRunner:
                     fields = self._fields(t + k, req)
                     if steps > 1:
                         telemetry.emit("serve.chunk", id=req["id"], step=k,
-                                       steps=steps, timestep=t + k, **fields)
+                                       steps=steps, timestep=t + k, **fields,
+                                       **telemetry.trace.child_fields(
+                                           parent=span))
                 out[req["id"]] = {**fields, "cslot": cslot, "steps": steps}
         return out
 
@@ -264,7 +267,8 @@ class EngineRunner:
         return state
 
     # --------------------------------------------------------------- solve
-    def solve(self, t: int, groups: list[dict], steps: int = 1) -> dict:
+    def solve(self, t: int, groups: list[dict], steps: int = 1,
+              span: str | None = None) -> dict:
         np = self._np
         state = self._with_overrides(groups)
         if self.fleet_slots == 1:
@@ -289,7 +293,9 @@ class EngineRunner:
                         for f, v in fields.items()}
                 if steps > 1:
                     telemetry.emit("serve.chunk", id=req["id"], step=k,
-                                   steps=steps, timestep=t + k, **vals)
+                                   steps=steps, timestep=t + k, **vals,
+                                   **telemetry.trace.child_fields(
+                                       parent=span))
                 resp[req["id"]] = {**vals, "cslot": cslot, "steps": steps}
             if steps > 1:
                 beat({"stage": "serve:chunk", "step": k, "steps": steps})
@@ -330,8 +336,12 @@ def serve_loop(runner, spool_dir: str, slot: int, gen: int,
             fault_hook("serve_batch")
             groups = _as_groups(payload)
             t0 = time.perf_counter()
+            # The batch span (daemon _dispatch) rides the inbox payload;
+            # per-chunk serve.chunk records parent on it so the request
+            # -> batch -> chunk chain crosses the process boundary.
             responses = runner.solve(int(payload.get("t", 0)), groups,
-                                     steps=max(1, int(payload.get("steps", 1))))
+                                     steps=max(1, int(payload.get("steps", 1))),
+                                     span=payload.get("span"))
             resp = {"batch": seq, "platform": runner.platform, "gen": gen,
                     "elapsed_s": round(time.perf_counter() - t0, 4),
                     "groups": len(groups), "responses": responses}

@@ -136,8 +136,17 @@ def run_sharded(config: dict, *, run_dir: str, steps: int,
                          f"got {transport!r}")
     server = None
     opened_bus = False
-    if (config.get("telemetry", {}).get("enabled", True)
-            and not telemetry.active()):
+    tcfg = config.get("telemetry", {})
+    # Trace plane (ISSUE 20): ``telemetry.trace = true`` makes this
+    # coordinator the trace root — every slot launch exports the context
+    # so worker/supervisor records land in one causal tree.  The flush
+    # cadence rides the env the same way the slot export reads it.
+    flush_cfg = float(tcfg.get("flush_interval_s", 0.0) or 0.0)
+    if flush_cfg and not os.environ.get(telemetry.ENV_FLUSH):
+        os.environ[telemetry.ENV_FLUSH] = str(flush_cfg)
+    if tcfg.get("trace") and not telemetry.trace.enabled():
+        telemetry.trace.enable()
+    if tcfg.get("enabled", True) and not telemetry.active():
         telemetry.init_run(run_dir)
         opened_bus = True
     journal = sj.Journal(os.path.join(run_dir, JOURNAL_FILE))
@@ -174,7 +183,8 @@ def run_sharded(config: dict, *, run_dir: str, steps: int,
 
             server = ChunkIngestServer(
                 spool_dir, journal, token,
-                listen=str(scfg.get("listen", "127.0.0.1:0")), log=log)
+                listen=str(scfg.get("listen", "127.0.0.1:0")),
+                run_dir=run_dir, log=log)
             server.start()
         telemetry.emit("shard.plan", communities=C, workers=n_workers,
                        ranges=[[a, b] for a, b in ranges], steps=steps,
@@ -239,6 +249,7 @@ def run_sharded(config: dict, *, run_dir: str, steps: int,
             """Merge every consecutive ready chunk at the frontier."""
             while sh.frontier < n_chunks_target:
                 seq = sh.frontier
+                t_m0 = time.monotonic()
                 payload = sp.read_json(sp.chunk_path(spool_dir, k, seq))
                 if payload is None or int(payload.get("seq", -1)) != seq:
                     return
@@ -254,10 +265,19 @@ def run_sharded(config: dict, *, run_dir: str, steps: int,
                 if not (server is not None and server.was_acked(k, seq)):
                     journal.chunk(k, seq, int(payload["t0"]),
                                   int(payload["t1"]))
+                # Trace-only extras: a merge span parented on the chunk
+                # span that rode the payload, plus the merge duration
+                # the critical-path "merge" bucket attributes.  Nothing
+                # when tracing is off (round-19 byte identity).
+                extra = telemetry.trace.child_fields(
+                    parent=payload.get("trace_span"))
+                if extra:
+                    extra["s"] = round(time.monotonic() - t_m0, 6)
                 telemetry.emit("shard.chunk", shard=k, seq=seq,
                                t0=payload["t0"], t1=payload["t1"],
                                solve_rate=payload.get("solve_rate"),
-                               device_s=payload.get("device_s"))
+                               device_s=payload.get("device_s"),
+                               **extra)
                 if payload.get("device_s") is not None:
                     telemetry.observe("shard.chunk_s",
                                       float(payload["device_s"]))

@@ -87,6 +87,16 @@ class ShardSlot:
         stream = telemetry.run_dir() or env.get(telemetry.ENV_DIR)
         if stream:
             env[telemetry.ENV_DIR] = shard_stream_dir(stream, self.shard)
+        # Causal trace context + live-rollup flush cadence travel the
+        # same way as the stream dir (ISSUE 20): exported only when the
+        # coordinator traces/flushes, so untraced runs stay byte-
+        # identical.
+        trace_ctx = telemetry.trace.env_value()
+        if trace_ctx:
+            env[telemetry.trace.ENV_CTX] = trace_ctx
+        flush_s = os.environ.get(telemetry.ENV_FLUSH)
+        if flush_s:
+            env.setdefault(telemetry.ENV_FLUSH, flush_s)
         argv = [sys.executable, "-m", "dragg_tpu.shard.worker",
                 "--spool", self.spool_dir, "--shard", str(self.shard),
                 "--gen", str(self.gen)]

@@ -89,9 +89,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, status: int, doc: dict) -> None:
         body = json.dumps(doc).encode("utf-8")
+        self._reply_bytes(status, "application/json", body)
+
+    def _reply_bytes(self, status: int, ctype: str, body: bytes) -> None:
         try:
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -145,8 +148,13 @@ class _Handler(BaseHTTPRequestHandler):
                 "got": epoch, "want": owner.epoch})
             return
         dup = owner.ingest(shard, seq, payload)
+        # Server-side ingest span, parented on the chunk span that rode
+        # the frame body (worker.py) — the wire hop stays one causal
+        # chain.  No fields when the coordinator isn't tracing.
         telemetry.emit("wire.ingest", shard=shard, seq=seq, dup=dup,
-                       bytes=length)
+                       bytes=length,
+                       **telemetry.trace.child_fields(
+                           parent=payload.get("trace_span")))
         try:
             fault_hook("wire_ack")
         except WireFault:
@@ -163,6 +171,29 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         if url.path == "/ping":
             self._reply(200, {"ok": True, "epoch": owner.epoch})
+            return
+        if url.path == "/clock":
+            # Clock-skew handshake (ISSUE 20): the wire client brackets
+            # this call and derives its wall-clock offset against the
+            # coordinator — merged ordering's honesty correction for
+            # the multi-host future.
+            self._reply(200, {"ok": True, "t": time.time(),  # dragg: disable=DT014, the handshake MEASURES wall clocks — that is the payload
+                              "epoch": owner.epoch})
+            return
+        if url.path in ("/rollup.json", "/metrics"):
+            from dragg_tpu.telemetry import rollup as rollup_mod
+
+            run_dir = owner.run_dir or telemetry.run_dir()
+            if not run_dir:
+                self._reply(404, {"error": "no telemetry run dir"})
+                return
+            roll = rollup_mod.fold_rollup(run_dir)
+            if url.path == "/rollup.json":
+                self._reply(200, roll)
+            else:
+                self._reply_bytes(
+                    200, "text/plain; version=0.0.4",
+                    rollup_mod.prometheus_text(roll).encode("utf-8"))
             return
         if url.path != "/params":
             self._reply(404, {"error": f"no such endpoint {url.path}"})
@@ -190,10 +221,14 @@ class ChunkIngestServer:
     duplicate, never re-merged (``doctor --shard-check`` pins this)."""
 
     def __init__(self, spool_dir: str, journal, epoch: str, *,
-                 listen: str = "127.0.0.1:0", log=None):
+                 listen: str = "127.0.0.1:0", run_dir: str | None = None,
+                 log=None):
         self.spool_dir = spool_dir
         self.journal = journal
         self.epoch = epoch
+        # Telemetry run dir backing /rollup.json + /metrics (falls back
+        # to the process bus's dir at request time when None).
+        self.run_dir = run_dir
         self.log = log
         self._lock = threading.Lock()
         self._params_cv = threading.Condition(self._lock)
@@ -241,6 +276,7 @@ class ChunkIngestServer:
         chunk ack both complete BEFORE the handler sends the 200."""
         with self._lock:
             if (shard, seq) in self._seen:
+                telemetry.inc("wire.dedup", 1)
                 return True
             sp.ensure_shard_dirs(self.spool_dir, shard)
             path = sp.chunk_path(self.spool_dir, shard, seq)
@@ -315,6 +351,35 @@ class WireClient:
         self.op_timeout_s = float(op_timeout_s)
         self.log = log
         self.degraded = False
+        if telemetry.trace.enabled():
+            self._clock_handshake()
+
+    def _clock_handshake(self) -> None:
+        """Bracket a ``GET /clock`` to measure this process's wall-clock
+        offset against the coordinator (offset = server − midpoint, the
+        classic NTP-lite estimate).  Emitted as ``trace.skew`` so the
+        merged tailer and the trace assembler can order cross-process
+        records honestly (ISSUE 20).  Best-effort: a dead wire just
+        means no correction record, never a stalled worker."""
+        conn = HTTPConnection(self.host, self.port,
+                              timeout=self.op_timeout_s)
+        try:
+            t0 = time.time()  # dragg: disable=DT014, bracketing wall clocks IS the skew measurement
+            conn.request("GET", "/clock")
+            r = conn.getresponse()
+            body = r.read()
+            t1 = time.time()  # dragg: disable=DT014, bracketing wall clocks IS the skew measurement
+            if r.status != 200:
+                return
+            doc = json.loads(body)
+            offset = float(doc["t"]) - (t0 + t1) / 2.0
+            telemetry.emit("trace.skew", shard=self.shard,
+                           offset_s=round(offset, 6),
+                           rtt_s=round(t1 - t0, 6))
+        except (OSError, ValueError, KeyError, HTTPException):
+            pass
+        finally:
+            conn.close()
 
     # ------------------------------------------------------------- pushing
     def push_chunk(self, seq: int, payload: dict) -> str:
@@ -341,10 +406,17 @@ class WireClient:
             status, resp = self._attempt(frame)
             if status == 200:
                 dup = bool((resp or {}).get("dup"))
+                push_s = time.monotonic() - t_start
+                # Trace-only extras (span + ``s`` duration for the
+                # critical-path "wire" bucket): the off-mode stream
+                # stays byte-identical to round 19.
+                extra = telemetry.trace.child_fields(
+                    parent=payload.get("trace_span"))
+                if extra:
+                    extra["s"] = round(push_s, 6)
                 telemetry.emit("wire.push", shard=self.shard, seq=seq,
-                               dup=dup, attempts=attempts)
-                telemetry.observe("wire.push_s",
-                                  time.monotonic() - t_start)
+                               dup=dup, attempts=attempts, **extra)
+                telemetry.observe("wire.push_s", push_s)
                 return "dup" if dup else "acked"
             if status == 409:
                 raise EpochFenced(self.epoch,

@@ -214,6 +214,11 @@ def main() -> None:
                 if params.get("stop_t") is not None:
                     stop_t = min(stop_t, max(t, int(params["stop_t"])))
         fault_hook("shard_chunk")
+        # One causal span per chunk (ISSUE 20): the same span id rides
+        # chunk.done, the outbox/wire payload, and the coordinator's
+        # merge record, so the assembler links worker chunk -> wire
+        # push -> merge into one rooted chain.  Empty when tracing off.
+        chunk_span = telemetry.trace.child_fields()
         k = min(chunk_steps, stop_t - t)
         rps = np.zeros((k, H), dtype=np.float32)
         t0 = time.perf_counter()
@@ -240,6 +245,12 @@ def main() -> None:
             "band_tol": band_tol,
             "device_s": round(device_s, 4),
         }
+        if chunk_span:
+            # The span crosses the process boundary inside the payload
+            # (spool file or DRGW frame body — no codec change); absent
+            # entirely when tracing is off, keeping outbox files
+            # byte-identical to round 19.
+            payload["trace_span"] = chunk_span["span"]
         # Outbox BEFORE checkpoint (module docstring): a crash between
         # the two re-computes one deterministic chunk, never loses one.
         # FIRST WRITE WINS: a relaunched generation re-covering the
@@ -270,7 +281,17 @@ def main() -> None:
         telemetry.emit("chunk.done", t0=t - k, t1=t, n_steps=k,
                        solve_rate=round(payload["solve_rate"], 4),
                        device_s=round(device_s, 3),
-                       steps_per_s=round(k / max(device_s, 1e-9), 3))
+                       steps_per_s=round(k / max(device_s, 1e-9), 3),
+                       **chunk_span)
+        # Flush-on-crash metrics (ISSUE 20 satellite): with the rollup
+        # flush armed, persist this shard's in-progress snapshot every
+        # chunk — a kill -9 loses at most one chunk of metric deltas
+        # and the coordinator's post-mortem/rollup sees the last
+        # interval.  Unarmed runs write nothing mid-run (round 19).
+        if os.environ.get(telemetry.ENV_FLUSH):
+            telemetry.set_gauge("sim.timestep", t)
+            telemetry.observe("engine.chunk_device_s", device_s)
+            telemetry.write_snapshot()
     sys.exit(0)
 
 

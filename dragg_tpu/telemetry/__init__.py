@@ -24,8 +24,10 @@ Stdlib-only by contract: the jax-free resilience parents emit through
 this module, so importing it must never initialize a jax backend.
 """
 
+from dragg_tpu.telemetry import rollup, trace, traces
 from dragg_tpu.telemetry.bus import (
     ENV_DIR,
+    ENV_FLUSH,
     EVENTS_FILE,
     METRICS_FILE,
     EventFollower,
@@ -39,6 +41,7 @@ from dragg_tpu.telemetry.bus import (
     run_dir,
     selftest,
     set_gauge,
+    skew_offsets,
     snapshot,
     span,
     stream_paths,
@@ -49,9 +52,10 @@ from dragg_tpu.telemetry.bus import (
 from dragg_tpu.telemetry.registry import EVENTS, METRICS
 
 __all__ = [
-    "ENV_DIR", "EVENTS_FILE", "METRICS_FILE", "EVENTS", "METRICS",
-    "EventFollower",
+    "ENV_DIR", "ENV_FLUSH", "EVENTS_FILE", "METRICS_FILE", "EVENTS",
+    "METRICS", "EventFollower",
     "active", "close_run", "emit", "events_path", "inc", "init_run",
-    "observe", "run_dir", "selftest", "set_gauge", "snapshot", "span",
-    "stream_paths", "tail_events", "tail_events_dir", "write_snapshot",
+    "observe", "rollup", "run_dir", "selftest", "set_gauge",
+    "skew_offsets", "snapshot", "span", "stream_paths", "tail_events",
+    "tail_events_dir", "trace", "traces", "write_snapshot",
 ]

@@ -35,9 +35,10 @@ import sys
 import threading
 import time
 
-from dragg_tpu.telemetry import registry
+from dragg_tpu.telemetry import registry, trace
 
 ENV_DIR = "DRAGG_TELEMETRY_DIR"
+ENV_FLUSH = "DRAGG_TELEMETRY_FLUSH_S"
 EVENTS_FILE = "events.jsonl"
 METRICS_FILE = "metrics.json"
 SCHEMA_VERSION = 1
@@ -89,7 +90,8 @@ class _Hist:
 
 
 class _Bus:
-    def __init__(self, run_dir: str | None, jsonl: bool = True):
+    def __init__(self, run_dir: str | None, jsonl: bool = True,
+                 flush_s: float | None = None):
         self.run_dir = run_dir
         self.lock = threading.RLock()
         self.seq = 0
@@ -99,6 +101,13 @@ class _Bus:
         self.hists: dict[str, _Hist] = {}
         self.path = None
         self._fh = None
+        if flush_s is None:
+            try:
+                flush_s = float(os.environ.get(ENV_FLUSH) or 0.0)
+            except ValueError:
+                flush_s = 0.0
+        self.flush_s = max(0.0, flush_s)
+        self._next_flush = self.mono0 + self.flush_s
         if run_dir and jsonl:
             os.makedirs(run_dir, exist_ok=True)
             self.path = os.path.join(run_dir, EVENTS_FILE)
@@ -111,6 +120,16 @@ class _Bus:
                    "mono": round(time.monotonic() - self.mono0, 6),
                    "pid": os.getpid(), "seq": self.seq}
             rec.update(fields)
+            # Causal trace context (ISSUE 20): when tracing is on, every
+            # record carries trace/span/parent.  setdefault lets an
+            # emitter's finer span win; with tracing off NOTHING is
+            # added, keeping the off-mode stream byte-identical.
+            ctx = trace.current()
+            if ctx is not None:
+                rec.setdefault("trace", ctx["trace"])
+                rec.setdefault("span", ctx["span"])
+                if "parent" not in rec and ctx["parent"] is not None:
+                    rec["parent"] = ctx["parent"]
             if self._fh is not None:
                 try:
                     # One full line per write: POSIX O_APPEND keeps lines
@@ -119,6 +138,15 @@ class _Bus:
                     self._fh.flush()
                 except (OSError, ValueError):
                     pass  # telemetry never kills the workload
+            # Periodic in-progress metrics flush (the live-rollup feed):
+            # a kill -9 between flushes loses at most flush_s of metric
+            # deltas instead of the whole metrics.json.  Off (0.0) by
+            # default — round-19 runs write metrics.json only at close.
+            if self.flush_s and self.run_dir:
+                now = time.monotonic()
+                if now >= self._next_flush:
+                    self._next_flush = now + self.flush_s
+                    _write_snapshot_locked(self)
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -164,16 +192,19 @@ def _current() -> _Bus | None:
         return _bus
 
 
-def init_run(run_dir: str | None = None, jsonl: bool = True) -> str | None:
+def init_run(run_dir: str | None = None, jsonl: bool = True,
+             flush_s: float | None = None) -> str | None:
     """Open the process bus.  ``run_dir=None`` gives a memory-only bus
     (metrics + spans work, no events file — what bench's measured child
     uses unless the supervisor exported a telemetry dir).  Returns the
-    events.jsonl path, or None when memory-only."""
+    events.jsonl path, or None when memory-only.  ``flush_s`` > 0 turns
+    on the periodic in-progress metrics flush (default: read
+    ``$DRAGG_TELEMETRY_FLUSH_S``, else off)."""
     global _bus, _env_checked
     with _state_lock:
         if _bus is not None:
             _bus.close()
-        _bus = _Bus(run_dir, jsonl=jsonl)
+        _bus = _Bus(run_dir, jsonl=jsonl, flush_s=flush_s)
         _env_checked = True
         return _bus.path
 
@@ -336,25 +367,52 @@ def stream_paths(events_path: str) -> list[str]:
     return paths
 
 
+def skew_offsets(records) -> dict:
+    """Per-emitter wall-clock corrections from ``trace.skew`` records:
+    ``{(_stream, pid): offset_s}`` (last record wins).  The offsets come
+    from the shard wire's clock handshake (shard/transport.py) — on a
+    single host they are ~0, on a real multi-host fleet they are the
+    honesty correction merged ordering needs."""
+    offsets: dict = {}
+    for rec in records:
+        if rec.get("event") == "trace.skew":
+            try:
+                offsets[(rec.get("_stream", "main"), rec.get("pid"))] = \
+                    float(rec.get("offset_s") or 0.0)
+            except (TypeError, ValueError):
+                continue
+    return offsets
+
+
 def tail_events_dir(events_path: str, limit: int = 50,
                     tail_bytes: int = 262_144) -> list[dict]:
     """Merged tail across one run's streams (:func:`stream_paths`):
     the newest ``limit`` records across the main stream AND every shard
-    sub-stream, ordered by wall time (``t``; per-stream seq breaks
-    ties).  Each record carries a ``_stream`` key naming its source
-    (``"main"`` or ``"shard<k>"``) so a merged view stays attributable.
-    A run with no sub-streams reduces to :func:`tail_events` plus the
-    ``_stream`` annotation."""
-    merged: list[tuple] = []
+    sub-stream, ordered by ``(t, pid, seq)`` — wall time first, then
+    pid and per-process seq so cross-process ties interleave
+    DETERMINISTICALLY (tests/test_trace.py pins the order).  When a
+    stream carries ``trace.skew`` records (the wire clock handshake),
+    each emitter's ``t`` is skew-corrected before ordering; without
+    them, wall clocks are trusted as-is — the documented caveat for
+    multi-host runs without the tcp transport.  Each record carries a
+    ``_stream`` key naming its source (``"main"`` or ``"shard<k>"``)
+    so a merged view stays attributable.  A run with no sub-streams
+    reduces to :func:`tail_events` plus the ``_stream`` annotation."""
+    labelled: list[dict] = []
     for path in stream_paths(events_path):
         label = os.path.basename(os.path.dirname(path))
         if path == events_path:
             label = "main"
         for rec in tail_events(path, limit=limit, tail_bytes=tail_bytes):
-            merged.append((rec.get("t", 0.0), rec.get("seq", 0),
-                           {**rec, "_stream": label}))
-    merged.sort(key=lambda r: (r[0], r[1]))
-    return [rec for _t, _s, rec in merged[-limit:]]
+            labelled.append({**rec, "_stream": label})
+    offsets = skew_offsets(labelled)
+    merged = []
+    for rec in labelled:
+        off = offsets.get((rec["_stream"], rec.get("pid")), 0.0)
+        merged.append((rec.get("t", 0.0) + off, rec.get("pid") or 0,
+                       rec.get("seq", 0), rec))
+    merged.sort(key=lambda r: (r[0], r[1], r[2]))
+    return [rec for _t, _p, _s, rec in merged[-limit:]]
 
 
 class EventFollower:

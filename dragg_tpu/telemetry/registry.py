@@ -131,6 +131,12 @@ EVENTS: dict[str, str] = {
                    "whole (reason, bytes)",
     "wire.degrade": "wire client fell back (sticky) to the shared spool "
                     "after the retry budget (shard, after_s, attempts)",
+    # Fleet trace plane (ISSUE 20).  The trace/span/parent fields ride
+    # EVERY event's envelope when tracing is on (telemetry/trace.py);
+    # trace.skew is the wire clock handshake's per-process correction.
+    "trace.skew": "wire client measured its wall-clock offset against "
+                  "the coordinator's /clock (shard, offset_s, rtt_s) — "
+                  "merged ordering and the trace assembler apply it",
     # The resilience failure taxonomy as event types (one per kind in
     # taxonomy.FAILURE_KINDS; ``source`` says which layer classified it:
     # "probe" or "supervisor", ``detail``/``label`` locate it).
@@ -317,6 +323,10 @@ METRICS: dict[str, tuple[str, str]] = {
     "wire.retries": ("counter",
                      "failed chunk-push attempts retried by the wire "
                      "client (at-least-once delivery)"),
+    "wire.dedup": ("counter",
+                   "duplicate chunk frames acked without re-merge by the "
+                   "chunk-ingest server (at-least-once deliveries caught "
+                   "by the (epoch, shard, chunk) token)"),
 }
 
 

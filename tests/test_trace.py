@@ -1,0 +1,452 @@
+"""Fleet trace plane (round 20 — ISSUE 20, architecture.md §21).
+
+The tentpole contract has two halves and both are pinned here:
+
+* **off-mode byte identity** — with tracing off (the default), the
+  trace layer adds NOTHING: no envelope fields, no env exports, no
+  headers; the round-19 events.jsonl shape is byte-identical (the seed
+  invariant every satellite rides on);
+* **on-mode completeness** — a traced run assembles into causal trees
+  with >= 1 root and ZERO orphan spans across every propagation edge:
+  supervisor -> child (env), serve request -> batch -> worker chunk
+  (HTTP + env), and tcp shard chunk -> coordinator merge (wire frame),
+  the last one surviving a kill -9 mid-chunk plus relaunch.
+
+Around them: the ``(t, pid, seq)`` + clock-skew merge ordering, the
+periodic metrics flush (crash loses at most one interval — chaos-pinned
+with a real SIGKILL), the /rollup.json + /metrics fleet view, the
+serve-side phase decomposition, and the doctor's trace-plane selftest.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dragg_tpu import telemetry
+from dragg_tpu.config import default_config
+from dragg_tpu.resilience import faults
+from dragg_tpu.telemetry import rollup, trace, traces
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENVELOPE = {"event", "t", "mono", "pid", "seq"}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_trace_plane(monkeypatch):
+    """Every test starts and ends with no bus, no trace context, and no
+    trace/flush env (trace.enable() and the coordinator/daemon flush
+    export are process-global, so leakage would couple tests)."""
+    monkeypatch.delenv(trace.ENV_CTX, raising=False)
+    monkeypatch.delenv(telemetry.ENV_FLUSH, raising=False)
+    telemetry.close_run()
+    trace.disable()
+    yield
+    telemetry.close_run()
+    trace.disable()
+    faults.reset_plan()
+
+
+# ------------------------------------------------- off-mode byte identity
+def test_off_mode_stream_is_round19_byte_identical(tmp_path):
+    """Tracing off adds NO fields anywhere: every helper returns its
+    empty sentinel, and an emitted stream's records carry EXACTLY the
+    round-19 envelope plus the caller's fields — no trace/span/parent
+    keys for the assembler to find."""
+    assert trace.current() is None and not trace.enabled()
+    assert trace.env_value() is None
+    assert trace.child_fields() == {}
+    assert trace.child_fields(parent="x") == {}
+    assert trace.span_fields("s1") == {}
+
+    telemetry.init_run(str(tmp_path))
+    # The exact emit shapes the shard/serve layers use, including the
+    # **child_fields() splat that must expand to nothing.
+    telemetry.emit("run.start", case="baseline", homes=3, horizon=2,
+                   solver="ipm", run_dir=str(tmp_path))
+    telemetry.emit("chunk.done", t0=0, t1=2, solve_rate=1.0, device_s=0.1,
+                   **trace.child_fields())
+    telemetry.emit("wire.push", shard=0, seq=0, dup=False, attempts=1,
+                   **trace.child_fields(parent="ignored-when-off"))
+    telemetry.emit("run.end", completed=True)
+    telemetry.close_run()
+
+    recs = [json.loads(l) for l in
+            open(os.path.join(str(tmp_path), telemetry.EVENTS_FILE))]
+    expected_keys = [
+        ENVELOPE | {"case", "homes", "horizon", "solver", "run_dir"},
+        ENVELOPE | {"t0", "t1", "solve_rate", "device_s"},
+        ENVELOPE | {"shard", "seq", "dup", "attempts"},
+        ENVELOPE | {"completed"},
+    ]
+    assert [set(r) for r in recs] == expected_keys
+    rep = traces.trace_report(str(tmp_path))
+    assert rep["traces"] == {} and rep["untraced_records"] == 4
+
+
+def test_trace_context_enable_and_env_join(monkeypatch):
+    """enable() mints trace + process-root span; a child process joins
+    the SAME trace lazily from $DRAGG_TRACE_CTX, minting its own root
+    span parented on the exported one (how supervised children land
+    inside the parent's tree without calling enable())."""
+    ctx = trace.enable()
+    assert trace.enabled() and trace.current() == ctx
+    assert ctx["parent"] is None
+    assert trace.env_value() == f"{ctx['trace']}:{ctx['span']}"
+    assert trace.env_value(span="abc") == f"{ctx['trace']}:abc"
+    kid = trace.child_fields()
+    assert kid["parent"] == ctx["span"] and kid["span"] != ctx["span"]
+    assert trace.child_fields(parent="p1")["parent"] == "p1"
+    assert trace.span_fields("s1") == {"span": "s1"}
+    assert trace.span_fields("s1", parent="p2") == \
+        {"span": "s1", "parent": "p2"}
+
+    # Simulated child: fresh module state + the exported env value.
+    trace.disable()
+    monkeypatch.setenv(trace.ENV_CTX, f"{ctx['trace']}:{ctx['span']}")
+    joined = trace.current()
+    assert joined["trace"] == ctx["trace"]
+    assert joined["parent"] == ctx["span"]
+    assert joined["span"] not in (ctx["span"], None)
+
+
+# --------------------------------------------------- merge order + skew
+def _write_stream(run_dir, recs):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, telemetry.EVENTS_FILE), "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_merged_ordering_t_pid_seq_with_skew(tmp_path):
+    """tail_events_dir orders the merged streams by skew-corrected
+    (t, pid, seq): a shard whose trace.skew says its wall clock runs
+    5 s FAST sorts 5 s earlier, and exact-t ties break by pid then
+    per-process seq — deterministic cross-process interleave."""
+    main = str(tmp_path)
+    _write_stream(main, [
+        {"event": "shard.plan", "t": 100.0, "pid": 10, "seq": 1},
+        # Exact-t tie with the pid-20 record below: pid breaks it.
+        {"event": "shard.merge", "t": 104.0, "pid": 10, "seq": 2},
+        {"event": "shard.merge", "t": 104.0, "pid": 10, "seq": 3},
+    ])
+    _write_stream(os.path.join(main, "shard0"), [
+        {"event": "trace.skew", "t": 101.0, "pid": 20, "seq": 1,
+         "shard": 0, "offset_s": -5.0, "rtt_s": 0.001},
+        {"event": "chunk.done", "t": 102.0, "pid": 20, "seq": 2, "t1": 2},
+        {"event": "chunk.done", "t": 109.0, "pid": 20, "seq": 3, "t1": 4},
+    ])
+    merged = telemetry.tail_events_dir(
+        os.path.join(main, telemetry.EVENTS_FILE), limit=10)
+    assert [(r["_stream"], r["seq"]) for r in merged] == [
+        ("shard0", 1),   # 101 - 5 = 96
+        ("shard0", 2),   # 102 - 5 = 97
+        ("main", 1),     # 100
+        ("main", 2),     # 104, pid 10 before pid 20's 104
+        ("main", 3),     # same t+pid -> seq
+        ("shard0", 3),   # 109 - 5 = 104, pid 20
+    ]
+    # Without the skew record, wall clocks are trusted as-is (the
+    # documented multi-host caveat) — the shard sorts between.
+    offs = telemetry.skew_offsets(merged)
+    assert offs == {("shard0", 20): -5.0}
+
+
+# -------------------------------------------------- live metrics flush
+def test_flush_interval_writes_live_snapshot(tmp_path):
+    """flush_s > 0 persists metrics.json DURING the run (time-gated on
+    emit) — the live-rollup feed; the default 0.0 keeps the round-19
+    close-time-only behavior."""
+    telemetry.init_run(str(tmp_path / "off"))
+    telemetry.inc("engine.repair_failed")
+    telemetry.emit("heartbeat.beat", progress={})
+    assert not os.path.exists(
+        os.path.join(str(tmp_path / "off"), telemetry.METRICS_FILE))
+    telemetry.close_run()
+
+    telemetry.init_run(str(tmp_path / "on"), flush_s=0.01)
+    telemetry.inc("engine.repair_failed", 3)
+    time.sleep(0.02)
+    telemetry.emit("heartbeat.beat", progress={})  # crosses the gate
+    path = os.path.join(str(tmp_path / "on"), telemetry.METRICS_FILE)
+    assert os.path.exists(path), "no in-progress flush before close"
+    snap = json.load(open(path))
+    assert snap["counters"]["engine.repair_failed"] == 3
+
+
+def test_flush_survives_sigkill(tmp_path):
+    """The crash-safety point of the flush: a child that is SIGKILL'd
+    mid-run (no close, no atexit) still leaves its last flushed
+    metrics.json for the coordinator's post-mortem."""
+    child = (
+        "import os, signal, sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from dragg_tpu import telemetry\n"
+        "telemetry.init_run(%r, flush_s=0.01)\n"
+        "telemetry.inc('engine.repair_failed', 7)\n"
+        "time.sleep(0.02)\n"
+        "telemetry.emit('heartbeat.beat', progress={})\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n" % (ROOT, str(tmp_path)))
+    proc = subprocess.run([sys.executable, "-c", child],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == -signal.SIGKILL
+    snap = json.load(open(os.path.join(str(tmp_path),
+                                       telemetry.METRICS_FILE)))
+    assert snap["counters"]["engine.repair_failed"] == 7
+
+
+# --------------------------------------------------------------- rollup
+def test_rollup_fold_and_prometheus(tmp_path):
+    """fold_rollup merges per-stream snapshots + tails into the fleet
+    view (summed counters, per-shard scoreboard with frontier lag and
+    wire counters); prometheus_text exposes it as 0.0.4 text."""
+    run_dir = str(tmp_path)
+    telemetry.init_run(run_dir)
+    telemetry.emit("shard.plan", workers=2, communities=2)
+    telemetry.emit("shard.launch", shard=0, gen=1, platform="cpu")
+    telemetry.emit("shard.chunk", shard=1, seq=0, t0=0, t1=2)
+    telemetry.inc("wire.dedup", 1)          # server-side dup surface
+    telemetry.set_gauge("engine.solve_rate", 0.5)
+    telemetry.write_snapshot()
+    telemetry.close_run()
+    telemetry.init_run(os.path.join(run_dir, "shard0"))
+    telemetry.emit("chunk.done", t0=0, t1=4, solve_rate=1.0)
+    telemetry.inc("wire.retries", 2)
+    telemetry.inc("engine.repair_failed", 1)
+    telemetry.write_snapshot()
+    telemetry.close_run()
+
+    roll = rollup.fold_rollup(run_dir, now=time.time())
+    assert set(roll["streams"]) == {"main", "shard0"}
+    assert roll["fleet_counters"]["wire.retries"] == 2
+    assert roll["fleet_counters"]["engine.repair_failed"] == 1
+    assert roll["wire_dedup_server"] == 1
+    assert roll["frontier_t"] == 4
+    rows = {r["shard"]: r for r in roll["shards"]}
+    # shard0 has a live stream + snapshot; shard1 is known only from
+    # the coordinator's merge record (the lost-stream fallback).
+    assert rows["shard0"]["frontier_t"] == 4
+    assert rows["shard0"]["frontier_lag"] == 0
+    assert rows["shard0"]["wire_retries"] == 2
+    assert rows["shard0"]["platform"] == "cpu"
+    assert rows["shard0"]["metrics_written_at"] is not None
+    assert rows["shard0"]["last_event_age_s"] is not None
+    assert rows["shard1"]["frontier_t"] == 2
+    assert rows["shard1"]["frontier_lag"] == 2
+
+    text = rollup.prometheus_text(roll)
+    assert "# TYPE dragg_wire_retries counter" in text
+    assert "# TYPE dragg_engine_solve_rate gauge" in text
+    assert 'dragg_wire_retries{stream="shard0"} 2.0' in text
+    assert 'dragg_shard_frontier_lag{shard="shard1"} 2.0' in text
+    assert 'dragg_fleet_frontier_t{run="current"} 4.0' in text
+
+
+# ------------------------------------------ propagation: supervisor/env
+def test_supervisor_child_lands_in_parent_trace(tmp_path):
+    """Env edge: run_supervised exports $DRAGG_TRACE_CTX, the child's
+    first emit joins lazily — one trace, one rooted tree, zero orphans,
+    and the child's span parented on the supervisor's root."""
+    from dragg_tpu.resilience.supervisor import run_supervised
+
+    ctx = trace.enable()
+    telemetry.init_run(str(tmp_path))
+    child = ("import sys; sys.path.insert(0, %r); "
+             "from dragg_tpu.resilience.heartbeat import beat; "
+             "beat({'stage': 'traced-child'})" % ROOT)
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    res = run_supervised([sys.executable, "-c", child], deadline_s=60.0,
+                         label="trace-child", env=env)
+    assert res.ok, res.stderr_tail
+    telemetry.close_run()
+
+    records = traces.read_records(str(tmp_path))
+    assert all(r.get("trace") == ctx["trace"] for r in records)
+    rep = traces.trace_report(str(tmp_path), records=records)
+    assert rep["complete"], traces.completeness_problems(rep)
+    tr = traces.assemble(records)["traces"][ctx["trace"]]
+    assert tr["roots"] == [ctx["span"]] and tr["orphans"] == []
+    beat = next(r for r in records if r["event"] == "heartbeat.beat")
+    assert beat["pid"] != os.getpid()
+    assert tr["spans"][beat["span"]]["parent"] == ctx["span"]
+
+
+# --------------------------------------------- propagation: serve/HTTP
+def _serve_cfg(**overrides):
+    cfg = default_config()
+    cfg["serve"].update({"port": 0, "poll_s": 0.02, "backoff_s": 0.1,
+                         "request_retries": 3, "batch_deadline_s": 30.0,
+                         "worker_stall_s": 30.0, "drain_s": 10.0,
+                         **overrides})
+    cfg["telemetry"]["trace"] = True
+    # Live flush so /metrics has per-stream snapshots mid-run.
+    cfg["telemetry"]["flush_interval_s"] = 0.05
+    return cfg
+
+
+def _request(base, path, body=None, headers=None):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_serve_request_to_worker_chunk_rooted_tree(tmp_path):
+    """HTTP + env edge: a traced daemon answers X-Dragg-Trace/Span on
+    the 202, records the client's X-Dragg-Parent informationally, and
+    the request -> batch -> worker serve.chunk -> serve.done chain
+    assembles into ONE rooted tree with zero orphans — the worker's
+    chunk spans crossing the process boundary via the batch payload."""
+    from dragg_tpu.serve.daemon import ServeDaemon
+
+    sdir = str(tmp_path / "serve")
+    d = ServeDaemon(_serve_cfg(), sdir, platform="cpu", stub=True)
+    d.start()
+    try:
+        base = f"http://127.0.0.1:{d.port}"
+        # steps=2 so the worker emits per-step serve.chunk records (the
+        # cross-process leg of the tree; single-step solves skip them).
+        code, hdrs, raw = _request(
+            base, "/solve", {"id": "tr1", "t": 0, "home": 2, "steps": 2},
+            headers={"X-Dragg-Parent": "client-span-42"})
+        assert code == 202
+        tid = hdrs.get("X-Dragg-Trace")
+        rspan = hdrs.get("X-Dragg-Span")
+        assert tid and rspan, "202 missing trace response headers"
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            _c, _h, body = _request(base, "/result?id=tr1")
+            if json.loads(body).get("status") in ("done", "failed"):
+                break
+            time.sleep(0.05)
+        assert json.loads(body)["status"] == "done"
+
+        # Live fleet view over the same socket while the run is open.
+        code, _h, roll = _request(base, "/rollup.json")
+        roll = json.loads(roll)
+        assert code == 200 and "main" in roll["streams"]
+        code, _h, prom = _request(base, "/metrics")
+        assert code == 200 and b"# TYPE dragg_" in prom
+    finally:
+        d.stop(drain=False)
+
+    records = traces.read_records(sdir)
+    rep = traces.trace_report(sdir, records=records)
+    assert rep["complete"], traces.completeness_problems(rep)
+    assert list(rep["traces"]) == [tid]
+    req_rec = next(r for r in records if r["event"] == "serve.request")
+    assert req_rec["span"] == rspan
+    assert req_rec["client_parent"] == "client-span-42"
+    tr = traces.assemble(records)["traces"][tid]
+    assign = next(r for r in records if r["event"] == "serve.assign")
+    assert assign["parent"] == rspan, "batch span not parented on request"
+    chunk = next(r for r in records if r["event"] == "serve.chunk")
+    assert chunk["parent"] == assign["span"], \
+        "worker chunk span not parented on the batch payload span"
+    assert chunk["pid"] != os.getpid(), "chunk must come from the worker"
+    done = next(r for r in records if r["event"] == "serve.done")
+    assert done["span"] == rspan, "serve.done must close the request span"
+    assert tr["spans"][chunk["span"]]["streams"] == ["main"]
+
+    # Server-side phase decomposition (tools/serve_load.py satellite).
+    phases = traces.phase_breakdown(records, ["tr1"])["tr1"]
+    assert phases["queue_s"] is not None and phases["queue_s"] >= 0.0
+    assert phases["solve_s"] is not None and phases["solve_s"] >= 0.0
+
+
+# ------------------------------------------- propagation: shard wire/tcp
+def _shard_cfg(C=2, n=6):
+    """test_shard's composition-invariant pinned config, telemetry ON
+    (the trace plane is the subject here, not parity)."""
+    cfg = default_config()
+    cfg["community"]["total_number_homes"] = n
+    cfg["community"]["homes_pv"] = 1
+    cfg["community"]["homes_battery"] = 1
+    cfg["community"]["homes_pv_battery"] = 1
+    cfg["home"]["hems"]["prediction_horizon"] = 2
+    cfg["home"]["hems"]["solver"] = "ipm"
+    cfg["fleet"]["communities"] = C
+    cfg["fleet"]["seed_stride"] = 5
+    cfg["tpu"]["bucketed"] = "false"
+    cfg["tpu"]["ipm_tail_frac"] = 0.0
+    cfg["tpu"]["sharded"] = False
+    cfg["telemetry"] = {"enabled": True, "trace": True,
+                        "flush_interval_s": 0.05}
+    return cfg
+
+
+def test_tcp_shard_trace_complete_across_kill9(tmp_path, monkeypatch):
+    """Wire edge + the acceptance headline in one coordinator run: a
+    traced 2-shard tcp run with one worker SIGKILL'd mid-chunk still
+    assembles to ONE complete tree (chunk spans ride the frame body to
+    the coordinator's merge; the relaunched generation re-joins the same
+    trace via env), the clock handshake leaves trace.skew records, and
+    the per-chunk flush keeps every shard's metrics.json live."""
+    from dragg_tpu.shard.coordinator import run_sharded
+
+    cfg = _shard_cfg(C=2)
+    cfg["shard"] = {"transport": "tcp"}
+    monkeypatch.setenv(telemetry.ENV_FLUSH, "0.05")
+    monkeypatch.setenv("DRAGG_FAULT_INJECT", "sigkill@shard_chunk:2:once")
+    monkeypatch.setenv("DRAGG_FAULT_STATE", str(tmp_path / "faults"))
+    os.makedirs(str(tmp_path / "faults"), exist_ok=True)
+    faults.reset_plan()
+    run_dir = str(tmp_path / "run")
+    res = run_sharded(cfg, run_dir=run_dir, steps=4, workers=2,
+                      chunk_steps=2, platform="cpu", data_dir="")
+    assert sum(res["restarts"].values()) == 1, "chaos never fired"
+
+    records = traces.read_records(run_dir)
+    rep = traces.trace_report(run_dir, records=records)
+    assert rep["complete"], traces.completeness_problems(rep)
+    assert len(rep["traces"]) == 1
+    tid, meta = next(iter(rep["traces"].items()))
+    assert len(meta["roots"]) == 1 and not meta["orphans"]
+
+    # Every layer of the chain is present and trace-stamped.
+    by_event = {}
+    for r in records:
+        by_event.setdefault(r["event"], []).append(r)
+    for ev in ("shard.plan", "shard.launch", "chunk.done", "wire.push",
+               "wire.ingest", "shard.chunk", "trace.skew"):
+        assert ev in by_event, f"traced run missing {ev}"
+        assert all(r.get("trace") == tid for r in by_event[ev]), ev
+    # wire.push carries its wall seconds for the critical path, and the
+    # merge record parents on the SAME chunk span the worker opened.
+    assert all(r.get("s") is not None for r in by_event["wire.push"])
+    chunk_spans = {r["span"] for r in by_event["chunk.done"]}
+    assert {r["parent"] for r in by_event["shard.chunk"]} <= chunk_spans
+    # Critical path attributes device + wire seconds.
+    cp = rep["traces"][tid]["critical_path"]
+    assert cp["path_seconds"].get("device", 0) > 0
+    # Handshake offsets are ~0 on one host but must be RECORDED.
+    assert {r["shard"] for r in by_event["trace.skew"]} == {0, 1}
+    # Per-chunk flush: both shard sub-streams left live snapshots.
+    for k in (0, 1):
+        snap = json.load(open(os.path.join(run_dir, f"shard{k}",
+                                           telemetry.METRICS_FILE)))
+        assert snap["counters"] or snap["gauges"] or snap["histograms"]
+
+
+# ---------------------------------------------------------------- doctor
+def test_doctor_trace_plane_selftest():
+    """doctor --telemetry's check: traced child -> complete tree, live
+    flush observed before close, rollup folds — all in one subprocess."""
+    from dragg_tpu.doctor import _check_trace_plane
+
+    res = _check_trace_plane(timeout_s=60.0)
+    assert res["status"] == "ok", res
+    assert res["traces"] == 1 and res["live_flush"] is True

@@ -132,6 +132,21 @@ step "test/wire-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
            python -c "import json; r=json.load(open(\"/tmp/wire_smoke.json\")); assert r[\"ok\"] and r[\"shards\"]==2 and r[\"transport\"]==\"tcp\" and r[\"shard_parity\"][\"ok\"], r" &&
            python -m dragg_tpu doctor --shard-check --backend-timeout 60 | grep "shard_wire *\[ok" >/dev/null'
 
+# --- job: trace smoke (ISSUE 20): the fleet trace plane — a traced
+#     2-shard tcp run (trace ctx over env + wire frames, per-chunk
+#     metric flushes) must assemble into complete causal trees
+#     (every trace rooted, zero orphan spans — tools/trace_view.py
+#     --assert-complete), plus the doctor's trace-plane selftest
+#     (cross-process join + live flush + rollup fold)
+step "test/trace-smoke" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  bash -c 'python tools/validate_scale.py --communities 2 --homes 8 \
+             --horizon-hours 2 --days 1 --chunk 6 --steps 12 --solver ipm \
+             --shards 2 --transport tcp --trace --min-solve-rate 0.8 \
+             | tee /tmp/trace_smoke.json &&
+           python -c "import json; r=json.load(open(\"/tmp/trace_smoke.json\")); assert r[\"ok\"] and r[\"shards\"]==2, r" &&
+           python tools/trace_view.py "$(python -c "import json; print(json.load(open(\"/tmp/trace_smoke.json\"))[\"run_dir\"])")" --assert-complete >/dev/null &&
+           python -m dragg_tpu doctor --telemetry --backend-timeout 60 | grep "trace_plane *\[ok" >/dev/null'
+
 # --- job: bench-trend gate (round 9): the committed BENCH_r*.json series
 #     must show no like-for-like regression (comparability rules per
 #     CLAUDE.md; tools/bench_trend.py docstring)

@@ -49,6 +49,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from dragg_tpu import telemetry  # noqa: E402
+from dragg_tpu.telemetry import traces  # noqa: E402
 from dragg_tpu.config import default_config  # noqa: E402
 from dragg_tpu.resilience.supervisor import assert_parent_has_no_jax  # noqa: E402
 from dragg_tpu.serve import ServeDaemon  # noqa: E402
@@ -179,6 +180,30 @@ def run_level(base: str, events_path: str, reqs: list[dict], rate: float,
     }
 
 
+def _phase_percentiles(run_dir: str, ids: list[str]) -> dict:
+    """Per-phase p50/p99 for one level from the daemon's own records
+    (telemetry.traces.phase_breakdown): queue = accept -> batch dispatch
+    (the coalescing window included), solve = dispatch -> terminal
+    answer, stream = streamed-connection lifetime, compile = staged-
+    compile seconds overlapping the solve window (spill-lane compiles).
+    Decomposed server-side so an SLO breach names the guilty phase
+    without trusting client clocks."""
+    try:
+        records = traces.read_records(run_dir)
+        per_req = traces.phase_breakdown(records, ids)
+    except OSError:
+        return {}
+    out = {}
+    for phase in ("queue", "solve", "stream", "compile"):
+        vals = sorted(v for v in
+                      (p.get(f"{phase}_s") for p in per_req.values())
+                      if v is not None)
+        if vals:
+            out[phase] = {"p50_s": round(_percentile(vals, 0.50), 4),
+                          "p99_s": round(_percentile(vals, 0.99), 4)}
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -241,6 +266,10 @@ def main(argv=None) -> int:
     cfg["community"]["homes_pv_battery"] = max(1, args.homes // 6)
     cfg["home"]["hems"]["prediction_horizon"] = args.horizon_hours
     cfg["tpu"]["compile_cache_dir"] = os.path.join(root, "compile_cache")
+    # Trace plane on (ISSUE 20): request -> batch -> chunk spans land in
+    # the daemon's stream, and the per-level phase decomposition below
+    # names the guilty phase when an SLO breaches.
+    cfg.setdefault("telemetry", {})["trace"] = True
     cfg["serve"].update({
         "fleet_slots": max(1, args.fleet_slots),
         "workers": max(1, args.workers),
@@ -297,6 +326,8 @@ def main(argv=None) -> int:
                                        if occ_n else None)
             level["coalesced_mean"] = (round(co_sum / co_n, 4)
                                        if co_n else None)
+            level["phases"] = _phase_percentiles(
+                root, [r["id"] for r in reqs])
             breach = []
             if level["p99_s"] is None or level["p99_s"] > slo:
                 breach.append(f"p99 {level['p99_s']}s > SLO {slo}s")
@@ -337,6 +368,7 @@ def main(argv=None) -> int:
             "coalesced_mean": head.get("coalesced_mean"),
             "warmup_s": warmup_s,
             "slo_p99_s": slo,
+            "phases": head.get("phases"),
         },
         violations=violations,
         # bench_trend series fields: `serve` is the hard key that keeps

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Assemble and render causal traces from a run's telemetry streams.
+
+Reads every events.jsonl under a run directory (coordinator bus plus
+``shard<k>/`` sub-streams — telemetry.traces.read_records applies the
+``trace.skew`` offsets and the ``(t, pid, seq)`` merge order), assembles
+the span trees, and prints one ASCII timeline per trace with the
+critical-path attribution (queue / compile / device / collect / wire /
+merge — docs/telemetry.md "Tracing").
+
+    python tools/trace_view.py <run_dir>                  # ASCII timelines
+    python tools/trace_view.py <run_dir> --json           # report JSON
+    python tools/trace_view.py <run_dir> --out report.json
+    python tools/trace_view.py <run_dir> --assert-complete  # CI gate:
+        exit 1 naming every orphan span / rootless trace
+
+Stdlib + telemetry only; never imports jax (safe on a wedged tunnel).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dragg_tpu.telemetry import traces  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", help="run directory holding events.jsonl "
+                                    "(sub-streams merged automatically)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the report JSON instead of ASCII timelines")
+    ap.add_argument("--out", default=None,
+                    help="also write the report JSON to this path")
+    ap.add_argument("--width", type=int, default=60,
+                    help="ASCII timeline width in columns (default 60)")
+    ap.add_argument("--assert-complete", action="store_true",
+                    help="exit 1 unless every trace is a single rooted "
+                         "tree with zero orphan spans (CI trace-smoke)")
+    args = ap.parse_args()
+
+    records = traces.read_records(args.run_dir)
+    report = traces.trace_report(args.run_dir, records=records)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+    if args.as_json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        assembled = traces.assemble(records)
+        if not assembled["traces"]:
+            print(f"no traced records under {args.run_dir} "
+                  f"(telemetry.trace off?)")
+        for tid, tr in sorted(assembled["traces"].items()):
+            meta = report["traces"][tid]
+            print(f"trace {tid}: {meta['spans']} spans, "
+                  f"{len(meta['roots'])} root(s), "
+                  f"{'complete' if meta['complete'] else 'INCOMPLETE'}")
+            print(traces.render_ascii(tr, width=args.width))
+            cp = traces.critical_path(tr)
+            buckets = ", ".join(f"{k}={v:.3f}s" for k, v in
+                                sorted(cp["path_seconds"].items()) if v)
+            print(f"  critical path: {' -> '.join(cp['path'])}"
+                  + (f"  [{buckets}]" if buckets else ""))
+            print()
+
+    if args.assert_complete:
+        problems = traces.completeness_problems(report)
+        if problems:
+            for p in problems:
+                print(f"INCOMPLETE: {p}", file=sys.stderr)
+            return 1
+        n = len(report["traces"])
+        print(f"complete: {n} trace{'s' if n != 1 else ''}, zero orphans",
+              file=sys.stderr)
+        if n == 0:
+            print("INCOMPLETE: no traces assembled (was the run traced?)",
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -94,6 +94,11 @@ def run_shards(args):
     if args.stall:
         cfg.setdefault("shard", {})["stall_s"] = args.stall
     cfg.setdefault("shard", {})["transport"] = args.transport
+    if args.trace:
+        # Trace plane (ISSUE 20): the coordinator roots the trace and
+        # every shard's records join it; assemble with
+        # tools/trace_view.py <run_dir> after the run.
+        cfg.setdefault("telemetry", {})["trace"] = True
     dt = int(cfg["agg"]["subhourly_steps"])
     num_ts = args.steps or args.days * 24 * dt
     run_dir = args.shard_run_dir or tempfile.mkdtemp(
@@ -195,6 +200,12 @@ def main():
     ap.add_argument("--shard-run-dir", default=None,
                     help="with --shards: durable journal+spool directory "
                          "(default: a fresh temp dir; reuse to resume)")
+    ap.add_argument("--trace", action="store_true",
+                    help="with --shards: enable the causal trace plane "
+                         "(telemetry.trace) — the run's events carry "
+                         "trace/span ids across the coordinator, workers, "
+                         "and the wire; render with tools/trace_view.py "
+                         "<run_dir>")
     ap.add_argument("--weather-offset-hours", type=int, default=0,
                     help="fleet.weather_offset_hours: community c's "
                          "environment windows shift c× this many hours")
